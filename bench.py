@@ -1,0 +1,109 @@
+"""Benchmark: federated rounds/sec on the canonical ABCD-shaped workload.
+
+Run on real TPU hardware by the driver. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload (BASELINE.md north star): SalientGrads-style federated round on
+full-size ABCD volumes (121x145x121), AlexNet3D, 8 site-clients on the
+available chip(s) — broadcast, vmapped local SGD (5 steps x batch 8 per
+client), weighted aggregation, all one jitted program. ``vs_baseline``
+normalizes against the BASELINE.json target of 10 federated rounds/sec
+(v4-32); the reference itself publishes no throughput numbers (BASELINE.md).
+
+Until the SalientGrads mask path lands, the measured round is FedAvg
+(identical compute minus the mask elementwise multiply).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_CLIENTS = 8
+SAMPLES_PER_CLIENT = 16
+VOLUME = (121, 145, 121, 1)
+BATCH = 8
+STEPS = 5
+TARGET_ROUNDS_PER_SEC = 10.0  # BASELINE.json north star (v4-32)
+
+
+def _device_synth_data(n_clients, n, shape, key):
+    """Generate the federated dataset directly on device (HBM-resident)."""
+    from neuroimagedisttraining_tpu.data.types import FederatedData
+
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n_clients, n) + shape, jnp.float32)
+    y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
+    # plant a mean-shift signal so losses stay in a realistic regime
+    x = x + 0.75 * (y[..., None, None, None, None].astype(jnp.float32) * 2 - 1)
+    counts = jnp.full((n_clients,), n, jnp.int32)
+    m = max(4, n // 4)
+    return FederatedData(
+        x_train=x, y_train=y, n_train=counts,
+        x_test=x[:, :m], y_test=y[:, :m],
+        n_test=jnp.full((n_clients,), m, jnp.int32),
+        class_num=2,
+    )
+
+
+def main():
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = _device_synth_data(
+        N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0)
+    )
+    model = create_model("3dcnn", num_classes=1)
+    hp = HyperParams(
+        lr=1e-3, lr_decay=0.998, momentum=0.9, weight_decay=5e-4,
+        grad_clip=10.0, local_epochs=1, steps_per_epoch=STEPS,
+        batch_size=BATCH,
+    )
+    # On fewer devices than clients, chunk client concurrency to fit HBM
+    # (see FedAlgorithm._vmap_clients); a pod runs the full client vmap.
+    n_dev = len(jax.devices())
+    chunk = None if n_dev >= N_CLIENTS else max(1, n_dev)
+    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                  client_chunk=chunk)
+    state = algo.init_state(jax.random.PRNGKey(0))
+
+    def _sync(s):
+        # force a host transfer: on the experimental axon platform
+        # block_until_ready can return before execution completes
+        return float(jax.tree_util.tree_leaves(s.global_params)[0].sum())
+
+    # warmup / compile
+    state, _ = algo.run_round(state, 0)
+    _sync(state)
+
+    n_rounds = 5
+    t0 = time.perf_counter()
+    for r in range(1, n_rounds + 1):
+        state, m = algo.run_round(state, r)
+    _sync(state)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = n_rounds / dt
+    samples_per_round = N_CLIENTS * STEPS * BATCH
+    print(json.dumps({
+        "metric": "federated_rounds_per_sec_abcd_alexnet3d_8clients",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),
+        "extra": {
+            "client_samples_per_sec": round(rounds_per_sec * samples_per_round, 2),
+            "n_devices": len(jax.devices()),
+            "volume": list(VOLUME),
+            "clients": N_CLIENTS,
+            "local_steps": STEPS,
+            "batch": BATCH,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

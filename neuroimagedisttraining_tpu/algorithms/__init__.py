@@ -1,0 +1,4 @@
+from .base import FedAlgorithm, sample_client_indexes
+from .fedavg import FedAvg
+
+__all__ = ["FedAlgorithm", "FedAvg", "sample_client_indexes"]

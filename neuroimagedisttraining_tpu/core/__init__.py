@@ -1,0 +1,16 @@
+from .state import ClientState, HyperParams
+from .losses import make_loss_fn, bce_with_logits_loss, softmax_ce_loss
+from .optim import clip_by_global_norm, sgd_momentum_step
+from .trainer import make_client_update, make_eval_fn
+
+__all__ = [
+    "ClientState",
+    "HyperParams",
+    "make_loss_fn",
+    "bce_with_logits_loss",
+    "softmax_ce_loss",
+    "clip_by_global_norm",
+    "sgd_momentum_step",
+    "make_client_update",
+    "make_eval_fn",
+]

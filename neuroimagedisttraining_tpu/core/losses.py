@@ -1,0 +1,85 @@
+"""Loss functions.
+
+Reference semantics: ABCD sex classification uses ``nn.BCEWithLogitsLoss`` on a
+single logit with float labels (``sailentgrads/my_model_trainer.py:191-206``);
+CIFAR paths use ``nn.CrossEntropyLoss`` (``fedavg/my_model_trainer.py:38-67``).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _first_output(out):
+    # Several reference models return [logits, features]
+    # (salient_models.py:139,297); losses consume only the logits.
+    if isinstance(out, (tuple, list)):
+        return out[0]
+    return out
+
+
+def bce_with_logits_per_example(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example binary cross-entropy with logits; logits [B,1] or [B]."""
+    logits = _first_output(logits)
+    logits = logits.reshape(logits.shape[0], -1)[:, 0]
+    labels = labels.astype(logits.dtype)
+    # log(1+exp(-|x|)) formulation for numerical stability
+    return (jnp.maximum(logits, 0.0) - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def softmax_ce_per_example(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example softmax cross-entropy; logits [B, K], labels [B] int."""
+    logits = _first_output(logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        logp, labels[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+
+
+def mse_per_example(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-example squared error (AlexNet3D_Dropout_Regression head,
+    salient_models.py:248-297)."""
+    preds = _first_output(preds)
+    preds = preds.reshape(preds.shape[0], -1)[:, 0]
+    return jnp.square(preds - targets.astype(preds.dtype))
+
+
+PER_EXAMPLE_LOSSES = {
+    "bce": bce_with_logits_per_example,
+    "ce": softmax_ce_per_example,
+    "mse": mse_per_example,
+}
+
+
+def bce_with_logits_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(bce_with_logits_per_example(logits, labels))
+
+
+def softmax_ce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(softmax_ce_per_example(logits, labels))
+
+
+def mse_loss(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    return jnp.mean(mse_per_example(preds, targets))
+
+
+def predictions(logits: jax.Array, loss_type: str) -> jax.Array:
+    """Hard predictions matching the reference's eval rules.
+
+    BCE: sigmoid >= 0.5 (``my_model_trainer.py:243-248``); CE: argmax.
+    """
+    logits = _first_output(logits)
+    if loss_type == "bce":
+        logits = logits.reshape(logits.shape[0], -1)[:, 0]
+        return (logits >= 0.0).astype(jnp.int32)  # sigmoid(x) >= .5  <=>  x >= 0
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def make_loss_fn(loss_type: str) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    if loss_type not in PER_EXAMPLE_LOSSES:
+        raise ValueError(f"unknown loss type: {loss_type!r}")
+    per_ex = PER_EXAMPLE_LOSSES[loss_type]
+    return lambda logits, labels: jnp.mean(per_ex(logits, labels))

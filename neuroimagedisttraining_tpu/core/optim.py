@@ -1,0 +1,53 @@
+"""Hand-rolled SGD matching torch.optim.SGD semantics, as pure pytree ops.
+
+Reference local optimizer (``sailentgrads/my_model_trainer.py:191-216``):
+``torch.nn.utils.clip_grad_norm_(params, 10)`` then
+``SGD(lr*decay**round, momentum, weight_decay)``. Torch's update order is
+  g   <- g + wd * p          (weight decay added to the *clipped* grad)
+  buf <- momentum * buf + g  (buf initialised to g on first step == 0-init)
+  p   <- p - lr * buf
+We keep that order exactly so convergence comparisons are apples-to-apples.
+Written as plain tree-maps (not optax) so the whole update stays transparent
+inside a `lax.scan` and fuses into one elementwise XLA kernel per leaf.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree: Any) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+def clip_by_global_norm(grads: Any, max_norm: float) -> Any:
+    """torch.nn.utils.clip_grad_norm_ semantics: scale = max_norm/(norm+1e-6), cap 1."""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+def sgd_momentum_step(
+    params: Any,
+    momentum_buf: Any,
+    grads: Any,
+    lr: jax.Array,
+    momentum: float,
+    weight_decay: float,
+) -> Tuple[Any, Any]:
+    """One torch-order SGD step. Returns (new_params, new_momentum_buf)."""
+
+    def leaf(p, m, g):
+        g = g + weight_decay * p if weight_decay else g
+        m = momentum * m + g if momentum else g
+        return p - lr.astype(p.dtype) * m, m
+
+    flat = jax.tree_util.tree_map(leaf, params, momentum_buf, grads)
+    new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                        is_leaf=lambda t: isinstance(t, tuple))
+    new_mom = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                     is_leaf=lambda t: isinstance(t, tuple))
+    return new_params, new_mom

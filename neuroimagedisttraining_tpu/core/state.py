@@ -1,0 +1,104 @@
+"""Client/server state pytrees.
+
+Replaces the reference's per-client CPU ``state_dict`` dicts
+(``fedml_core/trainer/model_trainer.py:8-58`` — get/set params around a single
+shared ``nn.Module``) with a stacked, device-resident pytree: every field has a
+leading client axis so an entire federated cohort is one SPMD value.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class HyperParams:
+    """Static local-training hyperparameters.
+
+    Mirrors the reference's flag surface for the local SGD loop
+    (``my_model_trainer.py:185-216``): torch.optim.SGD(lr * lr_decay**round,
+    momentum, weight_decay), grad-norm clip at ``grad_clip``, ``epochs`` local
+    epochs of ``steps_per_epoch`` batches of ``batch_size``.
+    """
+
+    lr: float = struct.field(pytree_node=False, default=1e-3)
+    lr_decay: float = struct.field(pytree_node=False, default=0.998)
+    momentum: float = struct.field(pytree_node=False, default=0.0)
+    weight_decay: float = struct.field(pytree_node=False, default=0.0)
+    grad_clip: float = struct.field(pytree_node=False, default=10.0)
+    local_epochs: int = struct.field(pytree_node=False, default=2)
+    steps_per_epoch: int = struct.field(pytree_node=False, default=4)
+    batch_size: int = struct.field(pytree_node=False, default=16)
+
+    @property
+    def local_steps(self) -> int:
+        return self.local_epochs * self.steps_per_epoch
+
+
+@struct.dataclass
+class ClientState:
+    """Per-client training state; stacked along a leading client axis.
+
+    ``params``    — model parameter pytree ([C, ...] per leaf when stacked)
+    ``momentum``  — SGD momentum buffers, same structure as params
+    ``mask``      — {0,1} float pytree, same structure (sparse-FL algorithms);
+                    all-ones for dense algorithms
+    ``rng``       — per-client PRNG key
+    """
+
+    params: Any
+    momentum: Any
+    mask: Any
+    rng: jax.Array
+
+
+def zeros_like_tree(tree: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def ones_like_tree(tree: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.ones_like, tree)
+
+
+def stack_trees(trees: list) -> Any:
+    """Stack a list of identically-structured pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def broadcast_tree(tree: Any, n: int) -> Any:
+    """Replicate a pytree n times along a new leading client axis.
+
+    This is the SPMD analogue of the reference broadcasting the global model to
+    each simulated client via ``set_model_params`` (``sailentgrads/client.py:57-66``).
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree
+    )
+
+
+def tree_index(tree: Any, idx: jax.Array) -> Any:
+    """Gather rows ``idx`` from the leading (client) axis of every leaf."""
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0), tree)
+
+
+def tree_scatter_update(tree: Any, idx: jax.Array, update: Any) -> Any:
+    """Scatter ``update`` (leading axis = len(idx)) back into the client axis."""
+    return jax.tree_util.tree_map(
+        lambda x, u: x.at[idx].set(u), tree, update
+    )
+
+
+def weighted_tree_sum(tree: Any, weights: jax.Array) -> Any:
+    """Weighted sum over the leading client axis of every leaf.
+
+    The TPU-native form of the reference's CPU dict-arithmetic FedAvg
+    aggregation loop (``fedavg_api.py:102-117`` / ``sailentgrads_api.py:212-227``):
+    with the client axis sharded over the mesh, XLA lowers this contraction to a
+    weighted all-reduce over ICI.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.tensordot(weights.astype(x.dtype), x, axes=1), tree
+    )

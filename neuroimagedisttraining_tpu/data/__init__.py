@@ -1,0 +1,4 @@
+from .types import FederatedData
+from .synthetic import make_synthetic_federated
+
+__all__ = ["FederatedData", "make_synthetic_federated"]

@@ -1,0 +1,64 @@
+"""Federated dataset container.
+
+Replaces the reference's 8-element dataset list
+(``train_data_num, test_data_num, train_data_global, test_data_global,
+train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+class_num`` — ``ABCD/data_loader.py:164-216``) with a single device-ready
+pytree: per-client shards padded to a common length with valid-count vectors,
+so the whole cohort ships to the mesh as stacked arrays sharded over the
+``clients`` axis.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class FederatedData:
+    """Stacked per-client shards.
+
+    x_train: [C, n_max, *sample_shape]   y_train: [C, n_max]
+    x_test:  [C, m_max, *sample_shape]   y_test:  [C, m_max]
+    n_train, n_test: [C] int32 valid counts
+    x_val/y_val/n_val: optional per-client validation split (FedFomo needs
+    one — the reference's 9-element ``data_val_loader`` variant,
+    ``cifar10/data_val_loader.py:275-326``).
+    """
+
+    x_train: jax.Array
+    y_train: jax.Array
+    n_train: jax.Array
+    x_test: jax.Array
+    y_test: jax.Array
+    n_test: jax.Array
+    class_num: int = struct.field(pytree_node=False, default=2)
+    x_val: Optional[jax.Array] = None
+    y_val: Optional[jax.Array] = None
+    n_val: Optional[jax.Array] = None
+
+    @property
+    def num_clients(self) -> int:
+        return self.x_train.shape[0]
+
+    @property
+    def sample_shape(self):
+        return self.x_train.shape[2:]
+
+
+def pad_stack(arrays, pad_to=None, dtype=None):
+    """Stack variable-length per-client arrays into [C, n_max, ...] + counts."""
+    import numpy as np
+
+    n = [len(a) for a in arrays]
+    n_max = pad_to or max(n)
+    first = np.asarray(arrays[0])
+    out = np.zeros((len(arrays), n_max) + first.shape[1:],
+                   dtype or first.dtype)
+    for i, a in enumerate(arrays):
+        a = np.asarray(a)
+        out[i, : len(a)] = a
+    return jnp.asarray(out), jnp.asarray(np.array(n, np.int32))

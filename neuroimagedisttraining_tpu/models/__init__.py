@@ -1,0 +1,77 @@
+"""Model zoo registry.
+
+Mirrors the reference's ``create_model`` switch
+(``main_sailentgrads.py:164-178``: "3DCNN" -> AlexNet3D_Dropout, etc.) but
+returns a flax module plus a uniform ``apply_fn(params, x, train, rng)``
+closure that the vmapped trainer consumes.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+
+from .alexnet3d import (
+    AlexNet3D,
+    AlexNet3DDeeper,
+    AlexNet3DRegression,
+    SmallCNN3D,
+)
+
+ApplyFn = Callable[..., Any]
+
+
+def _registry():
+    from .resnet3d import ResNet3DL3  # local import: keeps zoo modular
+    from .resnet2d import ResNet18GN, TinyResNet18
+    from .cnn2d import CNNCifar10, CNNCifar100, CNNOriginalFedAvg, LeNet5, VGG11
+
+    return {
+        # reference names (main_*.py --model flags)
+        "3dcnn": lambda num_classes, **kw: AlexNet3D(num_classes=num_classes, **kw),
+        "3dcnn_deeper": lambda num_classes, **kw: AlexNet3DDeeper(num_classes=num_classes, **kw),
+        "3dcnn_regression": lambda num_classes, **kw: AlexNet3DRegression(
+            num_outputs=num_classes, **kw
+        ),
+        "3dresnet": lambda num_classes, **kw: ResNet3DL3(num_classes=num_classes, **kw),
+        "resnet18": lambda num_classes, **kw: ResNet18GN(num_classes=num_classes, **kw),
+        "tiny_resnet18": lambda num_classes, **kw: TinyResNet18(num_classes=num_classes, **kw),
+        "cnn_cifar10": lambda num_classes, **kw: CNNCifar10(num_classes=num_classes, **kw),
+        "cnn_cifar100": lambda num_classes, **kw: CNNCifar100(num_classes=num_classes, **kw),
+        "cnn": lambda num_classes, **kw: CNNOriginalFedAvg(num_classes=num_classes, **kw),
+        "lenet5": lambda num_classes, **kw: LeNet5(num_classes=num_classes, **kw),
+        "vgg11": lambda num_classes, **kw: VGG11(num_classes=num_classes, **kw),
+        # CI/test model
+        "small3dcnn": lambda num_classes, **kw: SmallCNN3D(num_classes=num_classes, **kw),
+    }
+
+
+def create_model(name: str, num_classes: int = 1, **kwargs):
+    reg = _registry()
+    key = name.lower()
+    if key not in reg:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(reg)}")
+    return reg[key](num_classes, **kwargs)
+
+
+def make_apply_fn(model) -> ApplyFn:
+    """Uniform apply closure: dropout rng threaded only in train mode."""
+
+    def apply_fn(params, x, train: bool, rng):
+        if train:
+            return model.apply(
+                {"params": params}, x, train=True, rngs={"dropout": rng}
+            )
+        return model.apply({"params": params}, x, train=False)
+
+    return apply_fn
+
+
+def init_params(model, rng: jax.Array, sample_shape: Tuple[int, ...]):
+    """Initialize parameters for input volumes/images of ``sample_shape``
+    (without batch axis)."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((1,) + tuple(sample_shape), jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, x, train=False)
+    return variables["params"]

@@ -1,0 +1,158 @@
+"""AlexNet3D family — the north-star ABCD sex-classification models.
+
+TPU-native re-designs of the reference architectures
+(``fedml_api/model/cv/salient_models.py``):
+  * AlexNet3D_Dropout          (:142-191) — 5-conv 3D feature stack,
+    Dropout/Linear(256->64->num_classes) head
+  * AlexNet3D_Deeper_Dropout   (:194-246) — 6-conv, 512->64 head,
+    returns [logits, logits]
+  * AlexNet3D_Dropout_Regression (:248-297) — regression head,
+    returns [pred, features]
+
+Layout is channels-last (N, D, H, W, C) — the TPU-preferred conv layout —
+with GroupNorm in place of BatchNorm3d (see models/layers.py docstring).
+Spatial arithmetic (VALID convs, floor-mode pools) matches torch exactly, so
+on the canonical (121,145,121) volume the flatten width is 256 (resp. 512),
+identical to the reference's Linear input sizes.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+
+from .layers import Conv3d, avg_pool3d, flatten, group_norm, max_pool3d
+
+
+class _Features(nn.Module):
+    """Shared 5-conv feature stack of AlexNet3D_Dropout."""
+
+    widths: tuple = (64, 128, 192, 192, 128)
+
+    @nn.compact
+    def __call__(self, x):
+        w1, w2, w3, w4, w5 = self.widths
+        x = Conv3d(w1, kernel_size=5, strides=2, padding=0)(x)
+        x = group_norm(w1)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+
+        x = Conv3d(w2, kernel_size=3, strides=1, padding=0)(x)
+        x = group_norm(w2)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+
+        x = Conv3d(w3, kernel_size=3, padding=1)(x)
+        x = group_norm(w3)(x)
+        x = nn.relu(x)
+
+        x = Conv3d(w4, kernel_size=3, padding=1)(x)
+        x = group_norm(w4)(x)
+        x = nn.relu(x)
+
+        x = Conv3d(w5, kernel_size=3, padding=1)(x)
+        x = group_norm(w5)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+        return x
+
+
+class AlexNet3D(nn.Module):
+    """AlexNet3D_Dropout (salient_models.py:142-191).
+
+    For ABCD BCE training use num_classes=1 (the reference trains
+    BCEWithLogits on a single logit, ``my_model_trainer.py:191-206``).
+    """
+
+    num_classes: int = 1
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = _Features()(x)
+        x = flatten(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+class AlexNet3DDeeper(nn.Module):
+    """AlexNet3D_Deeper_Dropout (salient_models.py:194-246); returns [x, x]."""
+
+    num_classes: int = 1
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for i, (w, spec) in enumerate(
+            [
+                (64, dict(kernel_size=5, strides=2, padding=0)),
+                (128, dict(kernel_size=3, strides=1, padding=0)),
+                (192, dict(kernel_size=3, padding=1)),
+                (384, dict(kernel_size=3, padding=1)),
+                (256, dict(kernel_size=3, padding=1)),
+                (256, dict(kernel_size=3, padding=1)),
+            ]
+        ):
+            x = Conv3d(w, **spec)(x)
+            x = group_norm(w)(x)
+            x = nn.relu(x)
+            if i in (0, 1, 5):
+                x = max_pool3d(x, kernel=3, strides=3)
+        x = flatten(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return [x, x]
+
+
+class AlexNet3DRegression(nn.Module):
+    """AlexNet3D_Dropout_Regression (salient_models.py:248-297).
+
+    Returns [pred, features] like the reference (features = pre-flatten conv
+    activations).
+    """
+
+    num_outputs: int = 1
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        feats = _Features()(x)
+        x = flatten(feats)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_outputs)(x)
+        return [x, feats]
+
+
+class SmallCNN3D(nn.Module):
+    """Tiny 3D CNN for CI-scale tests and multi-chip dry-runs.
+
+    Same structural idiom as AlexNet3D (conv/GN/relu/pool -> dense head) but
+    works on volumes as small as 8^3, keeping CPU test time negligible. This
+    plays the role of the reference's ``--ci 1`` smoke path
+    (``sailentgrads_api.py:260-265``).
+    """
+
+    num_classes: int = 1
+    width: int = 8
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = Conv3d(self.width, kernel_size=3, strides=2, padding=1)(x)
+        x = group_norm(self.width)(x)
+        x = nn.relu(x)
+        x = Conv3d(self.width * 2, kernel_size=3, strides=1, padding=1)(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2, 3))  # global average pool
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x

@@ -1,0 +1,108 @@
+"""Small 2D CNN zoo for CIFAR/MNIST-family parity runs.
+
+Re-designs of:
+  * cnn_cifar10 / cnn_cifar100 — 2x[conv5 + maxpool2] -> 384 -> 192 -> K
+    (``fedml_api/model/cv/cnn_cifar10.py:12-50``)
+  * CNN_OriginalFedAvg — the FedAvg-paper MNIST CNN: 2x[conv5 SAME +
+    maxpool2] -> 512 -> K (``cnn.py:6-96``)
+  * LeNet5 (SNIP-paper Caffe variant, no padding in conv1)
+    (``lenet5.py:4-28``)
+  * VGG11 with GroupNorm(32) (``vgg.py:14-88``, cfg 'A')
+Channels-last (N, H, W, C).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+
+from .layers import group_norm
+
+
+class _CNNCifar(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)  # 64*5*5 on 32x32 input
+        x = nn.relu(nn.Dense(384)(x))
+        x = nn.relu(nn.Dense(192)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNCifar10(_CNNCifar):
+    num_classes: int = 10
+
+
+class CNNCifar100(_CNNCifar):
+    num_classes: int = 100
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """McMahan et al. FedAvg MNIST CNN (cnn.py:6-96)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class LeNet5(nn.Module):
+    """SNIP-paper LeNet-5 (lenet5.py:4-28): conv1 has no padding."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(nn.Conv(20, (5, 5), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(50, (5, 5), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(500)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_VGG_CFG_A = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+_VGG_CFG_D = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class _VGG(nn.Module):
+    num_classes: int = 10
+    cfg: tuple = _VGG_CFG_A
+    use_group_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1)(x)
+                if self.use_group_norm:
+                    x = group_norm(v)(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGG11(_VGG):
+    cfg: tuple = _VGG_CFG_A
+
+
+class VGG16(_VGG):
+    cfg: tuple = _VGG_CFG_D

@@ -1,0 +1,80 @@
+"""Shared layers for the 3D/2D model zoo (flax linen, channels-last).
+
+Normalization policy: the reference's 3D nets use BatchNorm3d
+(``salient_models.py:146-176``) but its CIFAR ResNet already swaps BN for
+GroupNorm(32) as the FL-friendly choice (``resnet.py:91-126`` — no running
+stats to desynchronize across clients). We standardize on GroupNorm for every
+model (documented deviation for the 3D nets): under vmap-over-clients there is
+no per-client mutable running-stat state to carry, and eval needs no
+train/eval statistics split. ``norm="batch"`` is intentionally not offered.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Ints3 = Union[int, Tuple[int, int, int]]
+
+
+def _triple(v: Ints3) -> Tuple[int, int, int]:
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def group_norm(channels: int, max_groups: int = 32) -> nn.GroupNorm:
+    """GroupNorm with the largest group count <= max_groups dividing channels."""
+    g = min(max_groups, channels)
+    while channels % g:
+        g -= 1
+    return nn.GroupNorm(num_groups=g)
+
+
+class Conv3d(nn.Module):
+    """3D conv over (N, D, H, W, C) with torch-style integer padding.
+
+    padding=0 -> VALID (torch default); padding=p -> p voxels each side.
+    Output sizes therefore match the torch reference exactly (floor division).
+    """
+
+    features: int
+    kernel_size: Ints3
+    strides: Ints3 = 1
+    padding: Ints3 = 0
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        k = _triple(self.kernel_size)
+        s = _triple(self.strides)
+        p = _triple(self.padding)
+        return nn.Conv(
+            features=self.features,
+            kernel_size=k,
+            strides=s,
+            padding=[(pi, pi) for pi in p],
+            use_bias=self.use_bias,
+        )(x)
+
+
+def max_pool3d(x, kernel: Ints3, strides: Ints3, padding: Ints3 = 0):
+    """torch MaxPool3d semantics (floor mode) on (N, D, H, W, C)."""
+    k = _triple(kernel)
+    s = _triple(strides)
+    p = _triple(padding)
+    return nn.max_pool(
+        x, window_shape=k, strides=s, padding=[(pi, pi) for pi in p]
+    )
+
+
+def avg_pool3d(x, kernel: Ints3, strides: Ints3 = None, padding: Ints3 = 0):
+    k = _triple(kernel)
+    s = _triple(strides if strides is not None else kernel)
+    p = _triple(padding)
+    return nn.avg_pool(
+        x, window_shape=k, strides=s, padding=[(pi, pi) for pi in p]
+    )
+
+
+def flatten(x):
+    return x.reshape(x.shape[0], -1)

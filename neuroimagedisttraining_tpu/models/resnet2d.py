@@ -1,0 +1,81 @@
+"""CIFAR ResNet-18 with GroupNorm (the FL-friendly normalization).
+
+Re-design of the reference ``fedml_api/model/cv/resnet.py``:
+``customized_resnet18`` (:91-126) — CIFAR-style ResNet18 (3x3 stem, no
+maxpool, 4 stages of 2 BasicBlocks, avgpool(4), linear) with every BN
+replaced by GroupNorm(32); ``tiny_resnet18`` (:134-180) — 64x64-input
+variant. Channels-last (N, H, W, C).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from .layers import group_norm
+
+
+class BasicBlock2D(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False)(x)
+        y = group_norm(self.planes)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), strides=1, padding=1,
+                    use_bias=False)(y)
+        y = group_norm(self.planes)(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            residual = nn.Conv(self.planes, (1, 1), strides=self.stride,
+                               use_bias=False)(x)
+            residual = group_norm(self.planes)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18GN(nn.Module):
+    """customized_resnet18 (resnet.py:91-126), GroupNorm everywhere."""
+
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (3, 3), strides=1, padding=1, use_bias=False)(x)
+        x = group_norm(64)(x)
+        x = nn.relu(x)
+        for stage, (planes, n) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            for b in range(n):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BasicBlock2D(planes=planes, stride=stride)(x)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+class TinyResNet18(nn.Module):
+    """tiny_resnet18 (resnet.py:134-180): 64x64 stem with stride-2 conv +
+    maxpool before the residual stages."""
+
+    num_classes: int = 200
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (3, 3), strides=2, padding=1, use_bias=False)(x)
+        x = group_norm(64)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, (planes, n) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            for b in range(n):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BasicBlock2D(planes=planes, stride=stride)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,92 @@
+"""3-stage 3D ResNet (ResNet_l3) for ABCD volumes.
+
+Re-design of the reference ``fedml_api/model/cv/salient_models.py:84-139``
+(Conv3d stem k3/s2/p3 -> maxpool k3/s2/p1 -> three BasicBlock stages
+64/128/256 -> AvgPool3d(3) -> fc -> fc2, returning [logits, features]) with
+GroupNorm replacing BatchNorm3d and channels-last layout. The fc input width
+is inferred from the flattened feature map instead of the reference's
+hard-coded 9216 (which bakes in one specific input size).
+
+BasicBlock/Bottleneck follow the standard torchvision residual recipe the
+reference reuses (``salient_models.py:13-81``).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from .layers import Conv3d, avg_pool3d, flatten, group_norm, max_pool3d
+
+
+class BasicBlock3D(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = Conv3d(self.planes, kernel_size=3, strides=self.stride, padding=1,
+                   use_bias=False)(x)
+        y = group_norm(self.planes)(y)
+        y = nn.relu(y)
+        y = Conv3d(self.planes, kernel_size=3, strides=1, padding=1,
+                   use_bias=False)(y)
+        y = group_norm(self.planes)(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            residual = Conv3d(self.planes, kernel_size=1, strides=self.stride,
+                              padding=0, use_bias=False)(x)
+            residual = group_norm(self.planes)(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck3D(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_ch = self.planes * self.expansion
+        residual = x
+        y = Conv3d(self.planes, kernel_size=1, padding=0, use_bias=False)(x)
+        y = group_norm(self.planes)(y)
+        y = nn.relu(y)
+        y = Conv3d(self.planes, kernel_size=3, strides=self.stride, padding=1,
+                   use_bias=False)(y)
+        y = group_norm(self.planes)(y)
+        y = nn.relu(y)
+        y = Conv3d(out_ch, kernel_size=1, padding=0, use_bias=False)(y)
+        y = group_norm(out_ch)(y)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            residual = Conv3d(out_ch, kernel_size=1, strides=self.stride,
+                              padding=0, use_bias=False)(x)
+            residual = group_norm(out_ch)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet3DL3(nn.Module):
+    """ResNet_l3: 3-stage 3D ResNet returning [logits, penultimate]."""
+
+    num_classes: int = 1
+    layers: Sequence[int] = (2, 2, 2)
+    block: str = "basic"  # "basic" | "bottleneck"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        Block = BasicBlock3D if self.block == "basic" else Bottleneck3D
+        x = Conv3d(64, kernel_size=3, strides=2, padding=3, use_bias=False)(x)
+        x = group_norm(64)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=2, padding=1)
+        for stage, (planes, n_blocks) in enumerate(
+            zip((64, 128, 256), self.layers)
+        ):
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = Block(planes=planes, stride=stride)(x)
+        x = avg_pool3d(x, kernel=3, strides=3)
+        x = flatten(x)
+        x1 = nn.Dense(512)(x)
+        logits = nn.Dense(self.num_classes)(x1)
+        return [logits, x1]

@@ -1,0 +1,65 @@
+"""Device mesh + sharding helpers.
+
+This is the TPU-native replacement for the reference's distributed substrate
+(``fedml_core/distributed/``: MPI send/recv daemon threads with pickled
+state_dicts, ``mpi/com_manager.py:13-98``): instead of explicit peer sends,
+per-client values carry a leading client axis laid out over a ``clients`` mesh
+axis, and aggregation/gossip lower to XLA collectives over ICI. Multi-host
+(DCN) uses the same mesh spanning all processes after
+``jax.distributed.initialize`` (``parallel/multihost.py``, planned).
+
+Mesh axes:
+  * ``clients`` — the federated axis: one (or more) simulated site/hospital
+    client per device.
+  * ``space``   — optional spatial axis for sharding a single 3D volume's
+    conv grid across devices (this framework's sequence/context-parallel
+    analogue; see SURVEY.md §5.7 — consumer lands in parallel/spatial.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_client_devices: Optional[int] = None,
+    n_space: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (clients[, space]) mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_client_devices is None:
+        n_client_devices = len(devices) // n_space
+    n_total = n_client_devices * n_space
+    if n_total > len(devices):
+        raise ValueError(
+            f"mesh needs {n_total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:n_total])
+    if n_space == 1:
+        return Mesh(arr.reshape(n_client_devices), ("clients",))
+    return Mesh(arr.reshape(n_client_devices, n_space), ("clients", "space"))
+
+
+def shard_over_clients(tree: Any, mesh: Mesh) -> Any:
+    """Place a pytree whose leaves have a leading client axis onto the mesh,
+    sharded over ``clients``."""
+    sharding = NamedSharding(mesh, P("clients"))
+    return jax.device_put(tree, sharding)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a pytree (e.g. global model params) across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("clients"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Per SURVEY.md §4, the reference has no test suite; this repo adds the full
+pyramid, with multi-device integration tests simulated via
+``--xla_force_host_platform_device_count=8`` on CPU.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+# The sandbox preloads jax with JAX_PLATFORMS=axon (real TPU tunnel) via
+# sitecustomize, so the env var above can be too late — force the config too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

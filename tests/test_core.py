@@ -1,0 +1,90 @@
+"""Unit tests: losses, optimizer semantics, state utilities."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.core.losses import (
+    bce_with_logits_loss,
+    predictions,
+    softmax_ce_loss,
+)
+from neuroimagedisttraining_tpu.core.optim import (
+    clip_by_global_norm,
+    global_norm,
+    sgd_momentum_step,
+)
+from neuroimagedisttraining_tpu.core.state import (
+    broadcast_tree,
+    weighted_tree_sum,
+)
+
+
+def test_bce_matches_reference_formula():
+    logits = jnp.array([0.5, -1.2, 3.0])
+    labels = jnp.array([1, 0, 1])
+    expected = -np.mean(
+        np.array(labels) * np.log(1 / (1 + np.exp(-np.array(logits))))
+        + (1 - np.array(labels)) * np.log(1 - 1 / (1 + np.exp(-np.array(logits))))
+    )
+    got = bce_with_logits_loss(logits[:, None], labels)
+    assert np.allclose(got, expected, rtol=1e-4)
+
+
+def test_ce_matches_nll():
+    logits = jnp.array([[2.0, 0.5, -1.0], [0.0, 1.0, 0.0]])
+    labels = jnp.array([0, 2])
+    p = np.exp(np.array(logits))
+    p /= p.sum(-1, keepdims=True)
+    expected = -np.mean(np.log(p[np.arange(2), np.array(labels)]))
+    assert np.allclose(softmax_ce_loss(logits, labels), expected, rtol=1e-5)
+
+
+def test_predictions_bce_threshold():
+    logits = jnp.array([[0.01], [-0.01], [0.0]])
+    preds = predictions(logits, "bce")
+    assert preds.tolist() == [1, 0, 1]  # sigmoid>=0.5 <=> logit>=0
+
+
+def test_clip_by_global_norm_matches_torch_semantics():
+    grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
+    norm = float(global_norm(grads))
+    assert np.isclose(norm, np.sqrt(10 * 9 + 10 * 16))
+    clipped = clip_by_global_norm(grads, 1.0)
+    assert np.isclose(float(global_norm(clipped)), 1.0, rtol=1e-4)
+    # below threshold: untouched
+    small = {"a": jnp.array([0.1]), "b": jnp.array([0.1])}
+    out = clip_by_global_norm(small, 10.0)
+    assert np.allclose(out["a"], small["a"])
+
+
+def test_sgd_momentum_matches_torch_update_order():
+    # torch: g += wd*p; buf = mu*buf + g; p -= lr*buf
+    p = {"w": jnp.array([1.0])}
+    m = {"w": jnp.array([0.0])}
+    g = {"w": jnp.array([2.0])}
+    lr, mu, wd = jnp.float32(0.1), 0.9, 0.01
+    p1, m1 = sgd_momentum_step(p, m, g, lr, mu, wd)
+    g_eff = 2.0 + 0.01 * 1.0
+    assert np.allclose(m1["w"], g_eff)
+    assert np.allclose(p1["w"], 1.0 - 0.1 * g_eff)
+    # second step accumulates momentum
+    p2, m2 = sgd_momentum_step(p1, m1, g, lr, mu, wd)
+    g_eff2 = 2.0 + 0.01 * float(p1["w"][0])
+    buf2 = 0.9 * g_eff + g_eff2
+    assert np.allclose(m2["w"], buf2, rtol=1e-5)
+    assert np.allclose(p2["w"], p1["w"] - 0.1 * buf2, rtol=1e-5)
+
+
+def test_weighted_tree_sum_is_fedavg_aggregate():
+    # mirrors fedavg_api.py:102-117: w_global[k] = sum_i (n_i/N) local_i[k]
+    stacked = {"w": jnp.array([[1.0, 1.0], [3.0, 3.0]])}
+    weights = jnp.array([0.25, 0.75])
+    out = weighted_tree_sum(stacked, weights)
+    assert np.allclose(out["w"], [2.5, 2.5])
+
+
+def test_broadcast_tree():
+    t = {"w": jnp.ones((2, 3))}
+    b = broadcast_tree(t, 4)
+    assert b["w"].shape == (4, 2, 3)

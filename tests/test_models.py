@@ -1,0 +1,81 @@
+"""Model zoo shape/parity tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.models import (
+    create_model,
+    init_params,
+    make_apply_fn,
+)
+
+
+def _n_params(params):
+    return sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+
+
+def test_small3dcnn_forward():
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    apply_fn = make_apply_fn(model)
+    x = jnp.ones((4, 8, 8, 8, 1))
+    out = apply_fn(params, x, train=False, rng=None)
+    assert out.shape == (4, 1)
+    out_t = apply_fn(params, x, train=True, rng=jax.random.PRNGKey(1))
+    assert out_t.shape == (4, 1)
+
+
+@pytest.mark.slow
+def test_alexnet3d_flatten_width_matches_reference():
+    """On the canonical ABCD volume the feature stack flattens to 256
+    (the reference's hard-coded Linear(256, 64), salient_models.py:180)."""
+    model = create_model("3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (121, 145, 121, 1))
+    # classifier first Dense kernel must have input dim 256
+    dense_kernels = [
+        p for path, p in jax.tree_util.tree_flatten_with_path(params)[0]
+        if path[-1].key == "kernel" and p.ndim == 2
+    ]
+    first_dense = min(dense_kernels, key=lambda k: -k.shape[0])
+    assert first_dense.shape[0] == 256
+
+
+def test_alexnet3d_runs_on_smallest_valid_volume():
+    # 77^3 is the smallest cube surviving three k3/s3 floor-mode pools
+    model = create_model("3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (77, 77, 77, 1))
+    apply_fn = make_apply_fn(model)
+    out = apply_fn(params, jnp.ones((1, 77, 77, 77, 1)), train=False, rng=None)
+    assert out.shape == (1, 1)
+
+
+def test_multi_output_models_return_pairs():
+    model = create_model("3dresnet", num_classes=2)
+    params = init_params(model, jax.random.PRNGKey(0), (32, 32, 32, 1))
+    apply_fn = make_apply_fn(model)
+    out = apply_fn(params, jnp.ones((2, 32, 32, 32, 1)), train=False, rng=None)
+    assert isinstance(out, list) and len(out) == 2
+    assert out[0].shape == (2, 2)
+    assert out[1].shape == (2, 512)
+
+
+def test_cifar_models_shapes():
+    for name, nc in [("cnn_cifar10", 10), ("resnet18", 10), ("lenet5", 10)]:
+        shape = (32, 32, 3) if name != "lenet5" else (28, 28, 1)
+        model = create_model(name, num_classes=nc)
+        params = init_params(model, jax.random.PRNGKey(0), shape)
+        apply_fn = make_apply_fn(model)
+        out = apply_fn(params, jnp.ones((2,) + shape), train=False, rng=None)
+        assert out.shape == (2, nc), name
+
+
+def test_cnn_cifar10_flatten_width():
+    """cnn_cifar10 flattens to 64*5*5=1600 on 32x32 (cnn_cifar10.py:19)."""
+    model = create_model("cnn_cifar10", num_classes=10)
+    params = init_params(model, jax.random.PRNGKey(0), (32, 32, 3))
+    kernels = [
+        p for path, p in jax.tree_util.tree_flatten_with_path(params)[0]
+        if path[-1].key == "kernel" and p.ndim == 2
+    ]
+    assert sorted(k.shape[0] for k in kernels) == [192, 384, 1600]
