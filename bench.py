@@ -10,8 +10,6 @@ client), weighted aggregation, all one jitted program. ``vs_baseline``
 normalizes against the BASELINE.json target of 10 federated rounds/sec
 (v4-32); the reference itself publishes no throughput numbers (BASELINE.md).
 
-Until the SalientGrads mask path lands, the measured round is FedAvg
-(identical compute minus the mask elementwise multiply).
 """
 from __future__ import annotations
 
@@ -50,7 +48,7 @@ def _device_synth_data(n_clients, n, shape, key):
 
 
 def main():
-    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.algorithms import SalientGrads
     from neuroimagedisttraining_tpu.core.state import HyperParams
     from neuroimagedisttraining_tpu.models import create_model
 
@@ -67,9 +65,10 @@ def main():
     # (see FedAlgorithm._vmap_clients); a pod runs the full client vmap.
     n_dev = len(jax.devices())
     chunk = None if n_dev >= N_CLIENTS else max(1, n_dev)
-    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
-                  client_chunk=chunk)
-    state = algo.init_state(jax.random.PRNGKey(0))
+    algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                        client_chunk=chunk, dense_ratio=0.5,
+                        itersnip_iterations=1)
+    state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
 
     def _sync(s):
         # force a host transfer: on the experimental axon platform
@@ -90,7 +89,7 @@ def main():
     rounds_per_sec = n_rounds / dt
     samples_per_round = N_CLIENTS * STEPS * BATCH
     print(json.dumps({
-        "metric": "federated_rounds_per_sec_abcd_alexnet3d_8clients",
+        "metric": "salientgrads_rounds_per_sec_abcd_alexnet3d_8clients",
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),

@@ -1,4 +1,5 @@
 from .base import FedAlgorithm, sample_client_indexes
 from .fedavg import FedAvg
+from .salientgrads import SalientGrads
 
-__all__ = ["FedAlgorithm", "FedAvg", "sample_client_indexes"]
+__all__ = ["FedAlgorithm", "FedAvg", "SalientGrads", "sample_client_indexes"]

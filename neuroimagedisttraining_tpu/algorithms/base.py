@@ -63,18 +63,6 @@ class FedAlgorithm(abc.ABC):
         self.seed = seed
         self.num_clients = data.num_clients
         self.clients_per_round = max(1, int(round(self.num_clients * frac)))
-        if client_chunk:
-            # chunked vmap reshapes [S] -> [S//chunk, chunk]; snap the chunk
-            # to the largest divisor of the per-round client count
-            c = min(client_chunk, self.clients_per_round)
-            while self.clients_per_round % c:
-                c -= 1
-            if c != client_chunk:
-                logger.info(
-                    "client_chunk %d does not divide %d clients/round; using %d",
-                    client_chunk, self.clients_per_round, c,
-                )
-            client_chunk = c
         self.client_chunk = client_chunk
         self.apply_fn = make_apply_fn(model)
         self.eval_client = make_eval_fn(self.apply_fn, loss_type, eval_batch)
@@ -110,11 +98,22 @@ class FedAlgorithm(abc.ABC):
         jitted program with zero host round-trips.
         """
         vfn = jax.vmap(fn, in_axes=in_axes)
-        chunk = self.client_chunk
-        if not chunk:
+        max_chunk = self.client_chunk
+        if not max_chunk:
             return vfn
 
         def chunked(*args):
+            # snap the chunk to the largest divisor of this call's client
+            # count (the round uses clients_per_round, the SNIP pass all
+            # clients — both shapes are static at trace time)
+            first_mapped = next(
+                a for ax, a in zip(in_axes, args) if ax is not None
+            )
+            n = jax.tree_util.tree_leaves(first_mapped)[0].shape[0]
+            chunk = min(max_chunk, n)
+            while n % chunk:
+                chunk -= 1
+
             def reshape_in(ax, a):
                 if ax is None:
                     return a
@@ -146,6 +145,37 @@ class FedAlgorithm(abc.ABC):
             )
 
         return chunked
+
+    def _train_selected_weighted(
+        self, client_update, global_params, mask, sel_idx, round_idx,
+        round_key, x_train, y_train, n_train,
+    ):
+        """Shared round body for global-model algorithms (FedAvg,
+        SalientGrads): gather the selected clients' shards, broadcast the
+        global model (and mask) along the client axis, run vmapped local
+        SGD, and return the sample-weighted average + mean loss
+        (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227)."""
+        from ..core.state import (
+            broadcast_tree,
+            weighted_tree_sum,
+            zeros_like_tree,
+        )
+
+        n_sel = jnp.take(n_train, sel_idx)
+        x_sel = jnp.take(x_train, sel_idx, axis=0)
+        y_sel = jnp.take(y_train, sel_idx, axis=0)
+        s = sel_idx.shape[0]
+        params0 = broadcast_tree(global_params, s)
+        mask_b = broadcast_tree(mask, s)
+        mom0 = zeros_like_tree(params0)
+        keys = jax.random.split(round_key, s)
+        params_out, _, losses = self._vmap_clients(
+            client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
+        )(params0, mom0, mask_b, keys, x_sel, y_sel, n_sel, round_idx)
+        weights = n_sel.astype(jnp.float32)
+        weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
+        new_global = weighted_tree_sum(params_out, weights)
+        return new_global, jnp.mean(losses)
 
     def _make_global_eval(self):
         eval_client = self.eval_client

@@ -15,11 +15,6 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..core.state import (
-    broadcast_tree,
-    weighted_tree_sum,
-    zeros_like_tree,
-)
 from ..core.trainer import make_client_update
 from ..models import init_params
 from .base import FedAlgorithm, sample_client_indexes
@@ -43,21 +38,12 @@ class FedAvg(FedAlgorithm):
         def round_fn(state: FedAvgState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            n_sel = jnp.take(n_train, sel_idx)
-            x_sel = jnp.take(x_train, sel_idx, axis=0)
-            y_sel = jnp.take(y_train, sel_idx, axis=0)
-            s = sel_idx.shape[0]
-            params0 = broadcast_tree(state.global_params, s)
-            mom0 = zeros_like_tree(params0)
-            mask = params0  # unused (dense path); DCE'd by XLA
-            keys = jax.random.split(round_key, s)
-            params_out, _, losses = self._vmap_clients(
-                self.client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
-            )(params0, mom0, mask, keys, x_sel, y_sel, n_sel, round_idx)
-            weights = n_sel.astype(jnp.float32)
-            weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
-            new_global = weighted_tree_sum(params_out, weights)
-            return FedAvgState(global_params=new_global, rng=rng), jnp.mean(losses)
+            new_global, mean_loss = self._train_selected_weighted(
+                self.client_update, state.global_params,
+                state.global_params,  # dense path: mask unused, DCE'd
+                sel_idx, round_idx, round_key, x_train, y_train, n_train,
+            )
+            return FedAvgState(global_params=new_global, rng=rng), mean_loss
 
         self._round_jit = jax.jit(round_fn)
         self._eval_global = self._make_global_eval()

@@ -1,0 +1,124 @@
+"""SalientGrads — the flagship algorithm: SNIP-masked sparse federated
+training on site-partitioned neuroimaging data.
+
+Re-design of ``fedml_api/standalone/sailentgrads/sailentgrads_api.py``:
+  1. Before round 0, every client computes SNIP saliency scores on its own
+     shard (itersnip iterations, ``client.py:29-50``), the server averages
+     them (``snip.py:120-140``) and thresholds a single *global* mask at
+     ``dense_ratio`` (``snip.py:80-116``, via ``sailentgrads_api.py:47-66``).
+  2. Then FedAvg rounds where every local SGD step re-masks the weights
+     (``my_model_trainer.py:213-216``) and aggregation is the
+     sample-weighted mean (``sailentgrads_api.py:212-227``).
+
+Here the scoring pass is a vmapped ``jax.grad`` w.r.t. an all-ones mask
+multiplier (mean over clients = the "saliency psum"), and the training round
+is the same single jitted SPMD program as FedAvg with the mask broadcast
+along the client axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core.state import broadcast_tree
+from ..core.trainer import make_client_update
+from ..models import init_params
+from ..ops.sparsity import make_snip_score_fn, mask_density, mask_from_scores
+from .base import FedAlgorithm, sample_client_indexes
+
+
+@struct.dataclass
+class SalientGradsState:
+    global_params: Any
+    mask: Any
+    rng: jax.Array
+
+
+class SalientGrads(FedAlgorithm):
+    name = "salientgrads"
+
+    def __init__(self, *args, dense_ratio: float = 0.5,
+                 itersnip_iterations: int = 1, **kwargs):
+        self.dense_ratio = dense_ratio
+        self.itersnip_iterations = itersnip_iterations
+        super().__init__(*args, **kwargs)
+
+    def _build(self) -> None:
+        self.client_update = make_client_update(
+            self.apply_fn, self.loss_type, self.hp,
+            mask_grads=False, mask_params_post_step=True,
+        )
+        self.snip_scores = make_snip_score_fn(
+            self.apply_fn, self.loss_type, self.hp.batch_size
+        )
+
+        def global_mask_fn(params, x_train, y_train, n_train, rng):
+            """All clients score their own shards; mean; global top-k."""
+            c = x_train.shape[0]
+            keys = jax.random.split(rng, c)
+            params_b = broadcast_tree(params, c)
+            scores = self._vmap_clients(
+                lambda p, x, y, n, k: self.snip_scores(
+                    p, x, y, n, k, self.itersnip_iterations
+                ),
+                in_axes=(0, 0, 0, 0, 0),
+            )(params_b, x_train, y_train, n_train, keys)
+            # server-side mean over clients (snip.py:120-140)
+            mean_scores = jax.tree_util.tree_map(
+                lambda s: jnp.mean(s, axis=0), scores
+            )
+            return mask_from_scores(mean_scores, self.dense_ratio)
+
+        self._global_mask_jit = jax.jit(global_mask_fn)
+
+        def round_fn(state: SalientGradsState, sel_idx, round_idx,
+                     x_train, y_train, n_train):
+            rng, round_key = jax.random.split(state.rng)
+            new_global, mean_loss = self._train_selected_weighted(
+                self.client_update, state.global_params, state.mask,
+                sel_idx, round_idx, round_key, x_train, y_train, n_train,
+            )
+            return (
+                SalientGradsState(global_params=new_global, mask=state.mask,
+                                  rng=rng),
+                mean_loss,
+            )
+
+        self._round_jit = jax.jit(round_fn)
+        self._eval_global = self._make_global_eval()
+
+    def init_state(self, rng: jax.Array) -> SalientGradsState:
+        p_rng, m_rng, s_rng = jax.random.split(rng, 3)
+        params = init_params(self.model, p_rng, self.data.sample_shape)
+        mask = self._global_mask_jit(
+            params, self.data.x_train, self.data.y_train, self.data.n_train,
+            m_rng,
+        )
+        return SalientGradsState(global_params=params, mask=mask, rng=s_rng)
+
+    def run_round(self, state: SalientGradsState, round_idx: int):
+        sel = sample_client_indexes(
+            round_idx, self.num_clients, self.clients_per_round
+        )
+        state, loss = self._round_jit(
+            state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
+            self.data.x_train, self.data.y_train, self.data.n_train,
+        )
+        return state, {"train_loss": loss}
+
+    def evaluate(self, state: SalientGradsState) -> Dict[str, Any]:
+        # evaluate the masked global model, as the reference does (the
+        # aggregate of masked locals is already masked; assert via density)
+        ev = self._eval_global(
+            state.global_params, self.data.x_test, self.data.y_test,
+            self.data.n_test,
+        )
+        return {
+            "global_acc": ev["acc"],
+            "global_loss": ev["loss"],
+            "mask_density": mask_density(state.mask),
+            "acc_per_client": ev["acc_per_client"],
+        }

@@ -1,0 +1,21 @@
+from .sparsity import (
+    erk_sparsities,
+    fire_mask,
+    kernel_flags,
+    make_snip_score_fn,
+    mask_density,
+    mask_from_scores,
+    random_masks_from_sparsities,
+    regrow_mask,
+)
+
+__all__ = [
+    "erk_sparsities",
+    "fire_mask",
+    "kernel_flags",
+    "make_snip_score_fn",
+    "mask_density",
+    "mask_from_scores",
+    "random_masks_from_sparsities",
+    "regrow_mask",
+]

@@ -1,0 +1,167 @@
+"""Sparsity engine tests: SNIP identity, global mask, ERK, fire/regrow."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.losses import make_loss_fn
+from neuroimagedisttraining_tpu.models import (
+    create_model,
+    init_params,
+    make_apply_fn,
+)
+from neuroimagedisttraining_tpu.ops.sparsity import (
+    cosine_annealing,
+    erk_sparsities,
+    fire_mask,
+    kernel_flags,
+    live_counts,
+    make_snip_score_fn,
+    mask_density,
+    mask_from_scores,
+    param_shapes,
+    random_masks_from_sparsities,
+    regrow_mask,
+)
+
+
+def _toy():
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    return model, params, make_apply_fn(model)
+
+
+def test_snip_scores_equal_weight_times_grad():
+    """dL/dmask at mask=1 must equal |w * dL/dw| on kernel leaves —
+    the identity behind the reference's monkey-patch trick (snip.py:9-74)."""
+    model, params, apply_fn = _toy()
+    loss_fn = make_loss_fn("bce")
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 8, 8, 1))
+    y = jnp.array([0, 1, 1, 0])
+    rng = jax.random.PRNGKey(2)
+
+    snip = make_snip_score_fn(apply_fn, "bce", batch_size=4)
+    # one iteration over the whole 4-sample shard == one full batch
+    scores = snip(params, x, y, jnp.int32(4), rng, 1)
+
+    # manual: |w * dL/dw| — but note the batch the scorer sampled is random
+    # with replacement; use the same trick by scoring a fixed batch directly
+    def batch_loss(p):
+        return loss_fn(apply_fn(p, x, train=True, rng=rng), y)
+
+    grads = jax.grad(batch_loss)(params)
+    flags = kernel_flags(params)
+
+    # check on a fixed batch via the internal scorer path: recompute scores
+    # with n_valid=4 and batch drawn from the 4 identical samples is not
+    # deterministic; instead verify the identity directly:
+    def loss_of_mask(m):
+        masked = jax.tree_util.tree_map(
+            lambda p, mm, k: p * mm if k else p, params, m, flags
+        )
+        return loss_fn(apply_fn(masked, x, train=True, rng=rng), y)
+
+    mask_grad = jax.grad(loss_of_mask)(
+        jax.tree_util.tree_map(jnp.ones_like, params)
+    )
+    for (path, mg), g, k in zip(
+        jax.tree_util.tree_flatten_with_path(mask_grad)[0],
+        jax.tree_util.tree_leaves(grads),
+        jax.tree_util.tree_leaves(flags),
+    ):
+        if k:
+            assert np.allclose(mg, g * _leaf(params, path), rtol=1e-4, atol=1e-6)
+
+
+def _leaf(tree, path):
+    for p in path:
+        key = getattr(p, "key", getattr(p, "name", None))
+        tree = tree[key]
+    return tree
+
+
+def test_mask_from_scores_density_and_ones_elsewhere():
+    _, params, _ = _toy()
+    scores = jax.tree_util.tree_map(
+        lambda p: jax.random.uniform(jax.random.PRNGKey(3), p.shape), params
+    )
+    mask = mask_from_scores(scores, keep_ratio=0.3)
+    d = float(mask_density(mask))
+    assert abs(d - 0.3) < 0.02, d
+    # non-kernel leaves all ones
+    flags = kernel_flags(params)
+    for m, k in zip(jax.tree_util.tree_leaves(mask),
+                    jax.tree_util.tree_leaves(flags)):
+        if not k:
+            assert np.all(np.asarray(m) == 1.0)
+
+
+def test_erk_allocation_budget():
+    shapes = {
+        "conv1": (3, 3, 3, 1, 8),
+        "conv2": (3, 3, 3, 8, 16),
+        "dense": (16, 1),
+    }
+    sp = erk_sparsities(shapes, dense_ratio=0.5)
+    total = sum(np.prod(s) for s in shapes.values())
+    kept = sum((1 - sp[n]) * np.prod(s) for n, s in shapes.items())
+    assert abs(kept / total - 0.5) < 0.05
+    assert all(0.0 <= v < 1.0 for v in sp.values())
+
+
+def test_random_masks_respect_sparsities():
+    _, params, _ = _toy()
+    shapes = param_shapes(params)
+    sp = erk_sparsities(shapes, dense_ratio=0.4)
+    mask = random_masks_from_sparsities(
+        params, lambda name, shape: sp[name], jax.random.PRNGKey(0)
+    )
+    d = float(mask_density(mask))
+    assert abs(d - 0.4) < 0.05, d
+
+
+def test_fire_regrow_preserves_live_counts():
+    _, params, _ = _toy()
+    shapes = param_shapes(params)
+    sp = erk_sparsities(shapes, dense_ratio=0.5)
+    mask = random_masks_from_sparsities(
+        params, lambda n, s: sp[n], jax.random.PRNGKey(1)
+    )
+    before = live_counts(mask)
+    grads = jax.tree_util.tree_map(
+        lambda p: jax.random.normal(jax.random.PRNGKey(2), p.shape), params
+    )
+    drop_rate = cosine_annealing(0.5, 10, 100)
+
+    fired = fire_mask(mask, params, drop_rate)
+    n_regrow = jax.tree_util.tree_map(
+        lambda b, f: b - f, before, live_counts(fired)
+    )
+    regrown = regrow_mask(fired, grads, n_regrow)
+    after = live_counts(regrown)
+    flags = kernel_flags(mask)
+    for b, a, k in zip(jax.tree_util.tree_leaves(before),
+                       jax.tree_util.tree_leaves(after),
+                       jax.tree_util.tree_leaves(flags)):
+        if k:
+            # ties in |w| can make the count off by a few; stay close
+            assert abs(int(b) - int(a)) <= max(2, int(b) // 20), (int(b), int(a))
+
+
+def test_fire_regrow_jittable_with_traced_rate():
+    """Round-dependent drop rates must not trigger shape recompilation."""
+    _, params, _ = _toy()
+    mask = jax.tree_util.tree_map(jnp.ones_like, params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    @jax.jit
+    def evolve(mask, params, grads, round_idx):
+        rate = cosine_annealing(0.5, round_idx, 100)
+        before = live_counts(mask)
+        fired = fire_mask(mask, params, rate)
+        n = jax.tree_util.tree_map(lambda b, f: b - f, before,
+                                   live_counts(fired))
+        return regrow_mask(fired, grads, n)
+
+    m1 = evolve(mask, params, grads, jnp.float32(1))
+    m2 = evolve(mask, params, grads, jnp.float32(50))
+    assert jax.tree_util.tree_structure(m1) == jax.tree_util.tree_structure(m2)
