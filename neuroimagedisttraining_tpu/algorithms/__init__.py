@@ -1,5 +1,31 @@
 from .base import FedAlgorithm, sample_client_indexes
 from .fedavg import FedAvg
 from .salientgrads import SalientGrads
+from .dispfl import DisPFL
+from .subavg import SubAvg
+from .dpsgd import DPSGD
+from .ditto import Ditto
+from .fedfomo import FedFomo
+from .local_only import LocalOnly
+from .turboaggregate import TurboAggregate
 
-__all__ = ["FedAlgorithm", "FedAvg", "SalientGrads", "sample_client_indexes"]
+ALGORITHMS = {
+    a.name: a
+    for a in (FedAvg, SalientGrads, DisPFL, SubAvg, DPSGD, Ditto, FedFomo,
+              LocalOnly, TurboAggregate)
+}
+
+__all__ = [
+    "ALGORITHMS",
+    "DPSGD",
+    "DisPFL",
+    "Ditto",
+    "FedAlgorithm",
+    "FedAvg",
+    "FedFomo",
+    "LocalOnly",
+    "SalientGrads",
+    "SubAvg",
+    "TurboAggregate",
+    "sample_client_indexes",
+]

@@ -148,12 +148,13 @@ class FedAlgorithm(abc.ABC):
 
     def _train_selected_weighted(
         self, client_update, global_params, mask, sel_idx, round_idx,
-        round_key, x_train, y_train, n_train,
+        round_key, x_train, y_train, n_train, defense=None,
     ):
         """Shared round body for global-model algorithms (FedAvg,
         SalientGrads): gather the selected clients' shards, broadcast the
         global model (and mask) along the client axis, run vmapped local
-        SGD, and return the sample-weighted average + mean loss
+        SGD, optionally apply a robust-aggregation defense to the local
+        models, and return the sample-weighted average + mean loss
         (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227)."""
         from ..core.state import (
             broadcast_tree,
@@ -168,14 +169,35 @@ class FedAlgorithm(abc.ABC):
         params0 = broadcast_tree(global_params, s)
         mask_b = broadcast_tree(mask, s)
         mom0 = zeros_like_tree(params0)
-        keys = jax.random.split(round_key, s)
+        keys = jax.random.split(round_key, s + 1)
         params_out, _, losses = self._vmap_clients(
-            client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
-        )(params0, mom0, mask_b, keys, x_sel, y_sel, n_sel, round_idx)
+            client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
+        )(params0, mom0, mask_b, keys[:s], x_sel, y_sel, n_sel, round_idx,
+          params0)
+        if defense is not None:
+            params_out = defense.apply(params_out, global_params, keys[s])
         weights = n_sel.astype(jnp.float32)
         weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
         new_global = weighted_tree_sum(params_out, weights)
         return new_global, jnp.mean(losses)
+
+    def _train_stacked(self, client_update, params_stack, mask_stack,
+                       round_idx, round_key, x, y, n, prox_target=None):
+        """Every client trains its own stacked state on its own shard —
+        the whole-cohort local-training pass used by the decentralized /
+        personalized algorithms (DisPFL, DPSGD, FedFomo, Local, Ditto's
+        personal leg). Returns (params_stack, momentum_stack, losses[C])."""
+        from ..core.state import zeros_like_tree
+
+        c = jax.tree_util.tree_leaves(params_stack)[0].shape[0]
+        keys = jax.random.split(round_key, c)
+        mom0 = zeros_like_tree(params_stack)
+        if prox_target is None:
+            prox_target = params_stack
+        return self._vmap_clients(
+            client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
+        )(params_stack, mom0, mask_stack, keys, x, y, n, round_idx,
+          prox_target)
 
     def _make_global_eval(self):
         eval_client = self.eval_client

@@ -1,0 +1,237 @@
+"""DisPFL — decentralized sparse personalized FL (CVPR'22).
+
+Re-design of ``fedml_api/standalone/DisPFL/dispfl_api.py:46-184``:
+  * per-client random masks at ERK-allocated layer sparsities
+    (``my_model_trainer.py:28-38,40-114``)
+  * per round: client dropout coin-flips (``--active``, :96), neighbor
+    choice random/ring/full (``_benefit_choose`` :196-220),
+    count-mask-weighted aggregation of neighbors' sparse personal models
+    re-masked by the local mask (``_aggregate_func`` :222-240),
+    masked-gradient local SGD (trainer :147-172), then mask evolution:
+    screen one dense gradient batch (:128-144), cosine-annealed magnitude
+    fire + gradient-magnitude regrow (``client.py:71-99``)
+  * mask hamming-distance tracking (``slim_util.py:14-19``).
+
+TPU-native: masks and personal models are [C, ...] stacked pytrees; the
+count-mask aggregation is two adjacency contractions (weights and mask
+counts) + a safe reciprocal — all inside one jitted round program. Inactive
+clients keep their previous state via a select, preserving the reference's
+dropout-simulation semantics without host branching.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core.losses import make_loss_fn
+from ..core.state import broadcast_tree, mix_over_clients
+from ..core.trainer import make_client_update
+from ..models import init_params
+from ..ops.sparsity import (
+    cosine_annealing,
+    erk_sparsities,
+    fire_mask,
+    kernel_flags,
+    live_counts,
+    mask_density,
+    param_shapes,
+    random_masks_from_sparsities,
+    regrow_mask,
+)
+from ..parallel.topology import neighbor_adjacency
+from .base import FedAlgorithm
+
+
+@struct.dataclass
+class DisPFLState:
+    personal_params: Any  # [C, ...] sparse personal models
+    masks: Any            # [C, ...] personal masks
+    rng: jax.Array
+
+
+class DisPFL(FedAlgorithm):
+    name = "dispfl"
+
+    def __init__(self, *args, dense_ratio: float = 0.5,
+                 anneal_factor: float = 0.5, neighbor_mode: str = "random",
+                 active: float = 1.0, static_masks: bool = False,
+                 total_rounds: int = 100, erk_power_scale: float = 1.0,
+                 **kwargs):
+        self.dense_ratio = dense_ratio
+        self.anneal_factor = anneal_factor
+        self.neighbor_mode = neighbor_mode
+        self.active = active
+        self.static_masks = static_masks
+        self.total_rounds = total_rounds
+        self.erk_power_scale = erk_power_scale
+        super().__init__(*args, **kwargs)
+
+    def _build(self) -> None:
+        self.client_update = make_client_update(
+            self.apply_fn, self.loss_type, self.hp,
+            mask_grads=True, mask_params_post_step=True,
+        )
+        loss_fn = make_loss_fn(self.loss_type)
+
+        def screen_gradients(params, x, y, n_valid, rng):
+            """One dense-batch gradient for regrow scoring
+            (DisPFL/my_model_trainer.py:128-144)."""
+            k_idx, k_drop = jax.random.split(rng)
+            idx = jax.random.randint(
+                k_idx, (self.hp.batch_size,), 0, jnp.maximum(n_valid, 1)
+            )
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            return jax.grad(
+                lambda p: loss_fn(self.apply_fn(p, xb, train=True,
+                                                rng=k_drop), yb)
+            )(params)
+
+        def round_fn(state: DisPFLState, adjacency, active_vec, round_idx,
+                     x_train, y_train, n_train):
+            rng, k_train, k_screen = jax.random.split(state.rng, 3)
+            params, masks = state.personal_params, state.masks
+
+            # --- count-mask-weighted neighbor aggregation (:222-240) ------
+            counts = mix_over_clients(adjacency, masks)
+            inv = jax.tree_util.tree_map(
+                lambda c: jnp.where(c != 0, 1.0 / jnp.maximum(c, 1e-9), 0.0),
+                counts,
+            )
+            sums = mix_over_clients(adjacency, params)
+            consensus = jax.tree_util.tree_map(jnp.multiply, sums, inv)
+            w_agg = jax.tree_util.tree_map(jnp.multiply, consensus, masks)
+
+            # inactive clients skip ONLY the aggregation — they still train
+            # from their own previous personal model and evolve their masks
+            # (dispfl_api.py:105-142: w_local falls back to the lstrd copy,
+            # client.train runs unconditionally)
+            def pick_active(agg, own):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(
+                        active_vec.reshape((-1,) + (1,) * (a.ndim - 1)) > 0,
+                        a, b,
+                    ),
+                    agg, own,
+                )
+
+            w_local = pick_active(w_agg, params)
+
+            # --- masked local SGD ----------------------------------------
+            trained, _, losses = self._train_stacked(
+                self.client_update, w_local, masks, round_idx, k_train,
+                x_train, y_train, n_train,
+            )
+
+            # --- mask evolution (fire/regrow, client.py:55-99) -----------
+            if self.static_masks:
+                new_masks = masks
+            else:
+                c = x_train.shape[0]
+                keys = jax.random.split(k_screen, c)
+                grads = self._vmap_clients(
+                    screen_gradients, in_axes=(0, 0, 0, 0, 0)
+                )(trained, x_train, y_train, n_train, keys)
+                rate = cosine_annealing(
+                    self.anneal_factor, round_idx, self.total_rounds
+                )
+                before = jax.vmap(live_counts)(masks)  # per-client counts
+                fired = jax.vmap(partial(fire_mask, drop_rate=rate))(
+                    masks, trained
+                )
+                n_regrow = jax.tree_util.tree_map(
+                    lambda b, f: b - f, before, jax.vmap(live_counts)(fired)
+                )
+                new_masks = jax.vmap(regrow_mask)(fired, grads, n_regrow)
+                trained = jax.tree_util.tree_map(
+                    jnp.multiply, trained, new_masks
+                )
+
+            # mask-change tracking (hamming fraction, slim_util.py:14-19)
+            ham = _hamming_fraction(masks, new_masks)
+            return (
+                DisPFLState(personal_params=trained, masks=new_masks,
+                            rng=rng),
+                jnp.mean(losses), ham,
+            )
+
+        self._round_jit = jax.jit(round_fn)
+        self._eval_personal = self._make_personal_eval()
+
+    def init_state(self, rng: jax.Array) -> DisPFLState:
+        p_rng, m_rng, s_rng = jax.random.split(rng, 3)
+        params = init_params(self.model, p_rng, self.data.sample_shape)
+        shapes = param_shapes(params)
+        sp = erk_sparsities(shapes, self.dense_ratio, self.erk_power_scale)
+        mask_keys = jax.random.split(m_rng, self.num_clients)
+        masks = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[
+                random_masks_from_sparsities(
+                    params, lambda n, s: sp[n], mask_keys[i]
+                )
+                for i in range(self.num_clients)
+            ],
+        )
+        stacked = broadcast_tree(params, self.num_clients)
+        personal = jax.tree_util.tree_map(jnp.multiply, stacked, masks)
+        return DisPFLState(personal_params=personal, masks=masks, rng=s_rng)
+
+    def run_round(self, state: DisPFLState, round_idx: int):
+        np.random.seed(round_idx)
+        active_vec = np.random.choice(
+            [0, 1], size=self.num_clients,
+            p=[1.0 - self.active, self.active],
+        )
+        adj = neighbor_adjacency(
+            round_idx, self.num_clients, self.clients_per_round,
+            mode=self.neighbor_mode, active=active_vec,
+        )
+        state, loss, ham = self._round_jit(
+            state, jnp.asarray(adj), jnp.asarray(active_vec),
+            jnp.asarray(round_idx, jnp.float32),
+            self.data.x_train, self.data.y_train, self.data.n_train,
+        )
+        return state, {"train_loss": loss, "mask_change": ham}
+
+    def evaluate(self, state: DisPFLState) -> Dict[str, Any]:
+        ev = self._eval_personal(
+            state.personal_params, self.data.x_test, self.data.y_test,
+            self.data.n_test,
+        )
+        dens = jax.vmap(mask_density)(state.masks)
+        return {
+            "personal_acc": ev["acc"], "personal_loss": ev["loss"],
+            "mean_mask_density": jnp.mean(dens),
+            "acc_per_client": ev["acc_per_client"],
+        }
+
+    def mask_distance_matrix(self, state: DisPFLState) -> np.ndarray:
+        """Pairwise hamming-fraction matrix over client masks — the end-of-
+        run diagnostic the reference stores (dispfl_api.py:170-175)."""
+        flat = jnp.concatenate([
+            m.reshape(m.shape[0], -1)
+            for m, k in zip(jax.tree_util.tree_leaves(state.masks),
+                            jax.tree_util.tree_leaves(
+                                kernel_flags(state.masks)))
+            if k
+        ], axis=1)
+        a = (flat != 0).astype(jnp.float32)
+        return np.asarray(
+            jnp.mean(jnp.abs(a[:, None, :] - a[None, :, :]), axis=-1)
+        )
+
+
+def _hamming_fraction(masks_a: Any, masks_b: Any) -> jax.Array:
+    num = sum(
+        jnp.sum((a != 0) != (b != 0))
+        for a, b in zip(jax.tree_util.tree_leaves(masks_a),
+                        jax.tree_util.tree_leaves(masks_b))
+    )
+    tot = sum(a.size for a in jax.tree_util.tree_leaves(masks_a))
+    return num / tot

@@ -29,6 +29,11 @@ class FedAvgState:
 class FedAvg(FedAlgorithm):
     name = "fedavg"
 
+    def __init__(self, *args, defense=None, **kwargs):
+        # optional robust.RobustAggregator (fedml_core/robustness wiring)
+        self.defense = defense
+        super().__init__(*args, **kwargs)
+
     def _build(self) -> None:
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
@@ -42,6 +47,7 @@ class FedAvg(FedAlgorithm):
                 self.client_update, state.global_params,
                 state.global_params,  # dense path: mask unused, DCE'd
                 sel_idx, round_idx, round_key, x_train, y_train, n_train,
+                defense=self.defense,
             )
             return FedAvgState(global_params=new_global, rng=rng), mean_loss
 

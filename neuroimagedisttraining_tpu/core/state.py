@@ -102,3 +102,19 @@ def weighted_tree_sum(tree: Any, weights: jax.Array) -> Any:
     return jax.tree_util.tree_map(
         lambda x: jnp.tensordot(weights.astype(x.dtype), x, axes=1), tree
     )
+
+
+def mix_over_clients(mix_matrix: jax.Array, stacked: Any) -> Any:
+    """Contract a [C, C] mixing/adjacency matrix against the leading client
+    axis of every leaf: out_i = sum_j A[i, j] * leaf_j.
+
+    This is the TPU-native form of gossip aggregation — the reference loops
+    over neighbor state_dicts per client (``dpsgd_api.py:169-178``,
+    ``dispfl_api.py:222-240``); here one contraction covers the whole cohort
+    and XLA turns it into all-gather + local GEMM over ICI when the client
+    axis is sharded.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.tensordot(mix_matrix.astype(x.dtype), x, axes=1),
+        stacked,
+    )

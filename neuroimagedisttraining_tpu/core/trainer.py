@@ -36,6 +36,7 @@ def make_client_update(
     hp: HyperParams,
     mask_grads: bool = False,
     mask_params_post_step: bool = True,
+    prox_lambda: float = 0.0,
 ):
     """Build the per-client local-training function.
 
@@ -43,10 +44,13 @@ def make_client_update(
     masked SGD, ``DisPFL/my_model_trainer.py:147-172``).
     ``mask_params_post_step``: multiply params by mask after each optimizer
     step (SalientGrads, ``my_model_trainer.py:213-216``).
+    ``prox_lambda``: Ditto's personalization pull — after each step,
+    ``w -= lr * lambda * (w - w_global)`` (``ditto/my_model_trainer.py:63-64``).
 
     Returns ``client_update(params, momentum, mask, rng, x, y, n_valid,
-    round_idx) -> (params, momentum, mean_loss)``; vmap over a leading client
-    axis on (params, momentum, mask, rng, x, y, n_valid).
+    round_idx, prox_target) -> (params, momentum, mean_loss)``; vmap over a
+    leading client axis on everything except ``round_idx``. ``prox_target``
+    is ignored (and DCE'd) unless ``prox_lambda > 0``.
     """
     loss_fn = make_loss_fn(loss_type)
 
@@ -56,7 +60,8 @@ def make_client_update(
 
     grad_fn = jax.value_and_grad(batch_loss)
 
-    def client_update(params, momentum, mask, rng, x, y, n_valid, round_idx):
+    def client_update(params, momentum, mask, rng, x, y, n_valid, round_idx,
+                      prox_target):
         lr = hp.lr * jnp.power(hp.lr_decay, round_idx.astype(jnp.float32))
 
         def step(carry, key):
@@ -73,6 +78,11 @@ def make_client_update(
             params, momentum = sgd_momentum_step(
                 params, momentum, grads, lr, hp.momentum, hp.weight_decay
             )
+            if prox_lambda:
+                params = jax.tree_util.tree_map(
+                    lambda p, g: p - lr.astype(p.dtype) * prox_lambda * (p - g),
+                    params, prox_target,
+                )
             if mask_params_post_step:
                 params = jax.tree_util.tree_map(lambda p, m: p * m, params, mask)
             return (params, momentum), loss

@@ -21,6 +21,7 @@ def make_synthetic_federated(
     n_clients: int = 8,
     samples_per_client: int = 24,
     test_per_client: int = 8,
+    val_per_client: int = 0,
     sample_shape: Tuple[int, ...] = (8, 8, 8, 1),
     class_num: int = 2,
     loss_type: str = "bce",
@@ -38,11 +39,13 @@ def make_synthetic_federated(
     probe /= np.sqrt(np.mean(probe**2))
 
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    xs_va, ys_va = [], []
     for c in range(n_clients):
         n_tr = samples_per_client + (rng.randint(0, samples_per_client // 2 + 1)
                                      if uneven else 0)
         n_te = test_per_client
-        n = n_tr + n_te
+        n_va = val_per_client
+        n = n_tr + n_te + n_va
         y = rng.randint(0, class_num, size=n)
         x = rng.randn(n, *sample_shape).astype(np.float32)
         x += site_shift * rng.randn()  # per-site intensity shift (non-IID)
@@ -51,15 +54,22 @@ def make_synthetic_federated(
         x += signal * coef[(...,) + (None,) * len(sample_shape)] * probe
         xs_tr.append(x[:n_tr])
         ys_tr.append(y[:n_tr])
-        xs_te.append(x[n_tr:])
-        ys_te.append(y[n_tr:])
+        xs_te.append(x[n_tr:n_tr + n_te])
+        ys_te.append(y[n_tr:n_tr + n_te])
+        xs_va.append(x[n_tr + n_te:])
+        ys_va.append(y[n_tr + n_te:])
 
     x_train, n_train = pad_stack(xs_tr)
     y_train, _ = pad_stack([y.astype(np.int32) for y in ys_tr])
     x_test, n_test = pad_stack(xs_te)
     y_test, _ = pad_stack([y.astype(np.int32) for y in ys_te])
+    kwargs = {}
+    if val_per_client:
+        x_val, n_val = pad_stack(xs_va)
+        y_val, _ = pad_stack([y.astype(np.int32) for y in ys_va])
+        kwargs = dict(x_val=x_val, y_val=y_val, n_val=n_val)
     return FederatedData(
         x_train=x_train, y_train=y_train, n_train=n_train,
         x_test=x_test, y_test=y_test, n_test=n_test,
-        class_num=class_num,
+        class_num=class_num, **kwargs,
     )

@@ -287,3 +287,39 @@ def regrow_mask(mask: Any, grads: Any, n_regrow_tree: Any) -> Any:
 def live_counts(mask: Any) -> Any:
     """Per-leaf live-weight counts (for fire->regrow count preservation)."""
     return jax.tree_util.tree_map(lambda m: jnp.sum(m != 0), mask)
+
+
+# ---------------------------------------------------------------------------
+# SubAvg iterative magnitude pruning
+# ---------------------------------------------------------------------------
+
+def magnitude_prune_mask(mask: Any, params: Any, prune_ratio) -> Any:
+    """SubAvg's ``fake_prune`` (``subavg/prune_func.py:9-30``): per kernel
+    leaf, threshold = the ``prune_ratio`` percentile of |w| over *alive*
+    weights; new mask zeroes entries with |w| < threshold. ``prune_ratio``
+    may be traced. Non-kernel leaves untouched."""
+    flags = kernel_flags(mask)
+
+    def leaf(m, p, k):
+        if not k:
+            return m
+        n_alive = jnp.sum(m != 0)
+        # nearest-rank percentile of alive |w| (reference uses np.percentile)
+        rank = jnp.ceil(prune_ratio * n_alive).astype(jnp.int32)
+        score = jnp.where(m != 0, jnp.abs(p), jnp.inf).reshape(-1)
+        thresh = _kth_smallest(score, jnp.maximum(rank, 1))
+        pruned = jnp.where(jnp.abs(p) < thresh, 0.0, m)
+        return jnp.where(n_alive > 0, pruned, m)
+
+    return jax.tree_util.tree_map(leaf, mask, params, flags)
+
+
+def mask_distance(mask_a: Any, mask_b: Any) -> jax.Array:
+    """Mean per-leaf hamming fraction between two masks
+    (``subavg/prune_func.py:52-66`` dist_masks)."""
+    fracs = [
+        jnp.mean(((a != 0) != (b != 0)).astype(jnp.float32))
+        for a, b in zip(jax.tree_util.tree_leaves(mask_a),
+                        jax.tree_util.tree_leaves(mask_b))
+    ]
+    return sum(fracs) / len(fracs)

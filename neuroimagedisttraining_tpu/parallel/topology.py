@@ -1,0 +1,148 @@
+"""Gossip topologies and per-round neighbor adjacency.
+
+TPU-native re-design of two reference subsystems:
+
+* Topology managers (``fedml_core/distributed/topology/``): weighted gossip
+  matrices built from Watts-Strogatz graphs with rewiring probability 0 —
+  i.e. deterministic ring lattices — symmetric
+  (``symmetric_topology_manager.py:16-78``: ring + k-nearest-neighbor links,
+  self-loops, row-normalized) and asymmetric
+  (``asymmetric_topology_manager.py:17-100``: symmetric base with randomly
+  dropped directed links). No networkx needed: ws(n, k, p=0) is the
+  circulant lattice.
+
+* Per-round neighbor choice (``DisPFL/dispfl_api.py:196-220`` /
+  ``dpsgd_api.py:116-139`` ``_benefit_choose``): random (excluding self),
+  ring, or full (active clients only); self is appended when participation
+  is partial.
+
+Downstream these become a dense [C, C] mixing matrix contracted against the
+client-stacked state pytree — on a sharded mesh XLA lowers that to
+all-gather/reduce collectives over ICI, the TPU analogue of the reference's
+per-edge message passing.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def ring_lattice(n: int, k: int) -> np.ndarray:
+    """Adjacency of the circulant lattice: each node linked to its k nearest
+    neighbors (k//2 on each side) — watts_strogatz_graph(n, k, 0)."""
+    a = np.zeros((n, n), dtype=np.float32)
+    half = max(1, k // 2)
+    for off in range(1, half + 1):
+        for i in range(n):
+            a[i, (i + off) % n] = 1.0
+            a[i, (i - off) % n] = 1.0
+    return a
+
+
+class SymmetricTopologyManager:
+    """Row-normalized symmetric gossip matrix: ring ∪ k-lattice + self-loops
+    (symmetric_topology_manager.py:21-52)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2):
+        self.n = n
+        self.neighbor_num = neighbor_num
+        self.topology: Optional[np.ndarray] = None
+
+    def generate_topology(self) -> np.ndarray:
+        a = np.maximum(ring_lattice(self.n, 2),
+                       ring_lattice(self.n, self.neighbor_num))
+        np.fill_diagonal(a, 1.0)
+        self.topology = a / a.sum(axis=1, keepdims=True)
+        return self.topology
+
+    def get_in_neighbor_weights(self, node_index: int):
+        if self.topology is None or node_index >= self.n:
+            return []
+        return self.topology[node_index]
+
+    def get_out_neighbor_weights(self, node_index: int):
+        if self.topology is None or node_index >= self.n:
+            return []
+        return self.topology[:, node_index]
+
+    def get_in_neighbor_idx_list(self, node_index: int):
+        return [
+            j for j in range(self.n)
+            if self.topology is not None and self.topology[node_index, j] > 0
+            and j != node_index
+        ]
+
+    get_out_neighbor_idx_list = get_in_neighbor_idx_list
+
+
+class AsymmetricTopologyManager:
+    """Directed gossip matrix: symmetric lattice with a fraction of directed
+    links randomly removed, then row-normalized
+    (asymmetric_topology_manager.py:17-100)."""
+
+    def __init__(self, n: int, undirected_neighbor_num: int = 4,
+                 out_directed_neighbor: int = 2, seed: int = 0):
+        self.n = n
+        self.undirected_neighbor_num = undirected_neighbor_num
+        self.out_directed_neighbor = out_directed_neighbor
+        self.seed = seed
+        self.topology: Optional[np.ndarray] = None
+
+    def generate_topology(self) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        a = np.maximum(ring_lattice(self.n, 2),
+                       ring_lattice(self.n, self.undirected_neighbor_num))
+        # randomly drop directed links beyond the ring until each row keeps
+        # about out_directed_neighbor extra out-links
+        ring = ring_lattice(self.n, 2)
+        for i in range(self.n):
+            extra = [j for j in range(self.n) if a[i, j] > 0 and ring[i, j] == 0]
+            rng.shuffle(extra)
+            for j in extra[self.out_directed_neighbor:]:
+                a[i, j] = 0.0
+        np.fill_diagonal(a, 1.0)
+        self.topology = a / a.sum(axis=1, keepdims=True)
+        return self.topology
+
+
+def neighbor_adjacency(
+    round_idx: int,
+    n_clients: int,
+    n_per_round: int,
+    mode: str = "random",
+    active: Optional[np.ndarray] = None,
+    seed_with_round: bool = True,
+) -> np.ndarray:
+    """Per-round 0/1 neighbor matrix A[i, j]=1 iff client i aggregates j.
+
+    Reproduces ``_benefit_choose`` semantics (dispfl_api.py:196-220):
+      * ``random``: each client draws ``n_per_round`` others uniformly
+        without replacement, excluding itself; self appended when
+        participation is partial.
+      * ``ring``: left and right neighbors + self.
+      * ``full``: all active clients.
+    Inactive clients (``active[i]==0``) get empty rows — the DisPFL client
+    dropout simulation (dispfl_api.py:96,105-142).
+    """
+    if active is None:
+        active = np.ones(n_clients, dtype=np.int64)
+    rng = np.random.RandomState(round_idx if seed_with_round else None)
+    a = np.zeros((n_clients, n_clients), dtype=np.float32)
+    full_participation = n_per_round >= n_clients
+    for i in range(n_clients):
+        if active[i] == 0:
+            continue
+        if mode == "full" or full_participation:
+            idx = np.where(active == 1)[0]
+        elif mode == "ring":
+            idx = np.array([(i - 1) % n_clients, (i + 1) % n_clients, i])
+        elif mode == "random":
+            others = np.delete(np.arange(n_clients), i)
+            idx = rng.choice(others, min(n_per_round, n_clients - 1),
+                             replace=False)
+            idx = np.append(idx, i)
+        else:
+            raise ValueError(f"unknown neighbor mode {mode!r}")
+        a[i, idx] = 1.0
+    return a

@@ -1,0 +1,13 @@
+from .aggregation import (
+    RobustAggregator,
+    add_gaussian_noise,
+    norm_diff_clipping,
+    vectorize_weights,
+)
+
+__all__ = [
+    "RobustAggregator",
+    "add_gaussian_noise",
+    "norm_diff_clipping",
+    "vectorize_weights",
+]

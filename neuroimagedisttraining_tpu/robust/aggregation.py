@@ -1,0 +1,82 @@
+"""Byzantine-robust aggregation defenses.
+
+Re-design of ``fedml_core/robustness/robust_aggregation.py``: norm-difference
+clipping (:38-50, ``diff / max(1, |diff|/bound)``) and weak-DP Gaussian noise
+(:52-55), as pure pytree functions vmappable over the client axis so the
+whole defense runs inside the jitted round program.
+
+The reference's ``is_weight_param`` filter (:28-29) exists to skip BN running
+stats; this framework uses GroupNorm (no running stats), so every parameter
+leaf participates — ``vectorize_weights`` keeps the name for parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def vectorize_weights(tree: Any) -> jax.Array:
+    """Flatten a parameter pytree into one vector
+    (robust_aggregation.py:4-9)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([x.reshape(-1) for x in leaves])
+
+
+def norm_diff_clipping(local: Any, global_: Any, norm_bound: float) -> Any:
+    """Clip the local-vs-global weight difference to ``norm_bound``
+    (robust_aggregation.py:38-50): w_g + diff/max(1, |diff|/bound)."""
+    diff = jax.tree_util.tree_map(lambda l, g: l - g, local, global_)
+    norm = jnp.sqrt(sum(
+        jnp.sum(jnp.square(d)) for d in jax.tree_util.tree_leaves(diff)
+    ))
+    scale = 1.0 / jnp.maximum(1.0, norm / norm_bound)
+    return jax.tree_util.tree_map(
+        lambda g, d: g + d * scale.astype(d.dtype), global_, diff
+    )
+
+
+def add_gaussian_noise(tree: Any, rng: jax.Array, stddev: float) -> Any:
+    """Weak-DP defense: additive Gaussian noise on every leaf
+    (robust_aggregation.py:52-55)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        x + stddev * jax.random.normal(k, x.shape, x.dtype)
+        for x, k in zip(leaves, keys)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+class RobustAggregator:
+    """Configurable defense applied to client updates before averaging
+    (robust_aggregation.py:32-55).
+
+    defense_type: "none" | "norm_diff_clipping" | "weak_dp"
+    (weak_dp = clipping + noise, as in the reference's pairing).
+    """
+
+    def __init__(self, defense_type: str = "none", norm_bound: float = 5.0,
+                 stddev: float = 0.025):
+        if defense_type not in ("none", "norm_diff_clipping", "weak_dp"):
+            raise ValueError(f"unknown defense type {defense_type!r}")
+        self.defense_type = defense_type
+        self.norm_bound = norm_bound
+        self.stddev = stddev
+
+    def apply(self, stacked_locals: Any, global_: Any,
+              rng: Optional[jax.Array]) -> Any:
+        """Defend a [C, ...]-stacked pytree of local models; jit-safe."""
+        if self.defense_type == "none":
+            return stacked_locals
+        clipped = jax.vmap(
+            lambda l: norm_diff_clipping(l, global_, self.norm_bound)
+        )(stacked_locals)
+        if self.defense_type == "norm_diff_clipping":
+            return clipped
+        c = jax.tree_util.tree_leaves(clipped)[0].shape[0]
+        keys = jax.random.split(rng, c)
+        return jax.vmap(
+            lambda l, k: add_gaussian_noise(l, k, self.stddev)
+        )(clipped, keys)

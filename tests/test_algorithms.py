@@ -1,0 +1,158 @@
+"""End-to-end smoke + learning tests for the full algorithm suite."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import (
+    DPSGD,
+    DisPFL,
+    Ditto,
+    FedFomo,
+    LocalOnly,
+    SubAvg,
+    TurboAggregate,
+)
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+
+
+def _data(val=0):
+    return make_synthetic_federated(
+        n_clients=8, samples_per_client=24, test_per_client=8,
+        val_per_client=val, sample_shape=(8, 8, 8, 1),
+    )
+
+
+def _hp(**kw):
+    base = dict(lr=0.05, lr_decay=1.0, momentum=0.9, local_epochs=1,
+                steps_per_epoch=4, batch_size=8)
+    base.update(kw)
+    return HyperParams(**base)
+
+
+def _model():
+    return create_model("small3dcnn", num_classes=1)
+
+
+def test_dpsgd_gossip_learns():
+    algo = DPSGD(_model(), _data(), _hp(), loss_type="bce", frac=0.5,
+                 seed=0, neighbor_mode="random")
+    state, hist = algo.run(comm_rounds=12, eval_every=0)
+    ev = algo.evaluate(state)
+    assert ev["personal_acc"] > 0.75, float(ev["personal_acc"])
+    assert np.isfinite(float(ev["global_acc"]))
+
+
+def test_dpsgd_ring_topology():
+    algo = DPSGD(_model(), _data(), _hp(), loss_type="bce", frac=0.25,
+                 seed=0, neighbor_mode="ring")
+    state, _ = algo.run(comm_rounds=3, eval_every=0)
+    assert np.isfinite(float(algo.evaluate(state)["personal_loss"]))
+
+
+def test_ditto_personal_beats_chance_and_global_updates():
+    algo = Ditto(_model(), _data(), _hp(), loss_type="bce", frac=1.0,
+                 seed=0, lamda=0.5)
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    state, hist = algo.run(comm_rounds=12, eval_every=0, state=s0)
+    ev = algo.evaluate(state)
+    assert ev["personal_acc"] > 0.75
+    assert ev["global_acc"] > 0.75
+    # personal models must have moved away from the global
+    d = sum(
+        float(jnp.sum(jnp.abs(p[0] - g)))
+        for p, g in zip(jax.tree_util.tree_leaves(state.personal_params),
+                        jax.tree_util.tree_leaves(state.global_params))
+    )
+    assert d > 0
+
+
+def test_local_only_no_communication():
+    algo = LocalOnly(_model(), _data(), _hp(), loss_type="bce", frac=1.0,
+                     seed=0)
+    state, _ = algo.run(comm_rounds=8, eval_every=0)
+    ev = algo.evaluate(state)
+    assert ev["personal_acc"] > 0.7
+    # clients diverge (no averaging): params differ across clients
+    total_diff = sum(
+        float(jnp.sum(jnp.abs(l[0] - l[1])))
+        for l in jax.tree_util.tree_leaves(state.personal_params)
+    )
+    assert total_diff > 1e-3, total_diff
+
+
+def test_dispfl_sparse_personal_learning():
+    algo = DisPFL(_model(), _data(), _hp(), loss_type="bce", frac=0.5,
+                  seed=0, dense_ratio=0.5, total_rounds=16)
+    state, hist = algo.run(comm_rounds=16, eval_every=0)
+    ev = algo.evaluate(state)
+    assert ev["personal_acc"] > 0.7, float(ev["personal_acc"])
+    d = float(ev["mean_mask_density"])
+    assert 0.35 < d < 0.65, d
+    # mask evolution happened
+    assert any(h["mask_change"] > 0 for h in hist)
+    m = algo.mask_distance_matrix(state)
+    assert m.shape == (8, 8) and np.allclose(np.diag(m), 0)
+
+
+def test_dispfl_client_dropout_skips_only_aggregation():
+    """Reference semantics (dispfl_api.py:105-142): an inactive client skips
+    the neighbor aggregation but still trains from its own previous model."""
+    algo = DisPFL(_model(), _data(), _hp(), loss_type="bce", frac=0.5,
+                  seed=0, active=0.0, static_masks=True)  # everyone drops
+    state0 = algo.init_state(jax.random.PRNGKey(0))
+    state1, _ = algo.run_round(state0, 0)
+    # params changed (training ran) ...
+    diff = sum(
+        float(jnp.sum(jnp.abs(a - b)))
+        for a, b in zip(jax.tree_util.tree_leaves(state0.personal_params),
+                        jax.tree_util.tree_leaves(state1.personal_params))
+    )
+    assert diff > 1e-3
+    # ... and dropped clients were NOT mixed with neighbors: re-running from
+    # the same state at a different round index changes only the adjacency
+    # (lr_decay=1 keeps lr fixed, active=0 zeroes every row anyway), so an
+    # all-inactive round must give identical results
+    state2a, _ = algo.run_round(state0, 1)
+    for a, b in zip(jax.tree_util.tree_leaves(state1.personal_params),
+                    jax.tree_util.tree_leaves(state2a.personal_params)):
+        assert np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_subavg_prunes_and_learns():
+    algo = SubAvg(_model(), _data(), _hp(local_epochs=2), loss_type="bce",
+                  frac=1.0, seed=0, each_prune_ratio=0.3, dist_thresh=0.0,
+                  acc_thresh=0.3, dense_ratio=0.1)
+    state, hist = algo.run(comm_rounds=6, eval_every=0)
+    ev = algo.evaluate(state)
+    assert ev["personal_acc"] > 0.7, float(ev["personal_acc"])
+    # masks should have pruned below 1.0 density
+    assert float(ev["mean_mask_density"]) < 0.999
+
+
+def test_fedfomo_requires_val_and_learns():
+    with pytest.raises(ValueError):
+        FedFomo(_model(), _data(val=0), _hp(), loss_type="bce", seed=0)
+    algo = FedFomo(_model(), _data(val=6), _hp(), loss_type="bce",
+                   frac=0.5, seed=0)
+    state, hist = algo.run(comm_rounds=12, eval_every=0)
+    ev = algo.evaluate(state)
+    # FedFomo mixes deltas convexly across neighbors, so individual progress
+    # is slower than FedAvg at equal rounds — above-chance is the bar here
+    assert ev["personal_acc"] > 0.6, float(ev["personal_acc"])
+    # p_choose accumulated
+    assert not np.allclose(np.asarray(state.p_choose),
+                           np.ones((8, 8)))
+
+
+def test_turboaggregate_secure_sum_matches_fedavg_math():
+    algo = TurboAggregate(_model(), _data(), _hp(), loss_type="bce",
+                          frac=1.0, seed=0, n_groups=3)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    state, m = algo.run_round(state, 0)
+    assert np.isfinite(float(m["train_loss"]))
+    state, hist = algo.run(comm_rounds=5, eval_every=0, state=state)
+    ev = algo.evaluate(state)
+    assert ev["global_acc"] > 0.75, float(ev["global_acc"])

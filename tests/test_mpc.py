@@ -1,0 +1,70 @@
+"""Finite-field MPC primitive tests (TurboAggregate support)."""
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.ops import mpc
+
+P = 2_147_483_647
+
+
+def test_mod_inverse():
+    for a in [1, 2, 12345, P - 1]:
+        assert (a * mpc.mod_inverse(a, P)) % P == 1
+    with pytest.raises(ZeroDivisionError):
+        mpc.mod_inverse(0, P)
+
+
+def test_lagrange_coeffs_interpolate_polynomial():
+    # f(x) = 3 + 5x + 2x^2 over F_p; interpolate through nodes, eval target
+    f = lambda x: (3 + 5 * x + 2 * x * x) % P
+    nodes = [1, 2, 3]
+    lam = mpc.lagrange_coeffs([10], nodes, P)[0]
+    got = sum(int(l) * f(b) for l, b in zip(lam, nodes)) % P
+    assert got == f(10)
+
+
+def test_shamir_share_reconstruct_roundtrip():
+    rng = np.random.RandomState(0)
+    secret = rng.randint(0, 1000, size=(4, 5))
+    shares = mpc.shamir_share(secret, n_shares=5, threshold=2, p=P, rng=rng)
+    assert shares.shape == (5, 4, 5)
+    # any 3 of 5 shares reconstruct
+    rec = mpc.shamir_reconstruct(shares[[0, 2, 4]], [0, 2, 4], P)
+    assert np.array_equal(rec, secret % P)
+    rec2 = mpc.shamir_reconstruct(shares[[1, 2, 3]], [1, 2, 3], P)
+    assert np.array_equal(rec2, secret % P)
+
+
+def test_lcc_encode_decode_identity():
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 1000, size=(6, 3))
+    enc = mpc.lcc_encode(x, n_workers=8, k_split=2, t_privacy=1, p=P, rng=rng)
+    assert enc.shape == (8, 3, 3)
+    # identity computation: decode from any K+T(=3)+... >= deg*(K+T-1)+1 workers
+    dec = mpc.lcc_decode(enc[[0, 1, 2, 3]], [0, 1, 2, 3], 8, 2, 1, P)
+    assert np.array_equal(dec.reshape(6, 3), x % P)
+
+
+def test_additive_shares_sum_and_hide():
+    rng = np.random.RandomState(2)
+    x = rng.randint(0, 1000, size=(7,))
+    shares = mpc.additive_shares(x, 4, P, rng)
+    assert shares.shape == (4, 7)
+    assert np.array_equal(np.mod(shares.sum(axis=0), P), x % P)
+    # individual shares look nothing like the secret
+    assert not np.array_equal(shares[0] % P, x % P)
+
+
+def test_dh_key_agreement():
+    g, p = 5, P
+    a_sk, b_sk = 123457, 987643
+    a_pk, b_pk = mpc.dh_keygen(a_sk, g, p), mpc.dh_keygen(b_sk, g, p)
+    assert mpc.dh_key_agreement(b_pk, a_sk, p) == mpc.dh_key_agreement(a_pk, b_sk, p)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.array([1.5, -2.25, 0.0, 1e-3])
+    q = mpc.quantize(x, 2 ** 16, P)
+    assert np.all(q >= 0)
+    back = mpc.dequantize(q, 2 ** 16, P)
+    assert np.allclose(back, x, atol=1e-4)

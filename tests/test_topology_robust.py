@@ -1,0 +1,118 @@
+"""Topology manager + robust aggregation tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import FedAvg
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.parallel.topology import (
+    AsymmetricTopologyManager,
+    SymmetricTopologyManager,
+    neighbor_adjacency,
+    ring_lattice,
+)
+from neuroimagedisttraining_tpu.robust import (
+    RobustAggregator,
+    add_gaussian_noise,
+    norm_diff_clipping,
+)
+
+
+def test_ring_lattice_shape():
+    a = ring_lattice(6, 2)
+    assert np.array_equal(a, a.T)
+    assert np.all(np.diag(a) == 0)
+    assert np.all(a.sum(axis=1) == 2)  # each node: left + right
+
+
+def test_symmetric_topology_row_normalized():
+    tm = SymmetricTopologyManager(8, neighbor_num=4)
+    t = tm.generate_topology()
+    assert np.allclose(t.sum(axis=1), 1.0)
+    assert np.all(np.diag(t) > 0)  # self-loops
+    # symmetric support
+    assert np.array_equal((t > 0), (t > 0).T)
+    assert len(tm.get_in_neighbor_weights(0)) == 8
+    assert tm.get_in_neighbor_weights(99) == []
+
+
+def test_asymmetric_topology_directed():
+    tm = AsymmetricTopologyManager(10, undirected_neighbor_num=6,
+                                   out_directed_neighbor=2, seed=0)
+    t = tm.generate_topology()
+    assert np.allclose(t.sum(axis=1), 1.0)
+    assert not np.array_equal((t > 0), (t > 0).T)  # some links dropped
+
+
+def test_neighbor_adjacency_modes():
+    a = neighbor_adjacency(0, 8, 3, mode="random")
+    assert np.all(np.diag(a) == 1)  # self appended
+    assert np.all(a.sum(axis=1) == 4)  # 3 neighbors + self
+    r = neighbor_adjacency(0, 8, 3, mode="ring")
+    assert np.all(r.sum(axis=1) == 3)  # left + right + self
+    active = np.array([1, 0, 1, 1, 0, 1, 1, 1])
+    f = neighbor_adjacency(0, 8, 8, mode="full", active=active)
+    assert np.all(f[1] == 0) and np.all(f[4] == 0)  # inactive rows empty
+    assert np.all(f[0][active == 1] == 1)
+    with pytest.raises(ValueError):
+        neighbor_adjacency(0, 4, 2, mode="banana")
+
+
+def test_norm_diff_clipping_semantics():
+    g = {"w": jnp.zeros((4,))}
+    local_near = {"w": jnp.full((4,), 0.1)}
+    local_far = {"w": jnp.full((4,), 100.0)}
+    # within bound: unchanged
+    out = norm_diff_clipping(local_near, g, norm_bound=5.0)
+    assert np.allclose(out["w"], 0.1)
+    # outside: diff scaled to the bound
+    out = norm_diff_clipping(local_far, g, norm_bound=5.0)
+    assert np.isclose(float(jnp.linalg.norm(out["w"])), 5.0, rtol=1e-5)
+
+
+def test_add_gaussian_noise_statistics():
+    t = {"w": jnp.zeros((10000,))}
+    out = add_gaussian_noise(t, jax.random.PRNGKey(0), stddev=0.1)
+    assert abs(float(out["w"].std()) - 0.1) < 0.01
+
+
+def test_robust_fedavg_survives_byzantine_client():
+    """A poisoned client (huge weights) must not destroy the global model
+    when norm-diff clipping is on."""
+    data = make_synthetic_federated(
+        n_clients=8, samples_per_client=24, test_per_client=8,
+        sample_shape=(8, 8, 8, 1),
+    )
+    # poison client 0's labels AND blow up its scale via crazy inputs
+    x = np.array(data.x_train)  # writable copy
+    x[0] = x[0] * 1e4
+    data = data.replace(x_train=jnp.asarray(x))
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, momentum=0.9, local_epochs=1,
+                     steps_per_epoch=4, batch_size=8)
+    defended = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                      defense=RobustAggregator("norm_diff_clipping",
+                                               norm_bound=2.0))
+    state, _ = defended.run(comm_rounds=6, eval_every=0)
+    ev = defended.evaluate(state)
+    assert np.isfinite(float(ev["global_loss"]))
+    assert ev["global_acc"] > 0.6, float(ev["global_acc"])
+
+
+def test_weak_dp_defense_runs():
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=12, test_per_client=4,
+        sample_shape=(8, 8, 8, 1),
+    )
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, local_epochs=1, steps_per_epoch=2, batch_size=4)
+    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                  defense=RobustAggregator("weak_dp", norm_bound=5.0,
+                                           stddev=0.001))
+    state, hist = algo.run(comm_rounds=2, eval_every=0)
+    assert np.isfinite(hist[-1]["train_loss"])
+    with pytest.raises(ValueError):
+        RobustAggregator("bad_defense")
