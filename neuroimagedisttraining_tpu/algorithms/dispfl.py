@@ -228,10 +228,16 @@ class DisPFL(FedAlgorithm):
 
 
 def _hamming_fraction(masks_a: Any, masks_b: Any) -> jax.Array:
+    # only kernel leaves evolve (fire/regrow gate on kernel_flags); counting
+    # bias/scale leaves in the denominator would dilute the metric
+    flags = jax.tree_util.tree_leaves(kernel_flags(masks_a))
     num = sum(
         jnp.sum((a != 0) != (b != 0))
-        for a, b in zip(jax.tree_util.tree_leaves(masks_a),
-                        jax.tree_util.tree_leaves(masks_b))
+        for a, b, k in zip(jax.tree_util.tree_leaves(masks_a),
+                           jax.tree_util.tree_leaves(masks_b), flags)
+        if k
     )
-    tot = sum(a.size for a in jax.tree_util.tree_leaves(masks_a))
+    tot = sum(a.size
+              for a, k in zip(jax.tree_util.tree_leaves(masks_a), flags)
+              if k)
     return num / tot

@@ -82,7 +82,15 @@ class FedFomo(FedAlgorithm):
             def client_round(i, js):
                 base = jax.tree_util.tree_map(lambda l: l[i], lstrd)
 
-                def per_neighbor(j):
+                # scan over neighbors, accumulating the positively-clipped
+                # weighted delta sum in the carry — normalization by the
+                # weight sum is linear, so dividing once at the end equals
+                # weighting by w/wsum per neighbor. Keeps exactly one
+                # neighbor delta live instead of a [K+1, |model|] stack
+                # (which at AlexNet3D scale would hold C*(K+1) model copies
+                # in HBM at once).
+                def per_neighbor(carry, j):
+                    acc, wsum = carry
                     model_j = jax.tree_util.tree_map(
                         lambda t, l: jnp.where(j == i, t[i], l[j]),
                         trained, lstrd,
@@ -100,20 +108,24 @@ class FedFomo(FedAlgorithm):
                         (self_loss[i] - l_j) / jnp.maximum(nrm, 1e-12),
                         0.0,
                     )
-                    return w, delta
+                    w_pos = jnp.maximum(w, 0.0)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, d: a + w_pos.astype(d.dtype) * d,
+                        acc, delta,
+                    )
+                    return (acc, wsum + w_pos), w
 
-                ws, deltas = jax.vmap(per_neighbor)(js)  # [K], [K, ...]
-                w_pos = jnp.maximum(ws, 0.0)
-                wsum = jnp.sum(w_pos)
-                summed = jax.tree_util.tree_map(
-                    lambda d: jnp.tensordot(
-                        (w_pos / jnp.maximum(wsum, 1e-12)).astype(d.dtype),
-                        d, axes=1,
-                    ),
-                    deltas,
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, base)
+                (acc, wsum), ws = jax.lax.scan(
+                    per_neighbor, (zeros, jnp.float32(0.0)), js
                 )
                 new_p = jax.tree_util.tree_map(
-                    lambda b, s_: jnp.where(wsum > 0, b + s_, b), base, summed
+                    lambda b, a: jnp.where(
+                        wsum > 0,
+                        b + a / jnp.maximum(wsum, 1e-12).astype(a.dtype),
+                        b,
+                    ),
+                    base, acc,
                 )
                 return new_p, ws
 

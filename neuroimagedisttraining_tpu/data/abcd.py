@@ -1,0 +1,207 @@
+"""ABCD neuroimaging data path: HDF5 cohort -> device-ready FederatedData.
+
+Rebuild of ``fedml_api/data_preprocessing/ABCD/data_loader.py``:
+
+* ``load_abcd_h5``            <- ``load_abcd_data`` (``data_loader.py:105-136``)
+  but *lazy per-site* instead of read-everything-into-RAM (SURVEY.md §7 memory
+  hard-part: the full 11.5k-subject cohort at 121x145x121 f32 is ~97 GB; we
+  read one site's rows at a time through h5py).
+* ``site_train_test_split``   <- the per-site 80/20 split with the fixed
+  seed-42 shuffle (``data_loader.py:67-102``, ``np.random.seed(42)`` before
+  every site's shuffle — reproduced exactly so convergence comparisons against
+  the reference see identical splits).
+* ``load_partition_data_abcd``          <- one client per site
+  (``data_loader.py:164-216``, hardcoded 21 sites there; dynamic here).
+* ``load_partition_data_abcd_rescale``  <- merge sites then contiguous equal
+  reshard to ``client_number`` (``data_loader.py:220-319``) — the entry
+  SalientGrads uses (``main_sailentgrads.py:135``).
+
+Instead of TensorDataset/DataLoader pairs, both entries return a single
+:class:`FederatedData` pytree (stacked [C, n_max, D, H, W, 1] arrays + valid
+counts) that ships to the TPU mesh once; batching happens on device inside the
+jitted round (``core/trainer.py``).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .types import FederatedData, pad_stack
+
+logger = logging.getLogger(__name__)
+
+ABCD_VOLUME_SHAPE = (121, 145, 121)  # data_loader.py:115-117
+ABCD_SPLIT_SEED = 42                 # data_loader.py:81
+ABCD_TEST_RATIO = 0.2                # data_loader.py:74
+
+
+def load_abcd_h5(path: str):
+    """Open the preprocessed cohort file ``final_dataset_<N>subs.h5``
+    (written by the preprocessing pipeline, see ``preprocess.py``) and return
+    ``(X, y, site)`` h5py datasets / arrays. ``X`` stays an h5py dataset so
+    callers can slice per site without loading the cohort."""
+    import h5py
+
+    f = h5py.File(path, "r")
+    return f["X"], np.asarray(f["y"][()]), np.asarray(f["site"][()])
+
+
+def site_train_test_split(
+    site: np.ndarray,
+    test_ratio: float = ABCD_TEST_RATIO,
+    seed: int = ABCD_SPLIT_SEED,
+) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+    """Per-site train/test index split with the reference's RNG contract:
+    the same fixed seed re-applied before each site's shuffle
+    (``data_loader.py:80-86``). Returns {site_value: (train_idx, test_idx)}."""
+    site = np.asarray(site).ravel()
+    out = {}
+    for s in np.unique(site):
+        idx = np.where(site == s)[0]
+        n_test = int(len(idx) * test_ratio)
+        n_train = len(idx) - n_test
+        np.random.seed(seed)
+        np.random.shuffle(idx)
+        out[int(s)] = (np.sort(idx[:n_train]), np.sort(idx[n_train:]))
+    return out
+
+
+def _gather_rows(X, idx: np.ndarray) -> np.ndarray:
+    """Read rows ``idx`` from an h5py dataset (or ndarray). h5py fancy
+    indexing requires strictly increasing indices — we sort, read, and the
+    row order within a client shard is irrelevant (batching reshuffles on
+    device)."""
+    idx = np.sort(np.asarray(idx))
+    if len(idx) == 0:
+        shape = (0,) + tuple(X.shape[1:])
+        return np.zeros(shape, dtype=np.float32)
+    return np.asarray(X[idx], dtype=np.float32)
+
+
+def _finalize(
+    xs_tr, ys_tr, xs_te, ys_te, val_fraction: float, seed: int,
+    normalize: bool,
+) -> FederatedData:
+    """Stack per-client splits into FederatedData; add channel axis; optional
+    per-volume standardization; optional val split carved from train (the
+    FedFomo 9-tuple variant, ``data_val_loader.py:275-326``)."""
+    def prep(x):
+        x = np.asarray(x, np.float32)
+        if x.ndim >= 2 and x.shape[-1] != 1:
+            x = x[..., None]  # NDHWC channel for conv kernels
+        if normalize and x.size:
+            flat = x.reshape(x.shape[0], -1)
+            mu = flat.mean(axis=1)
+            sd = flat.std(axis=1) + 1e-6
+            x = (x - mu[(...,) + (None,) * (x.ndim - 1)]) / \
+                sd[(...,) + (None,) * (x.ndim - 1)]
+        return x
+
+    xs_va, ys_va = [], []
+    if val_fraction > 0:
+        rng = np.random.RandomState(seed)
+        new_tr_x, new_tr_y = [], []
+        for x, y in zip(xs_tr, ys_tr):
+            n_val = int(len(y) * val_fraction)
+            perm = rng.permutation(len(y))
+            new_tr_x.append(x[perm[n_val:]])
+            new_tr_y.append(y[perm[n_val:]])
+            xs_va.append(x[perm[:n_val]])
+            ys_va.append(y[perm[:n_val]])
+        xs_tr, ys_tr = new_tr_x, new_tr_y
+
+    x_train, n_train = pad_stack([prep(x) for x in xs_tr])
+    y_train, _ = pad_stack([np.asarray(y, np.int32) for y in ys_tr])
+    x_test, n_test = pad_stack([prep(x) for x in xs_te])
+    y_test, _ = pad_stack([np.asarray(y, np.int32) for y in ys_te])
+    kwargs = {}
+    if val_fraction > 0:
+        x_val, n_val = pad_stack([prep(x) for x in xs_va])
+        y_val, _ = pad_stack([np.asarray(y, np.int32) for y in ys_va])
+        kwargs = dict(x_val=x_val, y_val=y_val, n_val=n_val)
+    return FederatedData(
+        x_train=x_train, y_train=y_train, n_train=n_train,
+        x_test=x_test, y_test=y_test, n_test=n_test,
+        class_num=2, **kwargs,
+    )
+
+
+def load_partition_data_abcd(
+    data_path: str,
+    val_fraction: float = 0.0,
+    normalize: bool = False,
+    seed: int = ABCD_SPLIT_SEED,
+) -> FederatedData:
+    """One federated client per acquisition site (``data_loader.py:164-216``).
+
+    Reads site by site (lazy), splits 80/20 with the reference's seed
+    contract, and stacks into one device-ready pytree."""
+    X, y, site = load_abcd_h5(data_path)
+    splits = site_train_test_split(site, seed=seed)
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for s, (tr, te) in splits.items():
+        xs_tr.append(_gather_rows(X, tr))
+        ys_tr.append(y[tr])
+        xs_te.append(_gather_rows(X, te))
+        ys_te.append(y[te])
+        logger.info("site %s: %d train / %d test", s, len(tr), len(te))
+    _close_if_h5(X)
+    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed, normalize)
+
+
+def load_partition_data_abcd_rescale(
+    data_path: str,
+    client_number: int,
+    val_fraction: float = 0.0,
+    normalize: bool = False,
+    seed: int = ABCD_SPLIT_SEED,
+) -> FederatedData:
+    """Merge all sites' train/test pools (site order), then contiguous equal
+    reshard to ``client_number`` clients — ``data_loader.py:220-319``. Client
+    i's train rows are ``[i*s, (i+1)*s)`` of the merged train pool and its
+    test rows the matching 20%-scaled window of the merged test pool
+    (``data_loader.py:286-296``)."""
+    X, y, site = load_abcd_h5(data_path)
+    splits = site_train_test_split(site, seed=seed)
+    tr_idx = np.concatenate([tr for tr, _ in splits.values()])
+    te_idx = np.concatenate([te for _, te in splits.values()])
+
+    s_tr = len(tr_idx) // client_number
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for c in range(client_number):
+        rows_tr = tr_idx[c * s_tr: (c + 1) * s_tr]
+        lo = int(c * s_tr * ABCD_TEST_RATIO)
+        hi = int((c + 1) * s_tr * ABCD_TEST_RATIO)
+        rows_te = te_idx[lo:hi]
+        xs_tr.append(_gather_rows(X, rows_tr))
+        ys_tr.append(y[np.sort(rows_tr)])
+        xs_te.append(_gather_rows(X, rows_te))
+        ys_te.append(y[np.sort(rows_te)])
+        logger.info("client %d: %d train / %d test", c, len(rows_tr),
+                    len(rows_te))
+    _close_if_h5(X)
+    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed, normalize)
+
+
+def _close_if_h5(X) -> None:
+    f = getattr(X, "file", None)
+    if f is not None:
+        try:
+            f.close()
+        except Exception:  # pragma: no cover
+            pass
+
+
+def write_abcd_h5(path: str, X: np.ndarray, y: np.ndarray,
+                  site: np.ndarray) -> None:
+    """Write a cohort file in the layout ``load_abcd_h5`` expects
+    (keys X/y/site — the format ``Preprocess_ABCD.ipynb`` cell 31 produces)."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        f.create_dataset("X", data=np.asarray(X, np.float32),
+                         chunks=(1,) + tuple(np.asarray(X).shape[1:]))
+        f.create_dataset("y", data=np.asarray(y))
+        f.create_dataset("site", data=np.asarray(site))

@@ -1,0 +1,182 @@
+"""CIFAR-10/100 federated loaders + device-side augmentation.
+
+Rebuild of ``fedml_api/data_preprocessing/cifar10/`` and ``cifar100/``:
+
+* raw loading <- ``load_cifar10_data`` (``cifar10/data_loader.py:63-72``)
+  but reading the standard python-pickled batch files directly (no
+  torchvision dependency);
+* partition modes 'homo'/'n_cls'/'dir'/'my_part'
+  (``data_loader.py:75-195``) via :mod:`.partition`;
+* per-client proportional test resampling
+  (``data_loader.py:224-243``) via
+  :func:`.partition.proportional_test_indices`;
+* the FedFomo validation variant (``data_val_loader.py:275-326``) via
+  ``val_fraction=0.1``.
+
+The reference's torchvision transform pipeline (RandomCrop(32,4) + flip +
+normalize, ``data_loader.py:34-57``) runs per-sample on CPU; here
+normalization is baked into the stacked arrays once and the random
+crop/flip is a jittable batched op (:func:`random_crop_flip`) that fuses
+into the device training step — the TPU-idiomatic replacement for a host
+DataLoader worker pool.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .partition import (
+    class_prior_partition,
+    proportional_test_indices,
+    record_data_stats,
+)
+from .types import FederatedData, pad_stack
+
+# torchvision-normalization constants used by the reference
+# (cifar10/data_loader.py:36-37, cifar100/data_loader.py)
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+
+def _unpickle(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f, encoding="latin1")
+
+
+def load_cifar10_raw(data_dir: str):
+    """Read the standard ``cifar-10-batches-py`` layout into NHWC uint8 +
+    int labels."""
+    base = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(base):
+        base = data_dir
+    xs, ys = [], []
+    for i in range(1, 6):
+        d = _unpickle(os.path.join(base, f"data_batch_{i}"))
+        xs.append(d["data"])
+        ys.extend(d["labels"])
+    X_train = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_train = np.asarray(ys, np.int32)
+    d = _unpickle(os.path.join(base, "test_batch"))
+    X_test = np.asarray(d["data"]).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_test = np.asarray(d["labels"], np.int32)
+    return X_train, y_train, X_test, y_test
+
+
+def load_cifar100_raw(data_dir: str):
+    base = os.path.join(data_dir, "cifar-100-python")
+    if not os.path.isdir(base):
+        base = data_dir
+    d = _unpickle(os.path.join(base, "train"))
+    X_train = np.asarray(d["data"]).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_train = np.asarray(d["fine_labels"], np.int32)
+    d = _unpickle(os.path.join(base, "test"))
+    X_test = np.asarray(d["data"]).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_test = np.asarray(d["fine_labels"], np.int32)
+    return X_train, y_train, X_test, y_test
+
+
+def _normalize(x: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    return ((x.astype(np.float32) / 255.0) - mean) / std
+
+
+def load_partition_data_cifar(
+    data_dir: str,
+    dataset: str = "cifar10",
+    partition_method: str = "dir",
+    partition_alpha: float = 0.3,
+    client_number: int = 100,
+    val_fraction: float = 0.0,
+    seed: Optional[int] = None,
+) -> FederatedData:
+    """Federated CIFAR with the reference's partition + eval protocol
+    (``load_partition_data_cifar10``, ``cifar10/data_loader.py:208-250``):
+    train indices from the chosen partition mode; per-client test sets
+    resampled proportional to the client's train label histogram."""
+    if dataset == "cifar10":
+        X_train, y_train, X_test, y_test = load_cifar10_raw(data_dir)
+        mean, std, n_classes = CIFAR10_MEAN, CIFAR10_STD, 10
+    elif dataset == "cifar100":
+        X_train, y_train, X_test, y_test = load_cifar100_raw(data_dir)
+        mean, std, n_classes = CIFAR100_MEAN, CIFAR100_STD, 100
+    else:
+        raise ValueError(f"unknown cifar dataset {dataset!r}")
+
+    X_train = _normalize(X_train, mean, std)
+    X_test = _normalize(X_test, mean, std)
+
+    mapping = class_prior_partition(
+        y_train, client_number, n_classes, partition_method,
+        partition_alpha, seed=seed,
+    )
+    cls_counts = record_data_stats(y_train, mapping)
+    rng = np.random.RandomState(seed)
+    test_map = proportional_test_indices(
+        y_test, cls_counts, client_number, n_classes, rng=rng,
+    )
+
+    xs_tr = [X_train[mapping[c]] for c in range(client_number)]
+    ys_tr = [y_train[mapping[c]] for c in range(client_number)]
+    xs_te = [X_test[test_map[c]] for c in range(client_number)]
+    ys_te = [y_test[test_map[c]] for c in range(client_number)]
+
+    xs_va, ys_va = [], []
+    if val_fraction > 0:
+        # FedFomo's 9-tuple variant: carve 10% of each local train split
+        # (cifar10/data_val_loader.py:275-279)
+        new_x, new_y = [], []
+        for x, y in zip(xs_tr, ys_tr):
+            n_val = int(len(y) * val_fraction)
+            perm = rng.permutation(len(y))
+            new_x.append(x[perm[n_val:]])
+            new_y.append(y[perm[n_val:]])
+            xs_va.append(x[perm[:n_val]])
+            ys_va.append(y[perm[:n_val]])
+        xs_tr, ys_tr = new_x, new_y
+
+    x_train, n_train = pad_stack(xs_tr)
+    y_tr, _ = pad_stack([y.astype(np.int32) for y in ys_tr])
+    x_test, n_test = pad_stack(xs_te)
+    y_te, _ = pad_stack([y.astype(np.int32) for y in ys_te])
+    kwargs = {}
+    if val_fraction > 0:
+        x_val, n_val = pad_stack(xs_va)
+        y_va, _ = pad_stack([y.astype(np.int32) for y in ys_va])
+        kwargs = dict(x_val=x_val, y_val=y_va, n_val=n_val)
+    return FederatedData(
+        x_train=x_train, y_train=y_tr, n_train=n_train,
+        x_test=x_test, y_test=y_te, n_test=n_test,
+        class_num=n_classes, **kwargs,
+    )
+
+
+def random_crop_flip(rng, batch, padding: int = 4):
+    """Jittable batched random crop (pad-and-slice) + horizontal flip.
+
+    Device-side replacement for the reference's torchvision
+    ``RandomCrop(32, padding=4) + RandomHorizontalFlip``
+    (``cifar10/data_loader.py:39-43``): one fused op over the whole batch,
+    traced inside the training step, so augmentation costs no host round-trip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, h, w, c = batch.shape
+    k1, k2, k3 = jax.random.split(rng, 3)
+    padded = jnp.pad(
+        batch, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    dy = jax.random.randint(k1, (b,), 0, 2 * padding + 1)
+    dx = jax.random.randint(k2, (b,), 0, 2 * padding + 1)
+
+    def crop_one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    cropped = jax.vmap(crop_one)(padded, dy, dx)
+    flip = jax.random.bernoulli(k3, 0.5, (b,))
+    flipped = jnp.where(flip[:, None, None, None],
+                        cropped[:, :, ::-1, :], cropped)
+    return flipped

@@ -32,6 +32,19 @@ def field_div(num, den, p: int):
     return np.mod(np.asarray(num, np.int64) * np.int64(inv), p)
 
 
+def _matmul_mod(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
+    """``(a @ b) mod p`` without int64 overflow: a plain matmul accumulates
+    up to K products of size (p-1)^2 each before reducing, which wraps for
+    K >= 3 at p ~ 2^31; reducing after every rank-1 term keeps every partial
+    below p^2 + p < 2^63."""
+    a = np.mod(np.asarray(a, np.int64), p)
+    b = np.mod(np.asarray(b, np.int64), p)
+    out = np.zeros((a.shape[0],) + b.shape[1:], np.int64)
+    for j in range(a.shape[1]):
+        out = np.mod(out + a[:, j, None] * b[j], p)
+    return out
+
+
 def lagrange_coeffs(
     targets: Sequence[int], nodes: Sequence[int], p: int
 ) -> np.ndarray:
@@ -118,7 +131,7 @@ def lcc_encode(
     lam = lagrange_coeffs(alphas, betas, p)  # [N, K+T]
     stacked = np.stack(subs)  # [K+T, chunk, ...]
     flat = stacked.reshape(len(subs), -1)
-    enc = np.mod(lam @ flat, p)
+    enc = _matmul_mod(lam, flat, p)
     return enc.reshape((n_workers,) + stacked.shape[1:])
 
 
@@ -142,7 +155,7 @@ def lcc_decode(
     eval_points = [alphas[i] for i in worker_ids]
     lam = lagrange_coeffs(betas[:k_split], eval_points, p)  # [K, n_used]
     flat = np.mod(np.asarray(worker_outputs, np.int64).reshape(len(worker_ids), -1), p)
-    dec = np.mod(lam @ flat, p)
+    dec = _matmul_mod(lam, flat, p)
     return dec.reshape((k_split,) + worker_outputs.shape[1:])
 
 

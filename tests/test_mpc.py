@@ -45,6 +45,18 @@ def test_lcc_encode_decode_identity():
     assert np.array_equal(dec.reshape(6, 3), x % P)
 
 
+def test_lcc_roundtrip_large_k_no_overflow():
+    # regression: K+T=12 full-field values — a plain int64 matmul accumulates
+    # >= 3 products of (p-1)^2 and wraps; the mod-per-term matmul must not
+    rng = np.random.RandomState(2)
+    x = rng.randint(0, P, size=(16, 4)).astype(np.int64)
+    enc = mpc.lcc_encode(x, n_workers=14, k_split=8, t_privacy=4, p=P,
+                         rng=rng)
+    ids = list(range(12))
+    dec = mpc.lcc_decode(enc[ids], ids, 14, 8, 4, P)
+    assert np.array_equal(dec.reshape(16, 4), x % P)
+
+
 def test_additive_shares_sum_and_hide():
     rng = np.random.RandomState(2)
     x = rng.randint(0, 1000, size=(7,))
