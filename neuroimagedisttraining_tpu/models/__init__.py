@@ -24,7 +24,17 @@ ApplyFn = Callable[..., Any]
 def _registry():
     from .resnet3d import ResNet3DL3  # local import: keeps zoo modular
     from .resnet2d import ResNet18GN, TinyResNet18
-    from .cnn2d import CNNCifar10, CNNCifar100, CNNOriginalFedAvg, LeNet5, VGG11
+    from .cnn2d import (
+        CNNCifar10,
+        CNNCifar100,
+        CNNDropOut,
+        CNNOriginalFedAvg,
+        LeNet5,
+        VGG11,
+        VGG16,
+    )
+    from .meta import CNNCifar10Meta
+    from .resnet_gn import resnet18_gn, resnet34_gn, resnet50_gn
 
     return {
         # reference names (main_*.py --model flags)
@@ -41,6 +51,12 @@ def _registry():
         "cnn": lambda num_classes, **kw: CNNOriginalFedAvg(num_classes=num_classes, **kw),
         "lenet5": lambda num_classes, **kw: LeNet5(num_classes=num_classes, **kw),
         "vgg11": lambda num_classes, **kw: VGG11(num_classes=num_classes, **kw),
+        "vgg16": lambda num_classes, **kw: VGG16(num_classes=num_classes, **kw),
+        "cnn_dropout": lambda num_classes, **kw: CNNDropOut(num_classes=num_classes, **kw),
+        "cnn_cifar10_meta": lambda num_classes, **kw: CNNCifar10Meta(num_classes=num_classes, **kw),
+        "resnet18_gn": lambda num_classes, **kw: resnet18_gn(num_classes=num_classes, **kw),
+        "resnet34_gn": lambda num_classes, **kw: resnet34_gn(num_classes=num_classes, **kw),
+        "resnet50_gn": lambda num_classes, **kw: resnet50_gn(num_classes=num_classes, **kw),
         # CI/test model
         "small3dcnn": lambda num_classes, **kw: SmallCNN3D(num_classes=num_classes, **kw),
     }

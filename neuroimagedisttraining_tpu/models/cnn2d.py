@@ -106,3 +106,23 @@ class VGG11(_VGG):
 
 class VGG16(_VGG):
     cfg: tuple = _VGG_CFG_D
+
+
+class CNNDropOut(nn.Module):
+    """The "Adaptive Federated Optimization" EMNIST CNN (``cnn.py:75-144``):
+    conv3x3(32) -> conv3x3(64) -> maxpool2 -> dropout(.25) -> dense 128 ->
+    dropout(.5) -> K. num_classes=10 for digits, 62 for FEMNIST (the
+    reference's ``only_digits`` switch). Input (N, 28, 28, 1)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -10,7 +10,7 @@ train/eval statistics split. ``norm="batch"`` is intentionally not offered.
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -78,3 +78,30 @@ def avg_pool3d(x, kernel: Ints3, strides: Ints3 = None, padding: Ints3 = 0):
 
 def flatten(x):
     return x.reshape(x.shape[0], -1)
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-device synchronized BatchNorm.
+
+    TPU-native replacement for the reference's hand-rolled master/slave-pipe
+    ``SynchronizedBatchNorm1d/2d/3d`` (``batchnorm_utils.py:150-396``): under
+    ``pmap``/``shard_map`` with ``axis_name`` set, flax's BatchNorm psums the
+    batch statistics over the mesh axis — XLA's collective IS the sync, no
+    callbacks or pipes. Kept for parity/experiments; the zoo's default norm
+    remains GroupNorm (see module docstring above) because federated
+    personalization makes shared running stats a liability.
+
+    Note: carries mutable ``batch_stats``; models using it must be applied
+    with ``mutable=["batch_stats"]`` during training.
+    """
+
+    axis_name: Optional[str] = None
+    momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.momentum,
+            axis_name=self.axis_name,
+        )(x)

@@ -1,0 +1,77 @@
+"""Hypernetwork (mask -> weight) models.
+
+Rebuild of ``fedml_api/model/cv/cnn_meta.py``:
+
+* :class:`CNNCifar10Meta` <- ``cnn_cifar10_meta`` (``cnn_meta.py:17-143``):
+  the bias-free 2x[conv5x5(64) + maxpool3s2] -> fc CIFAR net whose conv
+  weights are the *targets* a hypernetwork generates, plus its random
+  dense-ratio mask initializer.
+* :class:`MetaNet` <- ``Meta_net`` (``cnn_meta.py:145-176``): the
+  mask-conditioned weight generator — flatten(mask) -> 50 -> 50 -> |weight|,
+  reshaped to the conv kernel shape, He-uniform initialized.
+
+In the reference these are imported by several trainers but never exercised
+at runtime (SURVEY.md §2.3); they are kept first-class here because the
+mask->weight generation pattern composes naturally with the sparsity engine
+(``ops/sparsity.py``): generate weights for a client's mask on device, no
+host round-trip.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CNNCifar10Meta(nn.Module):
+    """Bias-free CIFAR CNN whose conv kernels are hypernetwork targets
+    (``cnn_meta.py:83-143``): conv5x5(64) -> pool3s2 -> conv5x5(64) ->
+    pool3s2 -> fc(10). VALID padding matches the torch defaults, so the fc
+    input is 4x4x64 at 32x32 input, as in the reference."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(nn.Conv(64, (5, 5), padding="VALID", use_bias=False,
+                            name="meta_conv1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="VALID", use_bias=False,
+                            name="meta_conv2")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes, use_bias=False,
+                        name="meta_fc1")(x)
+
+
+def init_random_mask(rng: jax.Array, shape: Tuple[int, ...],
+                     dense_ratio: float = 0.2) -> jax.Array:
+    """Random {0,1} mask at ``dense_ratio`` density — the reference's
+    ``init_conv_masks`` (``cnn_meta.py:59-68``). Thin alias over the
+    sparsity engine's shared mask sampler."""
+    from ..ops.sparsity import random_mask_array
+
+    return random_mask_array(rng, shape, dense_ratio)
+
+
+class MetaNet(nn.Module):
+    """Mask-conditioned weight generator (``Meta_net``,
+    ``cnn_meta.py:145-176``): flatten -> 50 -> 50 -> |target|, reshaped to
+    ``target_shape``. He-uniform init per the reference's
+    ``kaiming_uniform_``."""
+
+    target_shape: Tuple[int, ...]
+    hidden: int = 50
+
+    @nn.compact
+    def __call__(self, mask: jax.Array) -> jax.Array:
+        size = int(np.prod(self.target_shape))
+        kinit = nn.initializers.he_uniform()
+        x = mask.reshape(-1)
+        x = nn.relu(nn.Dense(self.hidden, kernel_init=kinit)(x))
+        x = nn.relu(nn.Dense(self.hidden, kernel_init=kinit)(x))
+        w = nn.Dense(size, kernel_init=kinit)(x)
+        return w.reshape(self.target_shape)

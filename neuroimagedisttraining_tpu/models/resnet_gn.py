@@ -1,0 +1,111 @@
+"""ImageNet-style ResNet with GroupNorm.
+
+Rebuild of ``fedml_api/model/cv/resnet_gn.py:108-237`` (torchvision-layout
+ResNet with the custom ``group_normalization.py:7-117`` GroupNorm module
+swapped in for BN): 7x7/2 stem + maxpool3/2, four stages, basic blocks for
+resnet18/34 and bottlenecks for resnet50, GN(32) everywhere. The reference
+carries its own GroupNorm implementation because torch's landed later; flax
+has one natively, so only the architecture is rebuilt. Channels-last.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+
+from .layers import group_norm
+
+# the reference He-normal-inits every conv (resnet_gn.py:138-142)
+_he = nn.initializers.he_normal()
+
+
+def _zero_scale_gn(channels: int) -> nn.GroupNorm:
+    """GN whose scale starts at zero — the reference zero-fills the last
+    norm's gamma in each residual block (resnet_gn.py:143-146, the
+    'zero-init residual' trick) so every branch starts as identity."""
+    g = min(32, channels)
+    while channels % g:
+        g -= 1
+    return nn.GroupNorm(num_groups=g, scale_init=nn.initializers.zeros)
+
+
+class _BasicBlockGN(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        r = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding=1, use_bias=False, kernel_init=_he)(x)
+        y = group_norm(self.features)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False,
+                    kernel_init=_he)(y)
+        y = _zero_scale_gn(self.features)(y)
+        if r.shape[-1] != self.features or self.strides != 1:
+            r = nn.Conv(self.features, (1, 1), strides=(self.strides,) * 2,
+                        use_bias=False, kernel_init=_he)(r)
+            r = group_norm(self.features)(r)
+        return nn.relu(y + r)
+
+
+class _BottleneckGN(nn.Module):
+    features: int  # bottleneck width; output is 4x
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        out = self.features * 4
+        r = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=_he)(x)
+        y = nn.relu(group_norm(self.features)(y))
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding=1, use_bias=False, kernel_init=_he)(y)
+        y = nn.relu(group_norm(self.features)(y))
+        y = nn.Conv(out, (1, 1), use_bias=False, kernel_init=_he)(y)
+        y = _zero_scale_gn(out)(y)
+        if r.shape[-1] != out or self.strides != 1:
+            r = nn.Conv(out, (1, 1), strides=(self.strides,) * 2,
+                        use_bias=False, kernel_init=_he)(r)
+            r = group_norm(out)(r)
+        return nn.relu(y + r)
+
+
+class ResNetGN(nn.Module):
+    """resnet_gn.py:108-237 layout: stem + 4 stages + global-avg-pool head."""
+
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    bottleneck: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        block = _BottleneckGN if self.bottleneck else _BasicBlockGN
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3,
+                    use_bias=False, kernel_init=_he)(x)
+        x = nn.relu(group_norm(64)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            feats = 64 * (2 ** stage)
+            for b in range(n_blocks):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = block(feats, strides)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet18_gn(num_classes: int = 1000, **kw) -> ResNetGN:
+    return ResNetGN(num_classes=num_classes, stage_sizes=(2, 2, 2, 2),
+                    bottleneck=False, **kw)
+
+
+def resnet34_gn(num_classes: int = 1000, **kw) -> ResNetGN:
+    return ResNetGN(num_classes=num_classes, stage_sizes=(3, 4, 6, 3),
+                    bottleneck=False, **kw)
+
+
+def resnet50_gn(num_classes: int = 1000, **kw) -> ResNetGN:
+    return ResNetGN(num_classes=num_classes, stage_sizes=(3, 4, 6, 3),
+                    bottleneck=True, **kw)

@@ -188,6 +188,24 @@ def erk_sparsities(
     return out
 
 
+def random_mask_array(
+    rng: jax.Array, shape: Tuple[int, ...], density: float,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Random {0,1} mask with exactly ``int(density * size)`` ones: rank
+    uniform scores and keep the top-k. Shared by DisPFL mask init and the
+    meta-net mask initializer (``cnn_meta.py:59-68``)."""
+    size = int(np.prod(shape))
+    n_dense = int(density * size)
+    if n_dense <= 0:
+        return jnp.zeros(shape, dtype)
+    if n_dense >= size:
+        return jnp.ones(shape, dtype)
+    scores = jax.random.uniform(rng, (size,))
+    thresh = jnp.sort(scores)[::-1][n_dense - 1]
+    return (scores >= thresh).astype(dtype).reshape(shape)
+
+
 def random_masks_from_sparsities(
     params: Any, sparsities_fn: Callable[[str, Tuple[int, ...]], float],
     rng: jax.Array,
@@ -202,13 +220,7 @@ def random_masks_from_sparsities(
             out.append(jnp.ones_like(p))
             continue
         s = sparsities_fn(_path_name(path), p.shape)
-        n_dense = int((1.0 - s) * p.size)
-        scores = jax.random.uniform(key, (p.size,))
-        if n_dense <= 0:
-            out.append(jnp.zeros_like(p))
-            continue
-        thresh = jnp.sort(scores)[::-1][n_dense - 1]
-        out.append((scores >= thresh).astype(p.dtype).reshape(p.shape))
+        out.append(random_mask_array(key, p.shape, 1.0 - s, p.dtype))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -79,3 +79,89 @@ def test_cnn_cifar10_flatten_width():
         if path[-1].key == "kernel" and p.ndim == 2
     ]
     assert sorted(k.shape[0] for k in kernels) == [192, 384, 1600]
+
+
+def test_new_zoo_models_shapes():
+    """CNN_DropOut / VGG16 / meta CNN / ImageNet GN-ResNets forward shapes."""
+    cases = [
+        ("cnn_dropout", 62, (28, 28, 1)),
+        ("vgg16", 10, (32, 32, 3)),
+        ("cnn_cifar10_meta", 10, (32, 32, 3)),
+        ("resnet18_gn", 7, (64, 64, 3)),
+        ("resnet50_gn", 7, (64, 64, 3)),
+    ]
+    for name, nc, shape in cases:
+        model = create_model(name, num_classes=nc)
+        params = init_params(model, jax.random.PRNGKey(0), shape)
+        apply_fn = make_apply_fn(model)
+        out = apply_fn(params, jnp.ones((2,) + shape), train=False, rng=None)
+        assert out.shape == (2, nc), name
+        out_t = apply_fn(params, jnp.ones((2,) + shape), train=True,
+                         rng=jax.random.PRNGKey(1))
+        assert out_t.shape == (2, nc), name
+
+
+def test_cnn_cifar10_meta_fc_width():
+    """VALID 5x5 convs + 3s2 pools on 32x32 -> 4x4x64 fc input
+    (cnn_meta.py:100: fc1 is Linear(64*4*4, 10))."""
+    model = create_model("cnn_cifar10_meta", num_classes=10)
+    params = init_params(model, jax.random.PRNGKey(0), (32, 32, 3))
+    fc = params["meta_fc1"]["kernel"]
+    assert fc.shape == (64 * 4 * 4, 10)
+
+
+def test_meta_net_generates_target_shape():
+    from neuroimagedisttraining_tpu.models.meta import (
+        MetaNet,
+        init_random_mask,
+    )
+
+    target = (5, 5, 3, 64)
+    mask = init_random_mask(jax.random.PRNGKey(0), target, dense_ratio=0.2)
+    density = float(mask.mean())
+    assert abs(density - 0.2) < 0.01
+    net = MetaNet(target_shape=target)
+    variables = net.init(jax.random.PRNGKey(1), mask)
+    w = net.apply(variables, mask)
+    assert w.shape == target
+
+
+def test_sync_batch_norm_cross_device_stats():
+    """SyncBatchNorm with axis_name psums batch stats over the mesh axis:
+    per-device outputs must equal single-device BN over the concatenated
+    batch (the batchnorm_utils.py:150-396 master/slave sync, done by XLA)."""
+    import numpy as np
+    from neuroimagedisttraining_tpu.models.layers import SyncBatchNorm
+
+    n_dev = min(4, jax.local_device_count())
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_dev, 8, 6))
+
+    m_sync = SyncBatchNorm(axis_name="clients")
+    variables = SyncBatchNorm().init(jax.random.PRNGKey(1), x[0], train=True)
+
+    def step(xs):
+        y, _ = m_sync.apply(variables, xs, train=True,
+                            mutable=["batch_stats"])
+        return y
+
+    y_pmap = jax.pmap(step, axis_name="clients")(x)
+    # single-device reference over the concatenated batch
+    y_ref, _ = SyncBatchNorm().apply(
+        variables, x.reshape(-1, 6), train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        np.asarray(y_pmap).reshape(-1, 6), np.asarray(y_ref),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_gn_zero_init_residual():
+    """Residual branches start as identity: the last GN scale in each block
+    is zero at init (resnet_gn.py:143-146 parity)."""
+    model = create_model("resnet18_gn", num_classes=4)
+    params = init_params(model, jax.random.PRNGKey(0), (32, 32, 3))
+    import numpy as np
+
+    zero_scales = [
+        p for path, p in jax.tree_util.tree_flatten_with_path(params)[0]
+        if path[-1].key == "scale" and float(np.abs(np.asarray(p)).sum()) == 0
+    ]
+    assert len(zero_scales) == 8  # 2 blocks x 4 stages
