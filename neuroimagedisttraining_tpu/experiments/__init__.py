@@ -1,0 +1,19 @@
+"""L5 experiments/CLI layer.
+
+``python -m neuroimagedisttraining_tpu.experiments --algo fedavg ...`` or the
+per-algorithm mains (``python -m
+neuroimagedisttraining_tpu.experiments.main_salientgrads ...``) — the rebuild
+of ``fedml_experiments/standalone/<algo>/main_<algo>.py``.
+"""
+from .config import ALGO_NAMES, build_parser, parse_args, run_identity
+from .runner import build_algorithm, main, run_experiment
+
+__all__ = [
+    "ALGO_NAMES",
+    "build_algorithm",
+    "build_parser",
+    "main",
+    "parse_args",
+    "run_experiment",
+    "run_identity",
+]

@@ -1,0 +1,200 @@
+"""Flag surface, derived config, and run-identity strings.
+
+Rebuilds the reference's per-algorithm argparse mains
+(``fedml_experiments/standalone/<algo>/main_<algo>.py``) as one shared flag
+table plus per-algorithm extras. Flag names are kept compatible with the
+reference (``main_sailentgrads.py:31-127``, ``main_dispfl.py:93-108``,
+``main_ditto.py:79,101``) so existing sweep scripts translate 1:1.
+
+Derived config mirrors ``client_num_per_round = int(client_num_in_total *
+frac)`` (``main_sailentgrads.py:234``); the identity string doubles as the
+experiment-tracking key and the log filename (``main_sailentgrads.py:205-241``).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Sequence
+
+ALGO_NAMES = (
+    "fedavg",
+    "salientgrads",
+    "dispfl",
+    "subavg",
+    "dpsgd",
+    "ditto",
+    "fedfomo",
+    "local",
+    "turboaggregate",
+)
+
+
+def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
+    """Common flags + (optionally) one algorithm's extra flags."""
+    p = argparse.ArgumentParser(
+        prog=f"main_{algo}" if algo else "neuroimagedisttraining_tpu",
+        description="TPU-native federated neuroimaging training",
+    )
+    if algo is None:
+        p.add_argument("--algo", type=str, default="fedavg",
+                       choices=ALGO_NAMES, help="federated algorithm")
+
+    # -- model / data (main_sailentgrads.py:36-63)
+    p.add_argument("--model", type=str, default="3dcnn",
+                   help="model key in the zoo registry (3dcnn, resnet18, ...)")
+    p.add_argument("--dataset", type=str, default="synthetic",
+                   help="abcd | abcd_site | cifar10 | cifar100 | "
+                        "tiny_imagenet | synthetic")
+    p.add_argument("--data_dir", type=str, default="",
+                   help="dataset root (ABCD .h5 path or CIFAR batches dir)")
+    p.add_argument("--partition_method", type=str, default="dir",
+                   help="dir | n_cls | my_part | site (cifar/tiny partition)")
+    p.add_argument("--partition_alpha", type=float, default=0.3)
+    p.add_argument("--client_num_in_total", type=int, default=8)
+    p.add_argument("--frac", type=float, default=1.0,
+                   help="fraction of clients sampled per round")
+
+    # -- local training (main_sailentgrads.py:66-101)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--client_optimizer", type=str, default="sgd")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--lr_decay", type=float, default=0.998)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--wd", type=float, default=0.0, help="weight decay")
+    p.add_argument("--grad_clip", type=float, default=10.0)
+    p.add_argument("--epochs", type=int, default=2,
+                   help="local epochs per round")
+    p.add_argument("--comm_round", type=int, default=10)
+    p.add_argument("--frequency_of_the_test", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ci", type=int, default=0,
+                   help="smoke mode: tiny eval to catch programming errors "
+                        "(sailentgrads_api.py:260-265 semantics)")
+
+    # -- runtime (new: TPU-native knobs, no reference equivalent)
+    p.add_argument("--client_chunk", type=int, default=0,
+                   help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--mesh_devices", type=int, default=0,
+                   help="shard client axis over this many devices (0 = all)")
+    p.add_argument("--checkpoint_dir", type=str, default="",
+                   help="enable round-granular orbax checkpointing here")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from latest checkpoint in --checkpoint_dir")
+    p.add_argument("--log_dir", type=str, default="LOG",
+                   help="per-run file logs (main_sailentgrads.py:184-192)")
+    p.add_argument("--results_dir", type=str, default="results",
+                   help="stat_info pickle dir (subavg_api.py:218-221)")
+    p.add_argument("--profile_dir", type=str, default="",
+                   help="write a jax.profiler trace of one round here")
+    p.add_argument("--tag", type=str, default="", help="identity suffix")
+
+    if algo is not None:
+        add_algo_args(p, algo)
+    else:
+        for a in ALGO_NAMES:
+            add_algo_args(p, a)
+    return p
+
+
+def _add_once(p: argparse.ArgumentParser, *args, **kwargs):
+    try:
+        p.add_argument(*args, **kwargs)
+    except argparse.ArgumentError:
+        pass  # shared by several algorithms (e.g. --dense_ratio, --cs)
+
+
+def add_algo_args(p: argparse.ArgumentParser, algo: str) -> None:
+    if algo == "salientgrads":
+        # main_sailentgrads.py:105-126
+        _add_once(p, "--dense_ratio", type=float, default=0.5)
+        _add_once(p, "--itersnip_iteration", type=int, default=1)
+        _add_once(p, "--snip_mask", type=int, default=1)
+        _add_once(p, "--stratified_sampling", type=int, default=0)
+    elif algo in ("dispfl", "dpsgd"):
+        # main_dispfl.py:93-108
+        _add_once(p, "--cs", type=str, default="random",
+                  help="client/neighbor selection: random | ring | full")
+        if algo == "dispfl":
+            _add_once(p, "--dense_ratio", type=float, default=0.5)
+            _add_once(p, "--anneal_factor", type=float, default=0.5)
+            _add_once(p, "--active", type=float, default=1.0,
+                      help="per-round client participation probability")
+            _add_once(p, "--static", action="store_true",
+                      help="freeze masks (no fire/regrow)")
+            _add_once(p, "--erk_power_scale", type=float, default=1.0)
+            _add_once(p, "--dis_gradient_check", action="store_true")
+    elif algo == "subavg":
+        _add_once(p, "--dense_ratio", type=float, default=0.5)
+        _add_once(p, "--each_prune_ratio", type=float, default=0.2)
+        _add_once(p, "--dist_thresh", type=float, default=0.001)
+        _add_once(p, "--acc_thresh", type=float, default=0.5)
+    elif algo == "ditto":
+        # main_ditto.py:79,101
+        _add_once(p, "--lamda", type=float, default=0.5)
+        _add_once(p, "--local_epochs", type=int, default=0,
+                  help="personal-model epochs (0 = same as --epochs)")
+    elif algo == "fedfomo":
+        _add_once(p, "--val_fraction", type=float, default=0.1,
+                  help="per-client validation split (data_val_loader)")
+    elif algo == "turboaggregate":
+        _add_once(p, "--n_groups", type=int, default=3)
+
+
+def derive(args: argparse.Namespace) -> argparse.Namespace:
+    """Post-parse derived fields (main_sailentgrads.py:234; rounding matches
+    ``FedAlgorithm.__init__``'s ``int(round(...))`` so the recorded config
+    reflects the actual per-round participation)."""
+    args.client_num_per_round = max(
+        1, int(round(args.client_num_in_total * args.frac)))
+    if getattr(args, "ci", 0):
+        args.comm_round = min(args.comm_round, 2)
+    return args
+
+
+# extras that belong to each algorithm's identity string (subset of the
+# flags added by add_algo_args; keep in sync)
+_IDENTITY_EXTRAS = {
+    "salientgrads": ("dense_ratio", "itersnip_iteration"),
+    "dispfl": ("dense_ratio", "cs", "active", "anneal_factor"),
+    "dpsgd": ("cs",),
+    "subavg": ("dense_ratio", "each_prune_ratio"),
+    "ditto": ("lamda",),
+    "turboaggregate": ("n_groups",),
+}
+
+
+def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
+                 for_checkpoint: bool = False) -> str:
+    """Experiment-identity string, the run's tracking key and log filename
+    (rebuild of ``main_sailentgrads.py:205-241``).
+
+    ``for_checkpoint`` drops the ``r{comm_round}`` component so a run
+    resubmitted with a larger round budget (the post-TIME-LIMIT resume case,
+    ``DisPFL/error3469448.err``) finds its own checkpoints.
+    """
+    algo = algo or getattr(args, "algo", "fedavg")
+    parts: List[str] = [
+        algo, args.dataset, args.model,
+        f"c{args.client_num_in_total}", f"frac{args.frac:g}",
+    ]
+    if not for_checkpoint:
+        parts.append(f"r{args.comm_round}")
+    parts += [
+        f"e{args.epochs}", f"bs{args.batch_size}",
+        f"lr{args.lr:g}", f"seed{args.seed}",
+    ]
+    # only this algorithm's extras — the unified --algo parser defines every
+    # algorithm's flags on the namespace, so filtering by algo keeps the
+    # identity (and hence checkpoint/log paths) stable across entry points
+    for extra in _IDENTITY_EXTRAS.get(algo, ()):
+        v = getattr(args, extra, None)
+        if v is not None:
+            parts.append(f"{extra.replace('_', '')}{v:g}"
+                         if isinstance(v, float) else f"{extra[:4]}{v}")
+    if args.tag:
+        parts.append(args.tag)
+    return "-".join(str(x) for x in parts)
+
+
+def parse_args(argv: Optional[Sequence[str]] = None,
+               algo: Optional[str] = None) -> argparse.Namespace:
+    return derive(build_parser(algo).parse_args(argv))

@@ -1,0 +1,6 @@
+"""CLI main for salientgrads (rebuild of main_salientgrads.py in the reference's
+fedml_experiments/standalone tree)."""
+from .runner import main
+
+if __name__ == "__main__":
+    main(algo="salientgrads")

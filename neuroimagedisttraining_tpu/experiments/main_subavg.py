@@ -1,0 +1,6 @@
+"""CLI main for subavg (rebuild of main_subavg.py in the reference's
+fedml_experiments/standalone tree)."""
+from .runner import main
+
+if __name__ == "__main__":
+    main(algo="subavg")

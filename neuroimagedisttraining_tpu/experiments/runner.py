@@ -1,0 +1,249 @@
+"""Experiment runner: flags -> data -> model -> algorithm -> train loop.
+
+The rebuild of the reference's per-algorithm ``main_<algo>.py`` wiring
+(``main_sailentgrads.py:194-279``): seed, load data, create model, construct
+the API object, ``.train()``. One runner serves all nine algorithms; the
+per-algo mains are thin wrappers selecting the algorithm and its extra flags.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import pickle
+import random
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from .config import parse_args, run_identity
+from .logging_utils import add_run_file_logger, configure_console
+
+logger = logging.getLogger(__name__)
+
+
+def seed_everything(seed: int) -> None:
+    """python/numpy seeding (main_sailentgrads.py:263-267; torch/cudnn
+    determinism maps to JAX's deterministic-by-default PRNG keys)."""
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def build_data(args: argparse.Namespace):
+    from ..data import load_federated_data
+
+    kwargs: Dict[str, Any] = {}
+    if args.dataset.lower() in ("synthetic", "abcd_synth"):
+        # CI-scale default; real ABCD shapes come from the .h5 itself
+        kwargs["sample_shape"] = (8, 8, 8, 1)
+        kwargs["samples_per_client"] = max(args.batch_size, 16)
+    return load_federated_data(
+        args.dataset,
+        data_dir=args.data_dir,
+        client_number=args.client_num_in_total,
+        partition_method=args.partition_method,
+        partition_alpha=args.partition_alpha,
+        val_fraction=getattr(args, "val_fraction", 0.0),
+        seed=42,  # the reference's fixed split seed (data_loader.py:67-102)
+        **kwargs,
+    )
+
+
+def infer_loss_type(args: argparse.Namespace, class_num: int) -> str:
+    """ABCD/3D path uses BCE-with-logits (my_model_trainer.py:191-206);
+    CIFAR path uses CE (fedavg/my_model_trainer.py:38-67)."""
+    if args.model.startswith("3d") and class_num == 2:
+        return "bce"
+    if args.dataset.lower().startswith(("abcd", "synthetic")) and class_num == 2:
+        return "bce"
+    return "ce"
+
+
+def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
+    import jax
+
+    from ..algorithms import ALGORITHMS
+    from ..core.state import HyperParams
+    from ..models import create_model
+
+    if data is None:
+        data = build_data(args)
+    loss_type = infer_loss_type(args, data.class_num)
+    num_outputs = 1 if loss_type == "bce" else data.class_num
+    model = create_model(args.model, num_classes=num_outputs)
+
+    n_mean = int(np.mean(np.asarray(data.n_train)))
+    steps_per_epoch = max(1, n_mean // args.batch_size)
+    hp = HyperParams(
+        lr=args.lr, lr_decay=args.lr_decay, momentum=args.momentum,
+        weight_decay=args.wd, grad_clip=args.grad_clip,
+        local_epochs=args.epochs, steps_per_epoch=steps_per_epoch,
+        batch_size=args.batch_size,
+    )
+
+    common = dict(
+        loss_type=loss_type, frac=args.frac, seed=args.seed,
+        client_chunk=args.client_chunk or None,
+    )
+    extra: Dict[str, Any] = {}
+    if algo_name == "salientgrads":
+        extra = dict(dense_ratio=args.dense_ratio,
+                     itersnip_iterations=args.itersnip_iteration)
+    elif algo_name == "dispfl":
+        extra = dict(dense_ratio=args.dense_ratio,
+                     anneal_factor=args.anneal_factor,
+                     neighbor_mode=args.cs, active=args.active,
+                     static_masks=bool(args.static),
+                     total_rounds=args.comm_round,
+                     erk_power_scale=args.erk_power_scale)
+    elif algo_name == "dpsgd":
+        extra = dict(neighbor_mode=args.cs)
+    elif algo_name == "subavg":
+        extra = dict(each_prune_ratio=args.each_prune_ratio,
+                     dist_thresh=args.dist_thresh,
+                     acc_thresh=args.acc_thresh,
+                     dense_ratio=args.dense_ratio)
+    elif algo_name == "ditto":
+        personal_hp = None
+        if getattr(args, "local_epochs", 0):
+            personal_hp = hp.replace(local_epochs=args.local_epochs)
+        extra = dict(lamda=args.lamda, personal_hp=personal_hp)
+    elif algo_name == "turboaggregate":
+        extra = dict(n_groups=args.n_groups)
+
+    cls = ALGORITHMS[algo_name]
+    return cls(model, data, hp, **common, **extra), data
+
+
+def maybe_shard(algo, args: argparse.Namespace):
+    """Place the client-stacked data on a ``clients`` mesh so the vmapped
+    round runs SPMD over devices (SURVEY §7 design stance)."""
+    import jax
+
+    from ..parallel import make_mesh, shard_over_clients
+
+    n_dev = args.mesh_devices or len(jax.devices())
+    n_dev = min(n_dev, len(jax.devices()), algo.num_clients)
+    if n_dev <= 1:
+        return None
+    while algo.num_clients % n_dev:
+        n_dev -= 1
+    if n_dev <= 1:
+        return None
+    mesh = make_mesh(n_dev)
+    algo.data = shard_over_clients(algo.data, mesh)
+    return mesh
+
+
+def save_stat_info(args: argparse.Namespace, identity: str,
+                   history, final_eval) -> Optional[str]:
+    """End-of-run artifact: stat_info pickle under
+    ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
+    if not args.results_dir:
+        return None
+    out_dir = os.path.join(args.results_dir, args.dataset)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, identity)
+    stat_info = {
+        "config": vars(args),
+        "history": history,
+        "final_eval": {k: float(v) for k, v in final_eval.items()
+                       if np.ndim(v) == 0},
+        "global_test_acc": [h.get("global_acc") for h in history
+                            if "global_acc" in h],
+        "person_test_acc": [h.get("personal_acc") for h in history
+                            if "personal_acc" in h],
+    }
+    with open(path, "wb") as f:
+        pickle.dump(stat_info, f)
+    with open(path + ".json", "w") as f:
+        json.dump(stat_info, f, default=str, indent=1)
+    return path
+
+
+def run_experiment(args: argparse.Namespace,
+                   algo_name: Optional[str] = None) -> Dict[str, Any]:
+    import jax
+
+    algo_name = algo_name or getattr(args, "algo", "fedavg")
+    identity = run_identity(args, algo_name)
+    configure_console()
+    log_handler = add_run_file_logger(args.log_dir, identity)
+    ckpt_mgr = None
+    try:
+        logger.info("run identity: %s", identity)
+        seed_everything(args.seed)
+
+        algo, data = build_algorithm(args, algo_name)
+        mesh = maybe_shard(algo, args)
+        if mesh is not None:
+            logger.info("sharding clients over mesh %s", dict(mesh.shape))
+
+        state = None
+        start_round = 0
+        if args.checkpoint_dir:
+            from ..utils.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(
+                args.checkpoint_dir,
+                run_identity(args, algo_name, for_checkpoint=True))
+            if args.resume:
+                restored = ckpt_mgr.restore_latest(
+                    algo.init_state(jax.random.PRNGKey(args.seed)))
+                if restored is not None:
+                    state, start_round = restored
+                    logger.info("resumed from round %d", start_round)
+
+        if state is None:
+            state = algo.init_state(jax.random.PRNGKey(args.seed))
+
+        if args.profile_dir:
+            from ..utils.profiling import trace_one_round
+
+            trace_one_round(algo, state, args.profile_dir)
+
+        history = []
+        final_eval = None
+        for r in range(start_round, max(start_round, args.comm_round)):
+            state, rec = algo.run_round(state, r)
+            record = {"round": r,
+                      **{k: _scalar(v) for k, v in rec.items()}}
+            final_eval = None  # state changed; any cached eval is stale
+            if args.frequency_of_the_test and \
+                    (r + 1) % args.frequency_of_the_test == 0:
+                final_eval = algo.evaluate(state)
+                record.update({
+                    k: _scalar(v) for k, v in final_eval.items()
+                    if not k.startswith("acc_per")})
+            history.append(record)
+            logger.info("%s round %d: %s", algo_name, r, record)
+            if ckpt_mgr is not None:
+                ckpt_mgr.save(r + 1, state)
+
+        if final_eval is None:  # last round wasn't an eval round
+            final_eval = algo.evaluate(state)
+        stat_path = save_stat_info(args, identity, history, final_eval)
+        return {
+            "identity": identity,
+            "history": history,
+            "final_eval": final_eval,
+            "stat_path": stat_path,
+            "state": state,
+        }
+    finally:
+        if ckpt_mgr is not None:
+            ckpt_mgr.close()
+        from .logging_utils import remove_run_file_logger
+
+        remove_run_file_logger(log_handler)
+
+
+def _scalar(v):
+    return float(v) if np.ndim(v) == 0 else v
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         algo: Optional[str] = None) -> Dict[str, Any]:
+    args = parse_args(argv, algo)
+    return run_experiment(args, algo)

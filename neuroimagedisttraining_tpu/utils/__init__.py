@@ -1,0 +1,1 @@
+"""Utilities: checkpointing, profiling/cost accounting, logging."""

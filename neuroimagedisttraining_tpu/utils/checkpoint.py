@@ -1,0 +1,72 @@
+"""Round-granular checkpoint/resume via orbax.
+
+The reference has no checkpointing in the FL path — a 3-day SLURM run that
+hits the time limit loses everything (``DisPFL/error3469448.err``; only DARTS
+carries torch.save utils, ``darts/utils.py:66-80``). Here every federated
+round can be checkpointed: the full server state pytree (params, per-client
+masks/params, optimizer state, PRNG key) plus the round index, with automatic
+latest-step resume.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with a fixed layout: ``<root>/<identity>/<step>``."""
+
+    def __init__(self, root: str, identity: str = "run",
+                 max_to_keep: int = 3, save_every: int = 1):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        path = os.path.abspath(os.path.join(root, identity))
+        os.makedirs(path, exist_ok=True)
+        self.directory = path
+        self.save_every = max(1, save_every)
+        self.mgr = ocp.CheckpointManager(
+            path,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+            ),
+        )
+
+    def save(self, round_idx: int, state: Any, force: bool = False) -> bool:
+        """Save ``state`` under step ``round_idx`` (respects save_every)."""
+        if not force and round_idx % self.save_every:
+            return False
+        self.mgr.save(
+            round_idx, args=self._ocp.args.StandardSave(state))
+        self.mgr.wait_until_finished()
+        return True
+
+    def latest_step(self) -> Optional[int]:
+        return self.mgr.latest_step()
+
+    def restore_latest(self, template: Any) -> Optional[Tuple[Any, int]]:
+        """Restore the newest checkpoint, shaped like ``template`` (an
+        ``algo.init_state(...)`` pytree); returns (state, round_idx) or
+        None when the directory is empty."""
+        step = self.mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") else x,
+            template,
+        )
+        state = self.mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract))
+        logger.info("restored checkpoint step %d from %s", step,
+                    self.directory)
+        return state, step
+
+    def close(self) -> None:
+        self.mgr.close()

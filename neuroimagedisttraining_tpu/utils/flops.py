@@ -1,0 +1,187 @@
+"""Cost accounting: FLOPs + communication-parameter counters.
+
+Rebuild of the reference's sparsity-aware FLOPs counter
+(``fedml_api/utils/main_flops_counter.py:30-159``) with two upgrades:
+
+* **Exact compiled FLOPs** straight from XLA's cost model
+  (``jit(f).lower(...).compile().cost_analysis()``) — covers every op the
+  model actually runs, on any backend.
+* **Any-rank analytical counter** — the reference's hook-based counter only
+  handles Conv2d/Linear and has no input-resolution entry for ABCD, so the
+  flagship 3D path could never be counted (SalientGrads approximates FLOPs
+  as ``epochs*samples``, ``sailentgrads/client.py:70-76``). Here per-layer
+  FLOPs are derived from parameter/activation *shapes* via ``jax.eval_shape``
+  + ``capture_intermediates`` — Conv1d/2d/3d and Dense all fall out of the
+  same formula, and the sparsity scaling honors each layer's nonzero
+  fraction (``(w != 0).sum()`` semantics).
+
+``count_training_flops = 3 x inference`` keeps the reference's convention
+(``main_flops_counter.py:146-157``); nonzero-weight communication-size
+accounting mirrors ``ModelTrainer.count_communication_params``
+(``fedml_core/trainer/model_trainer.py:49-53``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TRAIN_TO_INFER_RATIO = 3.0  # fwd + bwd ~= 3x fwd (reference convention)
+
+
+# -- exact XLA cost -----------------------------------------------------------
+
+def xla_cost_analysis(fn, *example_args) -> Dict[str, float]:
+    """FLOPs / bytes of the compiled ``fn`` from XLA's cost model."""
+    compiled = jax.jit(fn).lower(*example_args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returned [dict]
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def inference_flops_xla(apply_fn, params, sample_shape: Tuple[int, ...],
+                        batch_size: int = 1) -> float:
+    x = jnp.zeros((batch_size,) + tuple(sample_shape), jnp.float32)
+    cost = xla_cost_analysis(
+        lambda p, xb: apply_fn(p, xb, train=False, rng=None), params, x)
+    return float(cost.get("flops", 0.0))
+
+
+# -- analytical per-layer (sparsity-aware) ------------------------------------
+
+def _walk(tree, path=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, path + (k,))
+    else:
+        yield path, tree
+
+
+def _lookup(tree, path):
+    for k in path:
+        if not isinstance(tree, dict) or k not in tree:
+            return None
+        tree = tree[k]
+    return tree
+
+
+def per_layer_flops(model, params, sample_shape: Tuple[int, ...]
+                    ) -> Dict[Tuple[str, ...], float]:
+    """Per-sample dense FLOPs for every parametric layer (conv of any
+    rank + dense), keyed by the layer's param-tree path."""
+    x = jax.ShapeDtypeStruct((1,) + tuple(sample_shape), jnp.float32)
+
+    def fwd(p, xb):
+        return model.apply({"params": p}, xb, train=False,
+                           capture_intermediates=True)
+
+    _, state = jax.eval_shape(fwd, params, x)
+    inter = state["intermediates"]
+
+    out: Dict[Tuple[str, ...], float] = {}
+    for path, leaf in _walk(params):
+        if path[-1] != "kernel":
+            continue
+        layer_path = path[:-1]
+        called = _lookup(inter, layer_path)
+        kshape = tuple(leaf.shape)
+        if called is not None and "__call__" in called:
+            y = called["__call__"][0]
+            yshape = tuple(np.asarray(y.shape, dtype=np.int64))
+        else:
+            yshape = None
+        if len(kshape) >= 3:  # conv kernel: (*window, Cin/groups, Cout)
+            if yshape is None:
+                continue
+            out_spatial = int(np.prod(yshape[1:-1]))
+            out[layer_path] = 2.0 * out_spatial * float(np.prod(kshape))
+        elif len(kshape) == 2:  # dense: (in, out)
+            mult = 1.0
+            if yshape is not None and len(yshape) > 2:
+                mult = float(np.prod(yshape[1:-1]))
+            out[layer_path] = 2.0 * mult * float(np.prod(kshape))
+    return out
+
+
+def nonzero_fraction(params, mask=None) -> Dict[Tuple[str, ...], float]:
+    """Per-layer nonzero fraction of kernels (after masking)."""
+    fracs: Dict[Tuple[str, ...], float] = {}
+    for path, leaf in _walk(params):
+        if path[-1] != "kernel":
+            continue
+        w = np.asarray(leaf)
+        if mask is not None:
+            m = _lookup(mask, path)
+            if m is not None:
+                w = w * np.asarray(m)
+        total = w.size or 1
+        fracs[path[:-1]] = float(np.count_nonzero(w)) / total
+    return fracs
+
+
+def inference_flops(model, params, sample_shape: Tuple[int, ...],
+                    mask=None) -> float:
+    """Per-sample analytical inference FLOPs, honoring weight sparsity."""
+    dense = per_layer_flops(model, params, sample_shape)
+    fracs = nonzero_fraction(params, mask)
+    return float(sum(f * fracs.get(p, 1.0) for p, f in dense.items()))
+
+
+def training_flops(model, params, sample_shape, mask=None,
+                   n_samples: int = 1) -> float:
+    return TRAIN_TO_INFER_RATIO * n_samples * inference_flops(
+        model, params, sample_shape, mask)
+
+
+# -- communication accounting -------------------------------------------------
+
+def count_params(params) -> int:
+    return int(sum(np.prod(l.shape) for _, l in _walk(params)
+                   if hasattr(l, "shape")))
+
+
+def count_communication_params(params, mask=None) -> int:
+    """Nonzero elements actually shipped (model_trainer.py:49-53)."""
+    total = 0
+    for path, leaf in _walk(params):
+        w = np.asarray(leaf)
+        if mask is not None:
+            m = _lookup(mask, path)
+            if m is not None:
+                w = w * np.asarray(m)
+        total += int(np.count_nonzero(w))
+    return total
+
+
+# -- per-round stat_info counters ---------------------------------------------
+
+class CostTracker:
+    """Cumulative FLOPs/comm counters, the rebuild of ``stat_info``'s
+    ``sum_training_flops`` / ``sum_comm_params``
+    (``sailentgrads_api.py:137-138,334-346``)."""
+
+    def __init__(self, model=None, sample_shape: Optional[Tuple[int, ...]] = None):
+        self.model = model
+        self.sample_shape = sample_shape
+        self.sum_training_flops = 0.0
+        self.sum_comm_params = 0
+        self.per_round: list = []
+
+    def record_round(self, params, mask=None, n_clients: int = 1,
+                     samples_per_client: int = 1) -> Dict[str, float]:
+        flops = 0.0
+        if self.model is not None and self.sample_shape is not None:
+            flops = n_clients * training_flops(
+                self.model, params, self.sample_shape, mask,
+                n_samples=samples_per_client)
+        comm = n_clients * count_communication_params(params, mask)
+        self.sum_training_flops += flops
+        self.sum_comm_params += comm
+        rec = {"training_flops": flops, "comm_params": comm,
+               "sum_training_flops": self.sum_training_flops,
+               "sum_comm_params": self.sum_comm_params}
+        self.per_round.append(rec)
+        return rec

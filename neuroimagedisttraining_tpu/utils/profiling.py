@@ -1,0 +1,62 @@
+"""Runtime profiling hooks (the reference has none — SURVEY §5.1).
+
+Wraps ``jax.profiler`` so any federated round can be captured as an XLA
+trace viewable in TensorBoard/Perfetto, plus a lightweight wall-clock timer
+used by the benchmark harness.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Dict
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``with trace("/tmp/prof"):`` — captures an XLA/host trace."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def trace_one_round(algo, state, log_dir: str, round_idx: int = 0) -> None:
+    """Profile a single federated round (compile excluded: one warm-up
+    round runs first so the trace shows steady-state device time)."""
+    state2, _ = algo.run_round(state, round_idx)
+    jax.block_until_ready(jax.tree_util.tree_leaves(state2)[0])
+    with trace(log_dir):
+        state3, metrics = algo.run_round(state2, round_idx + 1)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state3)[0])
+    logger.info("wrote profiler trace for one round to %s", log_dir)
+
+
+class Timer:
+    """Accumulating wall-clock timer with named sections."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            name: {"total_s": tot, "count": self.counts[name],
+                   "mean_s": tot / self.counts[name]}
+            for name, tot in self.totals.items()
+        }

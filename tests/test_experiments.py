@@ -1,0 +1,189 @@
+"""L5 experiments/CLI layer: flags, identity, runner, checkpoint/resume,
+cost accounting."""
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.experiments import (
+    ALGO_NAMES,
+    parse_args,
+    run_experiment,
+    run_identity,
+)
+
+
+def _argv(tmp_path, algo="fedavg", **over):
+    base = {
+        "--model": "small3dcnn",
+        "--dataset": "synthetic",
+        "--client_num_in_total": "4",
+        "--batch_size": "8",
+        "--epochs": "1",
+        "--comm_round": "2",
+        "--lr": "0.05",
+        "--log_dir": str(tmp_path / "LOG"),
+        "--results_dir": str(tmp_path / "results"),
+    }
+    base.update({k: str(v) for k, v in over.items()})
+    argv = []
+    for k, v in base.items():
+        argv += [k, v]
+    return argv
+
+
+def test_parse_and_identity(tmp_path):
+    args = parse_args(_argv(tmp_path) + ["--frac", "0.5"], algo="salientgrads")
+    assert args.client_num_per_round == 2
+    ident = run_identity(args, "salientgrads")
+    assert "salientgrads" in ident and "synthetic" in ident
+    assert "seed0" in ident
+
+
+def test_ci_mode_caps_rounds(tmp_path):
+    args = parse_args(_argv(tmp_path, **{"--comm_round": 50, "--ci": 1}))
+    assert args.comm_round == 2
+
+
+@pytest.mark.parametrize("algo", ["fedavg", "salientgrads", "ditto"])
+def test_run_experiment_smoke(tmp_path, algo):
+    args = parse_args(_argv(tmp_path), algo=algo)
+    out = run_experiment(args, algo)
+    assert len(out["history"]) == 2
+    losses = [h["train_loss"] for h in out["history"]]
+    assert all(np.isfinite(l) for l in losses)
+    # stat_info artifact written (subavg_api.py:218-221 semantics)
+    assert out["stat_path"] and os.path.exists(out["stat_path"])
+    with open(out["stat_path"], "rb") as f:
+        stat = pickle.load(f)
+    assert stat["config"]["model"] == "small3dcnn"
+    assert len(stat["history"]) == 2
+    # per-run file log exists, keyed by identity
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "LOG"), out["identity"] + ".log"))
+
+
+def test_fedfomo_via_cli(tmp_path):
+    args = parse_args(_argv(tmp_path, **{"--val_fraction": 0.2}),
+                      algo="fedfomo")
+    out = run_experiment(args, "fedfomo")
+    assert np.isfinite(out["history"][-1]["train_loss"])
+
+
+def test_unified_main_algo_flag(tmp_path):
+    args = parse_args(_argv(tmp_path) + ["--algo", "local"])
+    out = run_experiment(args)
+    assert len(out["history"]) == 2
+
+
+def test_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "ckpt")
+    argv = _argv(tmp_path, **{"--comm_round": 3, "--checkpoint_dir": ck})
+    args = parse_args(argv, algo="fedavg")
+    out1 = run_experiment(args, "fedavg")
+    # resume with a larger total budget: picks up at round 3, runs 3..4
+    args2 = parse_args(argv + ["--resume", "--comm_round", "5"],
+                       algo="fedavg")
+    out2 = run_experiment(args2, "fedavg")
+    rounds2 = [h["round"] for h in out2["history"]]
+    assert rounds2 == [3, 4], f"resume should continue at round 3, got {rounds2}"
+    # checkpoint lineage is shared even though r{comm_round} differs
+    from neuroimagedisttraining_tpu.experiments.config import run_identity as ri
+    assert ri(args, "fedavg", for_checkpoint=True) == \
+        ri(args2, "fedavg", for_checkpoint=True)
+
+
+def test_identity_stable_across_entry_points(tmp_path):
+    """Unified --algo CLI and per-algo main must agree on identity, else
+    resume/log/stat paths diverge."""
+    argv = _argv(tmp_path)
+    unified = parse_args(argv + ["--algo", "fedavg"])
+    per_algo = parse_args(argv, algo="fedavg")
+    assert run_identity(unified, "fedavg") == run_identity(per_algo, "fedavg")
+    assert run_identity(unified, "fedavg", for_checkpoint=True) == \
+        run_identity(per_algo, "fedavg", for_checkpoint=True)
+
+
+def test_sequential_runs_no_log_crosstalk(tmp_path):
+    """Per-run file handlers are detached after each run."""
+    args1 = parse_args(_argv(tmp_path) + ["--tag", "one"], algo="local")
+    args2 = parse_args(_argv(tmp_path) + ["--tag", "two"], algo="local")
+    out1 = run_experiment(args1, "local")
+    out2 = run_experiment(args2, "local")
+    log1 = os.path.join(str(tmp_path / "LOG"), out1["identity"] + ".log")
+    with open(log1) as f:
+        content = f.read()
+    assert out2["identity"] not in content, "run 2 wrote into run 1's log"
+
+
+def test_all_algos_parse(tmp_path):
+    for algo in ALGO_NAMES:
+        args = parse_args(_argv(tmp_path), algo=algo)
+        assert args.comm_round == 2
+
+
+def test_flops_counter_3d():
+    import jax
+
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+    from neuroimagedisttraining_tpu.utils.flops import (
+        count_communication_params,
+        count_params,
+        inference_flops,
+        per_layer_flops,
+    )
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    layers = per_layer_flops(model, params, (8, 8, 8, 1))
+    assert layers, "expected conv/dense layers counted"
+    dense_total = inference_flops(model, params, (8, 8, 8, 1))
+    assert dense_total > 0
+    # masking half the weights must reduce counted FLOPs
+    mask = jax.tree_util.tree_map(
+        lambda x: (jax.random.uniform(jax.random.PRNGKey(1), x.shape) > 0.5
+                   ).astype(x.dtype),
+        params,
+    )
+    sparse_total = inference_flops(model, params, (8, 8, 8, 1), mask=mask)
+    assert sparse_total < dense_total
+    assert count_communication_params(params, mask) < count_params(params)
+
+
+def test_flops_xla_matches_analytical_order():
+    import jax
+
+    from neuroimagedisttraining_tpu.models import (
+        create_model,
+        init_params,
+        make_apply_fn,
+    )
+    from neuroimagedisttraining_tpu.utils.flops import (
+        inference_flops,
+        inference_flops_xla,
+    )
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    analytical = inference_flops(model, params, (8, 8, 8, 1))
+    xla = inference_flops_xla(make_apply_fn(model), params, (8, 8, 8, 1))
+    if xla > 0:  # cost model availability varies by backend
+        assert xla >= analytical * 0.5  # same order: XLA counts all ops
+
+
+def test_cost_tracker_accumulates():
+    import jax
+
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+    from neuroimagedisttraining_tpu.utils.flops import CostTracker
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    tracker = CostTracker(model, (8, 8, 8, 1))
+    r1 = tracker.record_round(params, n_clients=4, samples_per_client=8)
+    r2 = tracker.record_round(params, n_clients=4, samples_per_client=8)
+    assert r2["sum_training_flops"] == pytest.approx(
+        2 * r1["training_flops"])
+    assert r2["sum_comm_params"] == 2 * r1["comm_params"]
