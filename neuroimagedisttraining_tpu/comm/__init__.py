@@ -1,0 +1,30 @@
+"""Distributed communication layer.
+
+In-mesh training uses XLA collectives over ICI (``parallel/``); this package
+is the *cross-silo* message layer — the rebuild of
+``fedml_core/distributed/`` (Message, Observer, client/server managers,
+MPI/gRPC/MQTT backends) with a native C++ TCP transport
+(``native/comm/tcp_comm.cpp``) plus an in-process backend for simulation.
+"""
+from .base import BaseCommunicationManager, Observer
+from .cross_silo import CrossSiloClient, CrossSiloServer
+from .local import LocalCommManager, LocalRouter
+from .manager import ClientManager, DistributedManager, ServerManager
+from .message import Message
+from .tcp import TcpCommManager, build_native, native_available
+
+__all__ = [
+    "BaseCommunicationManager",
+    "ClientManager",
+    "CrossSiloClient",
+    "CrossSiloServer",
+    "DistributedManager",
+    "LocalCommManager",
+    "LocalRouter",
+    "Message",
+    "Observer",
+    "ServerManager",
+    "TcpCommManager",
+    "build_native",
+    "native_available",
+]

@@ -1,0 +1,55 @@
+"""Backend-neutral comm manager ABC + Observer.
+
+Rebuild of ``fedml_core/distributed/communication/base_com_manager.py:7-27``
+and ``observer.py:4-7``.
+"""
+from __future__ import annotations
+
+import abc
+import logging
+from typing import List
+
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    """send/receive + observer dispatch contract."""
+
+    def __init__(self):
+        self._observers: List[Observer] = []
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Run the receive loop, dispatching to observers until stopped."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            try:
+                obs.receive_message(msg.type, msg)
+            except Exception:
+                # a failing handler must not kill the rank's receive pump —
+                # log with traceback and keep serving later messages
+                logger.exception(
+                    "handler for %r raised; receive loop continues", msg.type)

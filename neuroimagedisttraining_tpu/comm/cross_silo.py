@@ -1,0 +1,119 @@
+"""Cross-silo federated training over a real transport.
+
+The deployment adapter SURVEY §5.8/§7.9 calls for: the same FedAvg
+aggregation semantics as the in-mesh path (sample-weighted parameter mean,
+``fedavg_api.py:102-117``), but with clients on separate processes/hosts
+exchanging Messages over a comm backend (native TCP or in-process). In-mesh
+SPMD remains the perf path; this layer exists so a real multi-hospital
+deployment has a transport with the same math.
+
+Protocol (star topology, server = rank 0):
+  server --MSG_TYPE_GLOBAL_MODEL{round}--> each client
+  client --MSG_TYPE_LOCAL_UPDATE{round, n_samples, params}--> server
+  ... comm_round times ... then server --MSG_TYPE_FINISH--> clients
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .manager import ClientManager, ServerManager
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+# local_train_fn(params, round_idx) -> (new_params, n_samples, train_loss)
+LocalTrainFn = Callable[[Any, int], Tuple[Any, int, float]]
+
+
+class CrossSiloServer(ServerManager):
+    """Rank-0 aggregator."""
+
+    def __init__(self, comm, world_size: int, global_params: Any):
+        super().__init__(comm, rank=0, world_size=world_size)
+        self.global_params = global_params
+        self._updates: "queue.Queue[Message]" = queue.Queue()
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_LOCAL_UPDATE, self._updates.put)
+        self.history: List[Dict[str, float]] = []
+
+    def run_round(self, round_idx: int, timeout_s: float = 120.0) -> Dict[str, float]:
+        for dest in range(1, self.world_size):
+            msg = Message(Message.MSG_TYPE_GLOBAL_MODEL, 0, dest)
+            msg.add("round", round_idx)
+            msg.add_tensor("params", self.global_params)
+            self.send_message(msg)
+        updates: List[Tuple[Any, float]] = []
+        losses: List[float] = []
+        seen: set = set()
+        while len(updates) < self.world_size - 1:
+            msg = self._updates.get(timeout=timeout_s)
+            # drop stragglers from earlier rounds and duplicate senders —
+            # averaging a stale round-r update into round r+1 would silently
+            # corrupt the global model
+            if int(msg.get("round", -1)) != round_idx:
+                logger.warning(
+                    "dropping stale update from rank %d (round %s != %d)",
+                    msg.sender_id, msg.get("round"), round_idx)
+                continue
+            if msg.sender_id in seen:
+                logger.warning("duplicate update from rank %d dropped",
+                               msg.sender_id)
+                continue
+            seen.add(msg.sender_id)
+            updates.append((msg.get_tensor("params"),
+                            float(msg.get("n_samples"))))
+            losses.append(float(msg.get("train_loss", float("nan"))))
+        total = sum(w for _, w in updates)
+        weights = [w / total for _, w in updates]
+        # sample-weighted FedAvg sum (fedavg_api.py:102-117)
+        self.global_params = jax.tree_util.tree_map(
+            lambda *leaves: sum(
+                np.asarray(l) * w for l, w in zip(leaves, weights)),
+            *[u for u, _ in updates],
+        )
+        rec = {"round": round_idx, "train_loss": float(np.nanmean(losses))}
+        self.history.append(rec)
+        return rec
+
+    def train(self, comm_rounds: int) -> Any:
+        for r in range(comm_rounds):
+            rec = self.run_round(r)
+            logger.info("cross-silo round %d: %s", r, rec)
+        for dest in range(1, self.world_size):
+            self.send_message(Message(Message.MSG_TYPE_FINISH, 0, dest))
+        return self.global_params
+
+
+class CrossSiloClient(ClientManager):
+    """Rank >=1 local trainer."""
+
+    def __init__(self, comm, rank: int, world_size: int,
+                 local_train_fn: LocalTrainFn):
+        super().__init__(comm, rank=rank, world_size=world_size)
+        self.local_train_fn = local_train_fn
+        self.done = threading.Event()
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_GLOBAL_MODEL, self._on_global_model)
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_FINISH, self._on_finish)
+
+    def _on_global_model(self, msg: Message) -> None:
+        round_idx = int(msg.get("round"))
+        params = msg.get_tensor("params")
+        new_params, n_samples, loss = self.local_train_fn(params, round_idx)
+        reply = Message(Message.MSG_TYPE_LOCAL_UPDATE, self.rank, 0)
+        reply.add("round", round_idx)
+        reply.add("n_samples", int(n_samples))
+        reply.add("train_loss", float(loss))
+        reply.add_tensor("params", new_params)
+        self.send_message(reply)
+
+    def _on_finish(self, msg: Message) -> None:
+        self.done.set()
+        self.comm.stop_receive_message()
