@@ -61,6 +61,14 @@ class DistributedManager(Observer):
         self.comm.stop_receive_message()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a handler is still running; finalizing the backend under
+                # it would hand a freed native handle to live code
+                logger.error(
+                    "rank %d: receive pump did not stop within 5s "
+                    "(handler still running?); leaving backend open",
+                    self.rank)
+                return
             self._thread = None
         finalize = getattr(self.comm, "finalize", None)
         if finalize is not None:

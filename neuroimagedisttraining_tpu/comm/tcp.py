@@ -36,9 +36,11 @@ _lib: Optional[ctypes.CDLL] = None
 def build_native(force: bool = False) -> str:
     """Compile the C++ transport if needed; returns the .so path."""
     with _lib_lock:
-        if not force and os.path.exists(_LIB_PATH) and \
-                os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-            return _LIB_PATH
+        if not force and os.path.exists(_LIB_PATH):
+            # deployments may ship only the prebuilt .so without native/
+            if not os.path.exists(_SRC) or \
+                    os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+                return _LIB_PATH
         os.makedirs(_BUILD_DIR, exist_ok=True)
         # compile to a per-process temp path, then rename atomically —
         # concurrent ranks on one host must never load a half-written .so
@@ -66,8 +68,10 @@ def _load() -> ctypes.CDLL:
                               ctypes.POINTER(ctypes.c_char_p),
                               ctypes.POINTER(ctypes.c_int)]
     lib.comm_send.restype = ctypes.c_int
+    # buf as c_char_p: ctypes passes the bytes object's buffer directly
+    # (the C side only reads), avoiding a full payload copy per send
     lib.comm_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
-                              ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32]
+                              ctypes.c_char_p, ctypes.c_uint32]
     lib.comm_recv.restype = ctypes.c_int
     lib.comm_recv.argtypes = [ctypes.c_void_p,
                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
@@ -117,8 +121,8 @@ class TcpCommManager(BaseCommunicationManager):
             raise ValueError(
                 f"message payload {len(payload)} bytes exceeds the 4 GiB "
                 "frame limit — shard the pytree across messages")
-        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        rc = self._lib.comm_send(self._h, msg.receiver_id, buf, len(payload))
+        rc = self._lib.comm_send(self._h, msg.receiver_id, payload,
+                                 len(payload))
         if rc != 0:
             raise OSError(f"comm_send to rank {msg.receiver_id} failed ({rc})")
 

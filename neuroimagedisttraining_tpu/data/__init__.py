@@ -48,6 +48,13 @@ def load_federated_data(
             data_dir, dataset=name, partition_method=partition_method,
             partition_alpha=partition_alpha, client_number=client_number,
             val_fraction=val_fraction, seed=seed, **kwargs)
+    if name in ("tiny_imagenet", "tiny-imagenet-200", "tiny"):
+        from .tiny_imagenet import load_partition_data_tiny_imagenet
+
+        return load_partition_data_tiny_imagenet(
+            data_dir, partition_method=partition_method,
+            partition_alpha=partition_alpha, client_number=client_number,
+            val_fraction=val_fraction, seed=seed, **kwargs)
     if name in ("synthetic", "abcd_synth"):
         spc = kwargs.get("samples_per_client", 24)
         val_per_client = (
