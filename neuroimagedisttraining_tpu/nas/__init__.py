@@ -1,0 +1,33 @@
+"""DARTS neural architecture search (rebuild of
+``fedml_api/model/cv/darts/``: search supernet, Gumbel/GDAS variant,
+bilevel architect, genotype derivation, final-training model)."""
+from .architect import Architect, ArchitectState
+from .genotypes import DARTS, DARTS_V1, DARTS_V2, PRIMITIVES, Genotype
+from .model import GenotypeCell, NetworkFromGenotype
+from .search import (
+    GumbelSearchNetwork,
+    SearchNetwork,
+    derive_genotype,
+    gumbel_weights,
+    init_alphas,
+)
+from .train import search, train_genotype
+
+__all__ = [
+    "Architect",
+    "ArchitectState",
+    "DARTS",
+    "DARTS_V1",
+    "DARTS_V2",
+    "Genotype",
+    "GenotypeCell",
+    "GumbelSearchNetwork",
+    "NetworkFromGenotype",
+    "PRIMITIVES",
+    "SearchNetwork",
+    "derive_genotype",
+    "gumbel_weights",
+    "init_alphas",
+    "search",
+    "train_genotype",
+]

@@ -1,0 +1,80 @@
+"""Bilevel architecture optimization (the DARTS "architect").
+
+Rebuild of ``fedml_api/model/cv/darts/architect.py``. The reference
+implements the unrolled (second-order) gradient by cloning the model,
+hand-editing parameter tensors, and a finite-difference Hessian-vector
+product (``_construct_model_from_theta`` :199-228,
+``_hessian_vector_product`` :229-260). In JAX the unrolled objective
+
+    L_val( w - xi * grad_w L_train(w, a),  a )
+
+is a pure function of ``a``, so ``jax.grad`` differentiates *through* the
+inner SGD step exactly — no model surgery, no finite differences.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# loss_fn(params, alphas, batch, rng) -> scalar
+LossFn = Callable[[Any, Any, Any, jax.Array], jnp.ndarray]
+
+
+class ArchitectState(NamedTuple):
+    alphas: Any
+    opt_state: optax.OptState
+
+
+class Architect:
+    """Owns the arch optimizer (Adam(3e-4, betas=(0.5, 0.999), wd=1e-3),
+    ``train_search.py`` arch_optimizer) and the jitted step functions."""
+
+    def __init__(self, loss_fn: LossFn, arch_lr: float = 3e-4,
+                 arch_weight_decay: float = 1e-3, xi: float = 0.025,
+                 unrolled: bool = True):
+        self.loss_fn = loss_fn
+        self.xi = xi
+        self.unrolled = unrolled
+        self.opt = optax.chain(
+            optax.add_decayed_weights(arch_weight_decay),
+            optax.adam(arch_lr, b1=0.5, b2=0.999),
+        )
+
+        def first_order_grad(params, alphas, val_batch, rng):
+            # architect.py step(unrolled=False) -> _backward_step :163-167
+            return jax.value_and_grad(self.loss_fn, argnums=1)(
+                params, alphas, val_batch, rng)
+
+        def unrolled_grad(params, alphas, train_batch, val_batch, rng):
+            # exact second-order: differentiate through one inner SGD step
+            r1, r2 = jax.random.split(rng)
+
+            def outer(a):
+                g_w = jax.grad(self.loss_fn, argnums=0)(
+                    params, a, train_batch, r1)
+                w_prime = jax.tree_util.tree_map(
+                    lambda w, g: w - self.xi * g, params, g_w)
+                return self.loss_fn(w_prime, a, val_batch, r2)
+
+            return jax.value_and_grad(outer)(alphas)
+
+        def step(arch_state: ArchitectState, params, train_batch, val_batch,
+                 rng) -> Tuple[ArchitectState, jnp.ndarray]:
+            if self.unrolled:
+                val_loss, g = unrolled_grad(
+                    params, arch_state.alphas, train_batch, val_batch, rng)
+            else:
+                val_loss, g = first_order_grad(
+                    params, arch_state.alphas, val_batch, rng)
+            updates, opt_state = self.opt.update(
+                g, arch_state.opt_state, arch_state.alphas)
+            alphas = optax.apply_updates(arch_state.alphas, updates)
+            return ArchitectState(alphas, opt_state), val_loss
+
+        self.step = jax.jit(step)
+
+    def init(self, alphas: Any) -> ArchitectState:
+        return ArchitectState(alphas, self.opt.init(alphas))

@@ -1,0 +1,95 @@
+"""Discrete DARTS network built from a Genotype (final-training model).
+
+Rebuild of ``fedml_api/model/cv/darts/model.py`` (Cell from genotype,
+NetworkCIFAR) minus the auxiliary head (aux towers exist for ImageNet-scale
+training; add when needed).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .genotypes import Genotype
+from .ops import OPS, FactorizedReduce, ReLUConvGN
+
+
+class GenotypeCell(nn.Module):
+    genotype: Genotype
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False,
+                 drop_path_rng: Optional[jax.Array] = None,
+                 drop_path_prob: float = 0.0):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(C_out=self.C)(s0)
+        else:
+            s0 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s0)
+        s1 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s1)
+
+        gene = (self.genotype.reduce if self.reduction
+                else self.genotype.normal)
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        # two edges per intermediate node
+        for i in range(len(gene) // 2):
+            acc = None
+            for k in (2 * i, 2 * i + 1):
+                name, j = gene[k]
+                stride = 2 if self.reduction and j < 2 else 1
+                y = OPS[name](self.C, stride)(states[j])
+                if train and drop_path_prob > 0 and name != "skip_connect" \
+                        and drop_path_rng is not None:
+                    keep = 1.0 - drop_path_prob
+                    key = jax.random.fold_in(drop_path_rng, i * 2 + k)
+                    mask = jax.random.bernoulli(
+                        key, keep, (y.shape[0], 1, 1, 1))
+                    y = y * mask / keep
+                acc = y if acc is None else acc + y
+            states.append(acc)
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class NetworkFromGenotype(nn.Module):
+    """NetworkCIFAR equivalent: stem + genotype cells + GAP + classifier."""
+
+    genotype: Genotype
+    C: int = 36
+    num_classes: int = 10
+    layers: int = 20
+    stem_multiplier: int = 3
+    drop_path_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False,
+                 rng: Optional[jax.Array] = None):
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), use_bias=False)(x)
+        s = nn.GroupNorm(num_groups=1)(s)
+        s0 = s1 = s
+
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = GenotypeCell(
+                genotype=self.genotype, C=C_curr,
+                reduction=reduction, reduction_prev=reduction_prev,
+            )
+            cell_rng = (jax.random.fold_in(rng, i)
+                        if rng is not None else None)
+            s0, s1 = s1, cell(
+                s0, s1, train=train,
+                drop_path_rng=cell_rng, drop_path_prob=self.drop_path_prob)
+            reduction_prev = reduction
+
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)

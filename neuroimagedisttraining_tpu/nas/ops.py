@@ -1,0 +1,150 @@
+"""DARTS primitive operations as flax modules (NHWC).
+
+Rebuild of ``fedml_api/model/cv/darts/operations.py`` (OPS dict, ReLUConvBN,
+SepConv, DilConv, FactorizedReduce, Zero/Identity). Deviations, documented:
+BatchNorm is replaced with GroupNorm throughout — this framework's FL-wide
+normalization choice (no running stats to aggregate; the reference itself
+swaps BN->GN for its FL ResNets, ``resnet.py:91-126``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..models.layers import group_norm
+
+
+def _gn(c: int) -> nn.GroupNorm:
+    return group_norm(c, max_groups=8)
+
+
+class ReLUConvGN(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(self.C_out, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride), use_bias=False)(x)
+        return _gn(self.C_out)(x)
+
+
+class SepConv(nn.Module):
+    """Depthwise-separable conv, applied twice (operations.py SepConv)."""
+
+    C_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        c_in = x.shape[-1]
+        for i, stride in enumerate((self.stride, 1)):
+            c = c_in if i == 0 else self.C_out
+            x = nn.relu(x)
+            x = nn.Conv(c, (self.kernel, self.kernel),
+                        strides=(stride, stride), feature_group_count=c,
+                        use_bias=False)(x)
+            x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+            x = _gn(self.C_out)(x)
+        return x
+
+
+class DilConv(nn.Module):
+    """Dilated depthwise conv + pointwise (operations.py DilConv)."""
+
+    C_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        c_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(c_in, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride),
+                    kernel_dilation=(self.dilation, self.dilation),
+                    feature_group_count=c_in, use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _gn(self.C_out)(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduction via two offset 1x1 convs."""
+
+    C_out: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        a = nn.Conv(self.C_out // 2, (1, 1), strides=(2, 2),
+                    use_bias=False)(x)
+        b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=(2, 2),
+                    use_bias=False)(x[:, 1:, 1:, :])
+        out = jnp.concatenate([a, b], axis=-1)
+        return _gn(self.C_out)(out)
+
+
+class Pool(nn.Module):
+    kind: str  # "max" | "avg"
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        window = (1, 3, 3, 1)
+        strides = (1, self.stride, self.stride, 1)
+        if self.kind == "max":
+            y = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                        constant_values=-jnp.inf)
+            import jax
+
+            y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, window,
+                                      strides, "VALID")
+        else:
+            import jax
+
+            summed = jax.lax.reduce_window(
+                jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0))),
+                0.0, jax.lax.add, window, strides, "VALID")
+            # divide by the true in-bounds window size, not the constant 9 —
+            # torch's count_include_pad=False semantics (the DARTS setting)
+            ones = jnp.pad(jnp.ones_like(x), ((0, 0), (1, 1), (1, 1), (0, 0)))
+            count = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides, "VALID")
+            y = summed / count
+        return _gn(x.shape[-1])(y)
+
+
+class Identity(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
+class Zero(nn.Module):
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        if self.stride == 1:
+            return jnp.zeros_like(x)
+        return jnp.zeros_like(x[:, ::self.stride, ::self.stride, :])
+
+
+# primitive name -> factory(C, stride) (operations.py OPS dict)
+OPS: Dict[str, Callable[[int, int], nn.Module]] = {
+    "none": lambda C, s: Zero(stride=s),
+    "max_pool_3x3": lambda C, s: Pool(kind="max", stride=s),
+    "avg_pool_3x3": lambda C, s: Pool(kind="avg", stride=s),
+    "skip_connect": lambda C, s: (Identity() if s == 1
+                                  else FactorizedReduce(C_out=C)),
+    "sep_conv_3x3": lambda C, s: SepConv(C_out=C, kernel=3, stride=s),
+    "sep_conv_5x5": lambda C, s: SepConv(C_out=C, kernel=5, stride=s),
+    "dil_conv_3x3": lambda C, s: DilConv(C_out=C, kernel=3, stride=s),
+    "dil_conv_5x5": lambda C, s: DilConv(C_out=C, kernel=5, stride=s),
+}

@@ -1,0 +1,190 @@
+"""DARTS search network: continuous relaxation over the op space.
+
+Rebuild of ``fedml_api/model/cv/darts/model_search.py`` (MixedOp :10-24,
+Cell :26-60, Network :172-256, genotype parsing :258-297) and the
+GDAS/Gumbel-softmax variant (``model_search_gdas.py:69-180``).
+
+JAX-idiomatic deltas: architecture parameters are NOT buried inside the
+module — ``apply`` takes ``alphas`` explicitly, so the bilevel architect is
+plain ``jax.grad`` w.r.t. an input (the reference clones whole models and
+hand-edits ``.data`` to differentiate w.r.t. alphas,
+``architect.py:199-228``). Gumbel sampling is a pure function of a PRNG key
+(straight-through hard one-hot optional), not module state + ``set_tau``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .genotypes import PRIMITIVES, Genotype
+from .ops import OPS, FactorizedReduce, ReLUConvGN
+
+
+def n_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class MixedOp(nn.Module):
+    """Softmax-weighted sum over all primitives on one edge."""
+
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, w):
+        outs = [OPS[p](self.C, self.stride)(x) for p in PRIMITIVES]
+        return sum(w[k] * o for k, o in enumerate(outs))
+
+
+class SearchCell(nn.Module):
+    steps: int
+    multiplier: int
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(C_out=self.C)(s0)
+        else:
+            s0 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s0)
+        s1 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s1)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            acc = None
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                y = MixedOp(C=self.C, stride=stride)(h, weights[offset + j])
+                acc = y if acc is None else acc + y
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class SearchNetwork(nn.Module):
+    """The over-parameterized search supernet (model_search.py Network)."""
+
+    C: int = 16
+    num_classes: int = 10
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+
+    @nn.compact
+    def __call__(self, x, alphas: Dict[str, jnp.ndarray],
+                 train: bool = False, rng: Optional[jax.Array] = None,
+                 weights: Optional[Dict[str, jnp.ndarray]] = None):
+        """``alphas`` are logits (softmaxed here); pass ``weights`` to
+        supply pre-computed edge weights instead (the Gumbel variant)."""
+        if weights is None:
+            weights = {
+                "normal": jax.nn.softmax(alphas["normal"], axis=-1),
+                "reduce": jax.nn.softmax(alphas["reduce"], axis=-1),
+            }
+
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), use_bias=False)(x)
+        s = nn.GroupNorm(num_groups=1)(s)
+        s0 = s1 = s
+
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = SearchCell(
+                steps=self.steps, multiplier=self.multiplier, C=C_curr,
+                reduction=reduction, reduction_prev=reduction_prev,
+            )
+            w = weights["reduce"] if reduction else weights["normal"]
+            s0, s1 = s1, cell(s0, s1, w)
+            reduction_prev = reduction
+
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+def init_alphas(steps: int = 4, scale: float = 1e-3,
+                rng: Optional[jax.Array] = None) -> Dict[str, jnp.ndarray]:
+    """1e-3-scaled random logits (model_search.py:232-241)."""
+    e = n_edges(steps)
+    k = len(PRIMITIVES)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    r1, r2 = jax.random.split(rng)
+    return {
+        "normal": scale * jax.random.normal(r1, (e, k)),
+        "reduce": scale * jax.random.normal(r2, (e, k)),
+    }
+
+
+def gumbel_weights(alphas: jnp.ndarray, rng: jax.Array, tau: float = 1.0,
+                   hard: bool = True) -> jnp.ndarray:
+    """GDAS edge weights: softmax((log-alpha + Gumbel)/tau), optionally
+    straight-through hard one-hot (model_search_gdas.py forward)."""
+    g = jax.random.gumbel(rng, alphas.shape)
+    soft = jax.nn.softmax((alphas + g) / tau, axis=-1)
+    if not hard:
+        return soft
+    idx = jnp.argmax(soft, axis=-1)
+    one_hot = jax.nn.one_hot(idx, alphas.shape[-1], dtype=soft.dtype)
+    return soft + jax.lax.stop_gradient(one_hot - soft)
+
+
+class GumbelSearchNetwork(SearchNetwork):
+    """Search net whose edge weights are Gumbel-softmax samples; pass the
+    sampling key + temperature through ``alphas`` pytree extras."""
+
+    @nn.compact
+    def __call__(self, x, alphas, train: bool = False,
+                 rng: Optional[jax.Array] = None, tau: float = 1.0,
+                 hard: bool = True):
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        kn, kr = jax.random.split(key)
+        sampled = {
+            "normal": gumbel_weights(alphas["normal"], kn, tau, hard),
+            "reduce": gumbel_weights(alphas["reduce"], kr, tau, hard),
+        }
+        return super().__call__(x, alphas, train=train, rng=rng,
+                                weights=sampled)
+
+
+def derive_genotype(alphas: Dict[str, Any], steps: int = 4,
+                    multiplier: Optional[int] = None) -> Genotype:
+    """Discretize: per node keep the 2 strongest incoming edges, each with
+    its best non-'none' primitive (model_search.py:263-297)."""
+
+    def _parse(w: np.ndarray) -> List[Tuple[str, int]]:
+        gene: List[Tuple[str, int]] = []
+        none_idx = PRIMITIVES.index("none")
+        offset = 0
+        for i in range(steps):
+            n_in = 2 + i
+            rows = w[offset:offset + n_in]
+            strengths = []
+            for j in range(n_in):
+                probs = np.delete(rows[j], none_idx)
+                strengths.append(probs.max())
+            top2 = np.argsort(strengths)[-2:][::-1]
+            for j in sorted(top2):
+                probs = rows[j].copy()
+                probs[none_idx] = -np.inf
+                gene.append((PRIMITIVES[int(np.argmax(probs))], int(j)))
+            offset += n_in
+        return gene
+
+    if multiplier is None:
+        multiplier = steps
+    w_n = np.asarray(jax.nn.softmax(alphas["normal"], axis=-1))
+    w_r = np.asarray(jax.nn.softmax(alphas["reduce"], axis=-1))
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(normal=_parse(w_n), normal_concat=concat,
+                    reduce=_parse(w_r), reduce_concat=concat)

@@ -1,0 +1,145 @@
+"""DARTS search + final-training drivers.
+
+Rebuild of ``fedml_api/model/cv/darts/train_search.py`` (alternating
+architect/weight steps over train/val splits) and ``train.py`` (training a
+``NetworkFromGenotype``). Both loops are jitted steps driven by a thin host
+loop; batches are drawn by uniform index sampling (the framework's standard
+static-shape batching, core/trainer.py).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .architect import Architect, ArchitectState
+from .genotypes import Genotype
+from .search import SearchNetwork, derive_genotype, init_alphas
+
+logger = logging.getLogger(__name__)
+
+
+def _batch(rng, x, y, batch_size):
+    idx = jax.random.randint(rng, (batch_size,), 0, x.shape[0])
+    return jnp.take(x, idx, axis=0), jnp.take(y, idx, axis=0)
+
+
+def search(
+    x_train, y_train, x_val, y_val,
+    num_classes: int,
+    C: int = 16, layers: int = 8, steps: int = 4,
+    epochs: int = 10, steps_per_epoch: int = 10, batch_size: int = 32,
+    lr: float = 0.025, momentum: float = 0.9, weight_decay: float = 3e-4,
+    arch_lr: float = 3e-4, unrolled: bool = True,
+    seed: int = 0,
+) -> Tuple[Genotype, Dict[str, Any], List[Dict[str, float]]]:
+    """Run DARTS search; returns (genotype, final_alphas, history)."""
+    # multiplier == steps (the DARTS setting): concat exactly the
+    # intermediate nodes, never the two input states
+    net = SearchNetwork(C=C, num_classes=num_classes, layers=layers,
+                        steps=steps, multiplier=steps)
+    key = jax.random.PRNGKey(seed)
+    k_init, k_alpha, key = jax.random.split(key, 3)
+    alphas = init_alphas(steps, rng=k_alpha)
+    x0 = jnp.zeros((1,) + tuple(x_train.shape[1:]), jnp.float32)
+    params = net.init(k_init, x0, alphas)["params"]
+
+    def loss_fn(p, a, batch, rng):
+        xb, yb = batch
+        logits = net.apply({"params": p}, xb, a)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, yb))
+
+    # xi = the weight optimizer's lr: the unrolled virtual step must model
+    # the real inner update (the reference passes the live eta,
+    # architect.py:47-56)
+    architect = Architect(loss_fn, arch_lr=arch_lr, xi=lr,
+                          unrolled=unrolled)
+    arch_state = architect.init(alphas)
+
+    w_opt = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(lr, momentum=momentum),
+    )
+    w_state = w_opt.init(params)
+
+    @jax.jit
+    def weight_step(params, w_state, batch, rng, alphas):
+        loss, g = jax.value_and_grad(loss_fn)(params, alphas, batch, rng)
+        updates, w_state = w_opt.update(g, w_state, params)
+        return optax.apply_updates(params, updates), w_state, loss
+
+    history: List[Dict[str, float]] = []
+    for epoch in range(epochs):
+        train_loss = val_loss = 0.0
+        for s in range(steps_per_epoch):
+            key, k1, k2, k3, k4 = jax.random.split(key, 5)
+            train_batch = _batch(k1, x_train, y_train, batch_size)
+            val_batch = _batch(k2, x_val, y_val, batch_size)
+            arch_state, vl = architect.step(
+                arch_state, params, train_batch, val_batch, k3)
+            params, w_state, tl = weight_step(
+                params, w_state, train_batch, k4, arch_state.alphas)
+            train_loss += float(tl)
+            val_loss += float(vl)
+        rec = {"epoch": epoch,
+               "train_loss": train_loss / steps_per_epoch,
+               "val_loss": val_loss / steps_per_epoch}
+        history.append(rec)
+        logger.info("darts search %s", rec)
+
+    genotype = derive_genotype(arch_state.alphas, steps=steps)
+    return genotype, arch_state.alphas, history
+
+
+def train_genotype(
+    genotype: Genotype, x_train, y_train, num_classes: int,
+    C: int = 16, layers: int = 8,
+    epochs: int = 5, steps_per_epoch: int = 20, batch_size: int = 32,
+    lr: float = 0.025, momentum: float = 0.9, weight_decay: float = 3e-4,
+    drop_path_prob: float = 0.0, seed: int = 0,
+):
+    """Final training of the derived architecture (darts/train.py:58-214)."""
+    from .model import NetworkFromGenotype
+
+    net = NetworkFromGenotype(
+        genotype=genotype, C=C, num_classes=num_classes, layers=layers,
+        drop_path_prob=drop_path_prob)
+    key = jax.random.PRNGKey(seed)
+    k_init, key = jax.random.split(key)
+    x0 = jnp.zeros((1,) + tuple(x_train.shape[1:]), jnp.float32)
+    params = net.init(k_init, x0)["params"]
+
+    opt = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(lr, momentum=momentum),
+    )
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch, rng):
+        xb, yb = batch
+        logits = net.apply({"params": p}, xb, train=True, rng=rng)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, yb))
+
+    @jax.jit
+    def step(params, opt_state, batch, rng):
+        loss, g = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    history = []
+    for epoch in range(epochs):
+        total = 0.0
+        for s in range(steps_per_epoch):
+            key, k1, k2 = jax.random.split(key, 3)
+            batch = _batch(k1, x_train, y_train, batch_size)
+            params, opt_state, loss = step(params, opt_state, batch, k2)
+            total += float(loss)
+        history.append({"epoch": epoch, "train_loss": total / steps_per_epoch})
+        logger.info("darts train %s", history[-1])
+    return net, params, history

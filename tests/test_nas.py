@@ -1,0 +1,111 @@
+"""DARTS NAS suite: ops, search supernet, architect, genotype, final model."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.nas import (
+    DARTS_V2,
+    Genotype,
+    NetworkFromGenotype,
+    PRIMITIVES,
+    SearchNetwork,
+    derive_genotype,
+    gumbel_weights,
+    init_alphas,
+    search,
+    train_genotype,
+)
+from neuroimagedisttraining_tpu.nas.search import n_edges
+
+
+def _toy_data(n=64, hw=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = rng.randn(n, hw, hw, 3).astype(np.float32) * 0.1
+    # class-dependent mean shift makes the task learnable
+    x += y[:, None, None, None] * 0.5
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_ops_registry_shapes():
+    from neuroimagedisttraining_tpu.nas.ops import OPS
+
+    x = jnp.ones((2, 8, 8, 6))
+    for name in PRIMITIVES:
+        for stride in (1, 2):
+            op = OPS[name](6, stride)
+            params = op.init(jax.random.PRNGKey(0), x)
+            y = op.apply(params, x)
+            expect_hw = 8 if stride == 1 else 4
+            assert y.shape == (2, expect_hw, expect_hw, 6), \
+                f"{name} stride={stride}: {y.shape}"
+
+
+def test_search_network_forward():
+    net = SearchNetwork(C=4, num_classes=3, layers=4, steps=2, multiplier=2)
+    alphas = init_alphas(steps=2)
+    x = jnp.ones((2, 16, 16, 3))
+    params = net.init(jax.random.PRNGKey(0), x, alphas)["params"]
+    logits = net.apply({"params": params}, x, alphas)
+    assert logits.shape == (2, 3)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_gumbel_weights_hard_one_hot():
+    alphas = jnp.zeros((5, len(PRIMITIVES)))
+    w = gumbel_weights(alphas, jax.random.PRNGKey(0), tau=0.5, hard=True)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), np.ones(5), rtol=1e-5)
+    assert np.allclose(np.sort(np.asarray(w), axis=-1)[:, -1], 1.0)
+    # gradient flows through the straight-through estimator
+    g = jax.grad(lambda a: gumbel_weights(
+        a, jax.random.PRNGKey(0), 0.5, True).sum())(alphas)
+    assert np.any(np.asarray(g) != 0)
+
+
+def test_derive_genotype_valid():
+    steps = 4
+    rng = jax.random.PRNGKey(1)
+    alphas = {
+        "normal": jax.random.normal(rng, (n_edges(steps), len(PRIMITIVES))),
+        "reduce": jax.random.normal(rng, (n_edges(steps), len(PRIMITIVES))),
+    }
+    g = derive_genotype(alphas, steps=steps)
+    assert isinstance(g, Genotype)
+    assert len(g.normal) == 2 * steps and len(g.reduce) == 2 * steps
+    for i in range(steps):
+        for k in (2 * i, 2 * i + 1):
+            name, j = g.normal[k]
+            assert name in PRIMITIVES and name != "none"
+            assert 0 <= j < 2 + i  # edge from an earlier state only
+
+
+def test_search_learns_and_derives(caplog):
+    x, y = _toy_data()
+    genotype, alphas, hist = search(
+        x[:48], y[:48], x[48:], y[48:], num_classes=4,
+        C=4, layers=2, steps=2, epochs=2, steps_per_epoch=3,
+        batch_size=16, unrolled=True, seed=0)
+    assert len(hist) == 2
+    assert np.isfinite(hist[-1]["train_loss"])
+    assert isinstance(genotype, Genotype)
+
+
+def test_first_order_architect_runs():
+    x, y = _toy_data(n=32)
+    genotype, _, hist = search(
+        x[:24], y[:24], x[24:], y[24:], num_classes=4,
+        C=4, layers=2, steps=2, epochs=1, steps_per_epoch=2,
+        batch_size=8, unrolled=False, seed=1)
+    assert np.isfinite(hist[-1]["val_loss"])
+
+
+def test_train_genotype_from_preset_and_derived():
+    x, y = _toy_data(n=48)
+    net, params, hist = train_genotype(
+        DARTS_V2, x, y, num_classes=4, C=4, layers=2,
+        epochs=2, steps_per_epoch=4, batch_size=16,
+        drop_path_prob=0.1, seed=0)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"] * 1.5
+    logits = net.apply({"params": params}, x[:4])
+    assert logits.shape == (4, 4)
