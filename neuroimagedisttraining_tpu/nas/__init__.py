@@ -4,7 +4,7 @@ bilevel architect, genotype derivation, final-training model)."""
 from .architect import Architect, ArchitectState
 from .genotypes import DARTS, DARTS_V1, DARTS_V2, PRIMITIVES, Genotype
 from .model import GenotypeCell, NetworkFromGenotype
-from .search import (
+from .supernet import (
     GumbelSearchNetwork,
     SearchNetwork,
     derive_genotype,

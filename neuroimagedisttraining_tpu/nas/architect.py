@@ -34,6 +34,7 @@ class Architect:
 
     def __init__(self, loss_fn: LossFn, arch_lr: float = 3e-4,
                  arch_weight_decay: float = 1e-3, xi: float = 0.025,
+                 w_momentum: float = 0.0, w_weight_decay: float = 0.0,
                  unrolled: bool = True):
         self.loss_fn = loss_fn
         self.xi = xi
@@ -48,24 +49,32 @@ class Architect:
             return jax.value_and_grad(self.loss_fn, argnums=1)(
                 params, alphas, val_batch, rng)
 
-        def unrolled_grad(params, alphas, train_batch, val_batch, rng):
-            # exact second-order: differentiate through one inner SGD step
+        def unrolled_grad(params, mom_buf, alphas, train_batch, val_batch,
+                          rng):
+            # exact second-order: differentiate through one inner SGD step.
+            # The virtual step mirrors the REAL weight update including its
+            # momentum buffer and weight decay (architect.py
+            # _compute_unrolled_model :32-45: theta - eta*(momentum*buf +
+            # dtheta + wd*theta)).
             r1, r2 = jax.random.split(rng)
 
             def outer(a):
                 g_w = jax.grad(self.loss_fn, argnums=0)(
                     params, a, train_batch, r1)
                 w_prime = jax.tree_util.tree_map(
-                    lambda w, g: w - self.xi * g, params, g_w)
+                    lambda w, m, g: w - self.xi * (
+                        w_momentum * m + g + w_weight_decay * w),
+                    params, mom_buf, g_w)
                 return self.loss_fn(w_prime, a, val_batch, r2)
 
             return jax.value_and_grad(outer)(alphas)
 
-        def step(arch_state: ArchitectState, params, train_batch, val_batch,
-                 rng) -> Tuple[ArchitectState, jnp.ndarray]:
+        def step(arch_state: ArchitectState, params, mom_buf, train_batch,
+                 val_batch, rng) -> Tuple[ArchitectState, jnp.ndarray]:
             if self.unrolled:
                 val_loss, g = unrolled_grad(
-                    params, arch_state.alphas, train_batch, val_batch, rng)
+                    params, mom_buf, arch_state.alphas, train_batch,
+                    val_batch, rng)
             else:
                 val_loss, g = first_order_grad(
                     params, arch_state.alphas, val_batch, rng)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from .genotypes import Genotype
-from .ops import OPS, FactorizedReduce, ReLUConvGN
+from .ops import OPS_EVAL, FactorizedReduce, ReLUConvGN
 
 
 class GenotypeCell(nn.Module):
@@ -43,8 +43,13 @@ class GenotypeCell(nn.Module):
             for k in (2 * i, 2 * i + 1):
                 name, j = gene[k]
                 stride = 2 if self.reduction and j < 2 else 1
-                y = OPS[name](self.C, stride)(states[j])
-                if train and drop_path_prob > 0 and name != "skip_connect" \
+                y = OPS_EVAL[name](self.C, stride)(states[j])
+                # only the parameterless stride-1 Identity skip is exempt
+                # from drop-path (reference model.py checks
+                # isinstance(op, Identity); a reduce-cell skip_connect is a
+                # FactorizedReduce and IS dropped)
+                is_identity = name == "skip_connect" and stride == 1
+                if train and drop_path_prob > 0 and not is_identity \
                         and drop_path_rng is not None:
                     keep = 1.0 - drop_path_prob
                     key = jax.random.fold_in(drop_path_rng, i * 2 + k)

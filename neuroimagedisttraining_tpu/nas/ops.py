@@ -5,32 +5,45 @@ SepConv, DilConv, FactorizedReduce, Zero/Identity). Deviations, documented:
 BatchNorm is replaced with GroupNorm throughout — this framework's FL-wide
 normalization choice (no running stats to aggregate; the reference itself
 swaps BN->GN for its FL ResNets, ``resnet.py:91-126``).
+
+Norm policy follows the reference's affine split: the *search* registry
+(``OPS``) builds every norm with ``affine=False`` (model_search passes
+``affine=False`` into operations.py so no op can rescale itself and bias
+the alpha comparison), while the *eval* registry (``OPS_EVAL``) uses
+affine norms and — like the reference's final model — no norm after
+pooling ops.
 """
 from __future__ import annotations
 
 from typing import Callable, Dict
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..models.layers import group_norm
 
 
-def _gn(c: int) -> nn.GroupNorm:
-    return group_norm(c, max_groups=8)
+def _gn(c: int, affine: bool = True) -> nn.GroupNorm:
+    g = group_norm(c, max_groups=8)
+    if affine:
+        return g
+    return nn.GroupNorm(num_groups=g.num_groups, use_bias=False,
+                        use_scale=False)
 
 
 class ReLUConvGN(nn.Module):
     C_out: int
     kernel: int
     stride: int
+    affine: bool = True
 
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
         x = nn.Conv(self.C_out, (self.kernel, self.kernel),
                     strides=(self.stride, self.stride), use_bias=False)(x)
-        return _gn(self.C_out)(x)
+        return _gn(self.C_out, self.affine)(x)
 
 
 class SepConv(nn.Module):
@@ -39,6 +52,7 @@ class SepConv(nn.Module):
     C_out: int
     kernel: int
     stride: int
+    affine: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -50,7 +64,7 @@ class SepConv(nn.Module):
                         strides=(stride, stride), feature_group_count=c,
                         use_bias=False)(x)
             x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
-            x = _gn(self.C_out)(x)
+            x = _gn(self.C_out, self.affine)(x)
         return x
 
 
@@ -61,6 +75,7 @@ class DilConv(nn.Module):
     kernel: int
     stride: int
     dilation: int = 2
+    affine: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -71,13 +86,14 @@ class DilConv(nn.Module):
                     kernel_dilation=(self.dilation, self.dilation),
                     feature_group_count=c_in, use_bias=False)(x)
         x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
-        return _gn(self.C_out)(x)
+        return _gn(self.C_out, self.affine)(x)
 
 
 class FactorizedReduce(nn.Module):
     """Stride-2 channel-preserving reduction via two offset 1x1 convs."""
 
     C_out: int
+    affine: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -87,12 +103,14 @@ class FactorizedReduce(nn.Module):
         b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=(2, 2),
                     use_bias=False)(x[:, 1:, 1:, :])
         out = jnp.concatenate([a, b], axis=-1)
-        return _gn(self.C_out)(out)
+        return _gn(self.C_out, self.affine)(out)
 
 
 class Pool(nn.Module):
-    kind: str  # "max" | "avg"
+    kind: str       # "max" | "avg"
     stride: int
+    norm: str = "none"  # "none" | "nonaffine" (search MixedOp wraps pools
+    #                     in BN(affine=False); the eval model uses bare pools)
 
     @nn.compact
     def __call__(self, x):
@@ -101,13 +119,9 @@ class Pool(nn.Module):
         if self.kind == "max":
             y = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
                         constant_values=-jnp.inf)
-            import jax
-
             y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, window,
                                       strides, "VALID")
         else:
-            import jax
-
             summed = jax.lax.reduce_window(
                 jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0))),
                 0.0, jax.lax.add, window, strides, "VALID")
@@ -117,7 +131,9 @@ class Pool(nn.Module):
             count = jax.lax.reduce_window(
                 ones, 0.0, jax.lax.add, window, strides, "VALID")
             y = summed / count
-        return _gn(x.shape[-1])(y)
+        if self.norm == "nonaffine":
+            y = _gn(x.shape[-1], affine=False)(y)
+        return y
 
 
 class Identity(nn.Module):
@@ -136,13 +152,34 @@ class Zero(nn.Module):
         return jnp.zeros_like(x[:, ::self.stride, ::self.stride, :])
 
 
-# primitive name -> factory(C, stride) (operations.py OPS dict)
-OPS: Dict[str, Callable[[int, int], nn.Module]] = {
+OpFactory = Callable[[int, int], nn.Module]
+
+# search registry: affine=False everywhere, pools normalized (MixedOp)
+OPS: Dict[str, OpFactory] = {
+    "none": lambda C, s: Zero(stride=s),
+    "max_pool_3x3": lambda C, s: Pool(kind="max", stride=s,
+                                      norm="nonaffine"),
+    "avg_pool_3x3": lambda C, s: Pool(kind="avg", stride=s,
+                                      norm="nonaffine"),
+    "skip_connect": lambda C, s: (
+        Identity() if s == 1 else FactorizedReduce(C_out=C, affine=False)),
+    "sep_conv_3x3": lambda C, s: SepConv(C_out=C, kernel=3, stride=s,
+                                         affine=False),
+    "sep_conv_5x5": lambda C, s: SepConv(C_out=C, kernel=5, stride=s,
+                                         affine=False),
+    "dil_conv_3x3": lambda C, s: DilConv(C_out=C, kernel=3, stride=s,
+                                         affine=False),
+    "dil_conv_5x5": lambda C, s: DilConv(C_out=C, kernel=5, stride=s,
+                                         affine=False),
+}
+
+# eval registry: affine norms, bare pools (reference model.py)
+OPS_EVAL: Dict[str, OpFactory] = {
     "none": lambda C, s: Zero(stride=s),
     "max_pool_3x3": lambda C, s: Pool(kind="max", stride=s),
     "avg_pool_3x3": lambda C, s: Pool(kind="avg", stride=s),
-    "skip_connect": lambda C, s: (Identity() if s == 1
-                                  else FactorizedReduce(C_out=C)),
+    "skip_connect": lambda C, s: (
+        Identity() if s == 1 else FactorizedReduce(C_out=C)),
     "sep_conv_3x3": lambda C, s: SepConv(C_out=C, kernel=3, stride=s),
     "sep_conv_5x5": lambda C, s: SepConv(C_out=C, kernel=5, stride=s),
     "dil_conv_3x3": lambda C, s: DilConv(C_out=C, kernel=3, stride=s),

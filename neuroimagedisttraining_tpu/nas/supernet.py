@@ -79,7 +79,6 @@ class SearchNetwork(nn.Module):
 
     @nn.compact
     def __call__(self, x, alphas: Dict[str, jnp.ndarray],
-                 train: bool = False, rng: Optional[jax.Array] = None,
                  weights: Optional[Dict[str, jnp.ndarray]] = None):
         """``alphas`` are logits (softmaxed here); pass ``weights`` to
         supply pre-computed edge weights instead (the Gumbel variant)."""
@@ -144,17 +143,19 @@ class GumbelSearchNetwork(SearchNetwork):
     sampling key + temperature through ``alphas`` pytree extras."""
 
     @nn.compact
-    def __call__(self, x, alphas, train: bool = False,
-                 rng: Optional[jax.Array] = None, tau: float = 1.0,
+    def __call__(self, x, alphas, rng: jax.Array, tau: float = 1.0,
                  hard: bool = True):
-        key = rng if rng is not None else jax.random.PRNGKey(0)
-        kn, kr = jax.random.split(key)
+        if rng is None:
+            # a constant fallback key would freeze the sampled architecture
+            # for the whole search — fail loudly instead
+            raise ValueError("GumbelSearchNetwork requires a PRNG key per "
+                             "forward pass")
+        kn, kr = jax.random.split(rng)
         sampled = {
             "normal": gumbel_weights(alphas["normal"], kn, tau, hard),
             "reduce": gumbel_weights(alphas["reduce"], kr, tau, hard),
         }
-        return super().__call__(x, alphas, train=train, rng=rng,
-                                weights=sampled)
+        return super().__call__(x, alphas, weights=sampled)
 
 
 def derive_genotype(alphas: Dict[str, Any], steps: int = 4,

@@ -18,7 +18,7 @@ import optax
 
 from .architect import Architect, ArchitectState
 from .genotypes import Genotype
-from .search import SearchNetwork, derive_genotype, init_alphas
+from .supernet import SearchNetwork, derive_genotype, init_alphas
 
 logger = logging.getLogger(__name__)
 
@@ -54,24 +54,25 @@ def search(
         return jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(logits, yb))
 
-    # xi = the weight optimizer's lr: the unrolled virtual step must model
-    # the real inner update (the reference passes the live eta,
-    # architect.py:47-56)
+    # the unrolled virtual step must model the REAL inner update: same lr
+    # (xi), momentum buffer, and weight decay (the reference passes the live
+    # eta + network optimizer, architect.py:47-56)
     architect = Architect(loss_fn, arch_lr=arch_lr, xi=lr,
+                          w_momentum=momentum, w_weight_decay=weight_decay,
                           unrolled=unrolled)
     arch_state = architect.init(alphas)
 
-    w_opt = optax.chain(
-        optax.add_decayed_weights(weight_decay),
-        optax.sgd(lr, momentum=momentum),
-    )
-    w_state = w_opt.init(params)
+    from ..core.optim import sgd_momentum_step
+    from ..core.state import zeros_like_tree
+
+    mom_buf = zeros_like_tree(params)
 
     @jax.jit
-    def weight_step(params, w_state, batch, rng, alphas):
+    def weight_step(params, mom_buf, batch, rng, alphas):
         loss, g = jax.value_and_grad(loss_fn)(params, alphas, batch, rng)
-        updates, w_state = w_opt.update(g, w_state, params)
-        return optax.apply_updates(params, updates), w_state, loss
+        params, mom_buf = sgd_momentum_step(
+            params, mom_buf, g, jnp.asarray(lr), momentum, weight_decay)
+        return params, mom_buf, loss
 
     history: List[Dict[str, float]] = []
     for epoch in range(epochs):
@@ -81,9 +82,9 @@ def search(
             train_batch = _batch(k1, x_train, y_train, batch_size)
             val_batch = _batch(k2, x_val, y_val, batch_size)
             arch_state, vl = architect.step(
-                arch_state, params, train_batch, val_batch, k3)
-            params, w_state, tl = weight_step(
-                params, w_state, train_batch, k4, arch_state.alphas)
+                arch_state, params, mom_buf, train_batch, val_batch, k3)
+            params, mom_buf, tl = weight_step(
+                params, mom_buf, train_batch, k4, arch_state.alphas)
             train_loss += float(tl)
             val_loss += float(vl)
         rec = {"epoch": epoch,

@@ -16,7 +16,7 @@ from neuroimagedisttraining_tpu.nas import (
     search,
     train_genotype,
 )
-from neuroimagedisttraining_tpu.nas.search import n_edges
+from neuroimagedisttraining_tpu.nas.supernet import n_edges
 
 
 def _toy_data(n=64, hw=8, classes=4, seed=0):
