@@ -49,11 +49,13 @@ class SearchCell(nn.Module):
 
     @nn.compact
     def __call__(self, s0, s1, weights):
+        # search-path preprocessing is non-affine too (model_search.py Cell
+        # passes affine=False to preprocess0/preprocess1)
         if self.reduction_prev:
-            s0 = FactorizedReduce(C_out=self.C)(s0)
+            s0 = FactorizedReduce(C_out=self.C, affine=False)(s0)
         else:
-            s0 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s0)
-        s1 = ReLUConvGN(C_out=self.C, kernel=1, stride=1)(s1)
+            s0 = ReLUConvGN(C_out=self.C, kernel=1, stride=1, affine=False)(s0)
+        s1 = ReLUConvGN(C_out=self.C, kernel=1, stride=1, affine=False)(s1)
         states = [s0, s1]
         offset = 0
         for i in range(self.steps):

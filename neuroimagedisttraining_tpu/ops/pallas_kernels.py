@@ -35,13 +35,25 @@ def _interpret() -> bool:
 
 
 def _to_2d(x: jax.Array) -> Tuple[jax.Array, int]:
-    """Ravel + zero-pad to a (rows, LANES) f32 panel; rows % SUBLANES == 0."""
+    """Ravel + zero-pad to a (rows, LANES) f32 panel; rows % SUBLANES == 0.
+
+    vmap over a leading axis to panel a batch per-element (each element
+    padded independently — see fused_weighted_sum_leaf)."""
     flat = x.ravel()
     n = flat.shape[0]
     per_panel = LANES * SUBLANES
     padded = ((n + per_panel - 1) // per_panel) * per_panel
     flat = jnp.pad(flat, (0, padded - n))
     return flat.reshape(-1, LANES), n
+
+
+def _pick_block_rows(rows: int, budget: int = _BLOCK_ROWS) -> int:
+    """Largest block size <= budget dividing ``rows`` (rows % SUBLANES == 0,
+    guaranteed by _to_2d, so the loop terminates at SUBLANES or below)."""
+    block_rows = min(budget, rows)
+    while rows % block_rows:
+        block_rows -= SUBLANES if block_rows > SUBLANES else 1
+    return max(block_rows, 1)
 
 
 def _from_2d(panel: jax.Array, n: int, shape, dtype) -> jax.Array:
@@ -76,10 +88,7 @@ def fused_masked_sgd_leaf(p, m, g, mask, lr, momentum: float = 0.0,
     g2, _ = _to_2d(g.astype(jnp.float32))
     k2, _ = _to_2d(mask.astype(jnp.float32))
     rows = p2.shape[0]
-    block_rows = min(_BLOCK_ROWS, rows)
-    while rows % block_rows:
-        block_rows -= SUBLANES if block_rows > SUBLANES else 1
-    block_rows = max(block_rows, 1)
+    block_rows = _pick_block_rows(rows)
     grid = (rows // block_rows,)
 
     vmem_spec = pl.BlockSpec(
@@ -100,7 +109,9 @@ def fused_masked_sgd_leaf(p, m, g, mask, lr, momentum: float = 0.0,
         ],
         interpret=_interpret(),
     )(jnp.asarray(lr, jnp.float32).reshape(1), p2, m2, g2, k2)
-    return _from_2d(p_new, n, shape, dtype), _from_2d(m_new, n, shape, dtype)
+    # momentum keeps its own dtype (f32 buffers stay f32 under bf16 params)
+    return (_from_2d(p_new, n, shape, dtype),
+            _from_2d(m_new, n, shape, m.dtype))
 
 
 def fused_masked_sgd_step(params: Any, momentum_tree: Any, grads: Any,
@@ -144,18 +155,11 @@ def fused_weighted_sum_leaf(stacked: jax.Array, weights: jax.Array):
     dtype = stacked.dtype
     flat = stacked.reshape(c, -1).astype(jnp.float32)
     n = flat.shape[1]
-    per_panel = LANES * SUBLANES
-    padded = ((n + per_panel - 1) // per_panel) * per_panel
-    flat = jnp.pad(flat, ((0, 0), (0, padded - n)))
-    panels = flat.reshape(c, -1, LANES)
+    panels = jax.vmap(lambda v: _to_2d(v)[0])(flat)  # per-client pad + panel
     rows = panels.shape[1]
     # the input block is (c, block_rows, LANES): shrink block_rows by the
     # client count so VMEM stays ~_BLOCK_ROWS*LANES*4B regardless of c
-    budget = max(SUBLANES, _BLOCK_ROWS // max(c, 1))
-    block_rows = min(budget, rows)
-    while rows % block_rows:
-        block_rows -= SUBLANES if block_rows > SUBLANES else 1
-    block_rows = max(block_rows, 1)
+    block_rows = _pick_block_rows(rows, max(SUBLANES, _BLOCK_ROWS // max(c, 1)))
     grid = (rows // block_rows,)
 
     out = pl.pallas_call(

@@ -1,3 +1,23 @@
 from .mesh import make_mesh, shard_over_clients, replicate
+from .spatial import (
+    halo_exchange,
+    make_sharded_conv3d,
+    make_spatial_forward,
+    shard_hybrid,
+    shard_spatial,
+    sharded_conv3d,
+    spatial_spec,
+)
 
-__all__ = ["make_mesh", "shard_over_clients", "replicate"]
+__all__ = [
+    "make_mesh",
+    "shard_over_clients",
+    "replicate",
+    "halo_exchange",
+    "make_sharded_conv3d",
+    "make_spatial_forward",
+    "shard_hybrid",
+    "shard_spatial",
+    "sharded_conv3d",
+    "spatial_spec",
+]

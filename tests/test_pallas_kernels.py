@@ -78,3 +78,18 @@ def test_fused_weighted_sum_matches_einsum():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         got, expect)
+
+
+def test_fused_sgd_preserves_momentum_dtype():
+    """bf16 params + f32 momentum buffer: the buffer must stay f32."""
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import (
+        fused_masked_sgd_leaf,
+    )
+
+    p = jnp.ones((33,), jnp.bfloat16)
+    m = jnp.zeros((33,), jnp.float32)
+    g = jnp.full((33,), 0.5, jnp.float32)
+    mask = jnp.ones((33,), jnp.float32)
+    p2, m2 = fused_masked_sgd_leaf(p, m, g, mask, 0.1, momentum=0.9)
+    assert p2.dtype == jnp.bfloat16
+    assert m2.dtype == jnp.float32

@@ -1,0 +1,147 @@
+"""Spatial (volume) parallelism tests — the context-parallel slot.
+
+Verifies on the 8-device virtual CPU mesh that
+  * explicit halo exchange reproduces zero-padding semantics,
+  * the shard_map halo-exchange conv matches the dense conv bit-for-bit,
+  * a GSPMD depth-sharded forward of the real 3D model matches the
+    unsharded forward,
+  * the hybrid clients x space layout compiles and matches too.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+from neuroimagedisttraining_tpu.parallel import spatial as sp
+
+
+def space_mesh(n, devices):
+    return Mesh(np.array(devices[:n]), (sp.SPACE_AXIS,))
+
+
+def test_halo_exchange_matches_zero_padding(eight_devices):
+    n = 4
+    mesh = space_mesh(n, eight_devices)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 3, 3, 1))
+
+    f = shard_map(
+        lambda xb: sp.halo_exchange(xb, halo=2),
+        mesh=mesh,
+        in_specs=P(None, sp.SPACE_AXIS),
+        out_specs=P(None, sp.SPACE_AXIS),
+        check_vma=False,
+    )
+    out = jax.jit(f)(x)
+    # each local block (depth 4) grows to 8; global result is the blocks'
+    # concatenation. Reconstruct expected from dense zero-padded x.
+    xp = jnp.pad(x, [(0, 0), (2, 2), (0, 0), (0, 0), (0, 0)])
+    expected = jnp.concatenate(
+        [xp[:, i * 4:i * 4 + 8] for i in range(n)], axis=1
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected))
+
+
+def test_sharded_conv3d_matches_dense(eight_devices):
+    mesh = space_mesh(4, eight_devices)
+    key = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (2, 12, 6, 6, 3))
+    w = jax.random.normal(k2, (3, 3, 3, 3, 5)) * 0.1
+    b = jax.random.normal(k3, (5,)) * 0.1
+
+    f = sp.make_sharded_conv3d(mesh)
+    out = jax.jit(f)(x, w, b)
+
+    dense = lax.conv_general_dilated(
+        x, w, (1, 1, 1), [(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    ) + b
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-5)
+
+
+def test_gspmd_spatial_forward_matches_unsharded(eight_devices):
+    from neuroimagedisttraining_tpu.models import (
+        create_model, init_params, make_apply_fn,
+    )
+
+    mesh = space_mesh(4, eight_devices)
+    model = create_model("small3dcnn", num_classes=2)
+    params = init_params(model, jax.random.PRNGKey(0), (16, 8, 8, 1))
+    apply_fn = make_apply_fn(model)
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 8, 8, 1))
+    dense = apply_fn(params, x, train=False, rng=None)
+
+    fwd = sp.make_spatial_forward(apply_fn, mesh)
+    xs = sp.shard_spatial(x, mesh)
+    out = fwd(params, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-5)
+
+
+def test_gspmd_spatial_uneven_depth_pads(eight_devices):
+    """Depth not divisible by the space axis: pad_depth_to makes it work and
+    parity holds on the padded volume."""
+    from neuroimagedisttraining_tpu.models import (
+        create_model, init_params, make_apply_fn,
+    )
+
+    mesh = space_mesh(4, eight_devices)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 15, 8, 8, 1))
+    with pytest.raises(ValueError, match="pad_depth_to"):
+        sp.shard_spatial(x, mesh)
+
+    xp = sp.pad_depth_to(x, 4)
+    assert xp.shape[1] == 16
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), xp.shape[1:])
+    apply_fn = make_apply_fn(model)
+    dense = apply_fn(params, xp, train=False, rng=None)
+    out = sp.make_spatial_forward(apply_fn, mesh)(
+        params, sp.shard_spatial(xp, mesh)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-5)
+
+
+def test_hybrid_clients_space_grad_step(eight_devices):
+    """clients x space hybrid: grads of a depth-sharded per-client batch match
+    the fully replicated computation."""
+    from neuroimagedisttraining_tpu.models import (
+        create_model, init_params, make_apply_fn,
+    )
+
+    mesh = make_mesh(2, n_space=4, devices=eight_devices)
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 4, 4, 1))
+    apply_fn = make_apply_fn(model)
+
+    n_clients = 2
+    x = jax.random.normal(jax.random.PRNGKey(4), (n_clients, 4, 8, 4, 4, 1))
+    y = jnp.array([[0, 1, 0, 1], [1, 1, 0, 0]], jnp.float32)
+
+    def client_loss(params, xc, yc):
+        logits = apply_fn(params, xc, train=False, rng=None)[..., 0]
+        return jnp.mean(
+            jnp.maximum(logits, 0) - logits * yc
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+
+    def total_loss(params, x, y):
+        losses = jax.vmap(client_loss, in_axes=(None, 0, 0))(params, x, y)
+        return jnp.mean(losses)
+
+    grads_dense = jax.grad(total_loss)(params, x, y)
+
+    xs = sp.shard_hybrid(x, mesh)
+    grads_sharded = jax.jit(jax.grad(total_loss))(params, xs, y)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        grads_dense,
+        grads_sharded,
+    )
