@@ -1,0 +1,12 @@
+#!/bin/sh
+# Regenerate the protobuf message stub for the gRPC comm backend
+# (reference: fedml_core/.../gRPC/proto/generate_grpc.sh). The service
+# itself is registered via grpc generic handlers (comm/grpc_backend.py),
+# so only --python_out is needed — no grpcio-tools plugin dependency.
+set -e
+cd "$(dirname "$0")"
+OUT="../../neuroimagedisttraining_tpu/comm/_generated"
+mkdir -p "$OUT"
+touch "$OUT/__init__.py"
+protoc --python_out="$OUT" -I. comm_manager.proto
+echo "wrote $OUT/comm_manager_pb2.py"

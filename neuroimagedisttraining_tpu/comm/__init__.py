@@ -8,9 +8,11 @@ MPI/gRPC/MQTT backends) with a native C++ TCP transport
 """
 from .base import BaseCommunicationManager, Observer
 from .cross_silo import CrossSiloClient, CrossSiloServer
+from .grpc_backend import GrpcCommManager, endpoints_from_hosts, grpc_available
 from .local import LocalCommManager, LocalRouter
 from .manager import ClientManager, DistributedManager, ServerManager
 from .message import Message
+from .pubsub import PubSubBroker, PubSubCommManager
 from .tcp import TcpCommManager, build_native, native_available
 
 __all__ = [
@@ -19,12 +21,17 @@ __all__ = [
     "CrossSiloClient",
     "CrossSiloServer",
     "DistributedManager",
+    "GrpcCommManager",
     "LocalCommManager",
     "LocalRouter",
     "Message",
     "Observer",
+    "PubSubBroker",
+    "PubSubCommManager",
     "ServerManager",
     "TcpCommManager",
     "build_native",
+    "endpoints_from_hosts",
+    "grpc_available",
     "native_available",
 ]

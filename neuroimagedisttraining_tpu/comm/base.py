@@ -7,7 +7,9 @@ from __future__ import annotations
 
 import abc
 import logging
-from typing import List
+import queue
+import threading
+from typing import List, Optional
 
 from .message import Message
 
@@ -53,3 +55,66 @@ class BaseCommunicationManager(abc.ABC):
                 # log with traceback and keep serving later messages
                 logger.exception(
                     "handler for %r raised; receive loop continues", msg.type)
+
+
+class PollingReceiveLoopMixin:
+    """``handle_receive_message``/``stop_receive_message`` over a blocking
+    ``self.recv(timeout_s)`` — the receive pump every backend shares."""
+
+    def _init_pump(self) -> None:
+        self._stop = threading.Event()
+
+    def handle_receive_message(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self.recv(timeout_s=0.1)
+            except OSError:
+                # covers ConnectionError from the inbox mixin and the plain
+                # OSError the native TCP backend raises on transport failure
+                logger.error("transport lost; receive pump exiting")
+                return
+            if msg is not None:
+                self._notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._stop.set()
+
+
+class QueueInboxMixin(PollingReceiveLoopMixin):
+    """Receive pump fed by an inbound bytes queue (``self._inbox.put(raw)``
+    from the backend's reader thread / RPC servicer).
+
+    ``_fail_inbox()`` marks the transport dead: once the queue drains,
+    ``recv`` raises ``ConnectionError`` instead of blocking forever.
+    """
+
+    def _init_pump(self) -> None:
+        super()._init_pump()
+        self._inbox: "queue.Queue[bytes]" = queue.Queue()
+        self._lost = threading.Event()
+
+    def _fail_inbox(self) -> None:
+        self._lost.set()
+
+    def recv(self, timeout_s: float = -1.0) -> Optional[Message]:
+        """Blocking receive of one message (None on timeout); raises
+        ``ConnectionError`` once the transport is lost and the queue is
+        drained."""
+        block_forever = timeout_s < 0
+        while True:
+            try:
+                payload = self._inbox.get(
+                    timeout=0.5 if block_forever else timeout_s)
+            except queue.Empty:
+                if self._lost.is_set():
+                    # the reader may have enqueued a final message between
+                    # our timeout and the _lost check — drain before failing
+                    try:
+                        payload = self._inbox.get_nowait()
+                    except queue.Empty:
+                        raise ConnectionError("transport lost") from None
+                    return Message.from_bytes(payload)
+                if block_forever:
+                    continue
+                return None
+            return Message.from_bytes(payload)

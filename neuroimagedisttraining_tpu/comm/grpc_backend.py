@@ -1,0 +1,197 @@
+"""gRPC comm backend: unary RPC mesh over the ``comm_manager.proto`` IDL.
+
+Working rebuild of the reference's gRPC backend
+(``fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:20-106``,
+``grpc_server.py:9-40``), which is un-importable as shipped (dangling
+``fedml_api.distributed.*`` imports at ``grpc_comm_manager.py:17-18``).
+Kept from the reference's design: every rank runs an insecure server
+(port ``50000 + rank`` when only hosts are given), send = open a channel
+to the receiver from an endpoint table and issue one unary
+``SendMessage(CommRequest)``, received payloads land in a queue drained
+by ``handle_receive_message``. Changed: payloads are the binary
+``Message`` framing (raw bytes field) instead of JSON, the 100 MB message
+cap is raised to 1 GiB, and channels are cached per receiver instead of
+re-dialed per send.
+
+The protobuf stub is generated on demand from
+``native/comm/comm_manager.proto`` with ``protoc`` (regen script
+``native/comm/generate_grpc.sh``); the service is registered through
+``grpc.GenericRpcHandler`` so no grpcio-tools protoc plugin is needed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from concurrent import futures
+from typing import Sequence, Tuple
+
+from .base import BaseCommunicationManager, QueueInboxMixin
+from .message import Message
+
+logger = logging.getLogger(__name__)
+
+GRPC_BASE_PORT = 50000  # grpc_comm_manager.py: PORT_BASE = 50000
+MAX_MESSAGE_BYTES = 1 << 30
+_SERVICE_METHOD = "/nidt.comm.CommManager/SendMessage"
+
+_PROTO_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "comm",
+)
+_GEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_generated")
+
+_stub_lock = threading.Lock()
+_pb2 = None
+
+
+def _load_pb2():
+    """protoc-compile the IDL into ``comm/_generated`` and import the stub."""
+    global _pb2
+    with _stub_lock:
+        if _pb2 is not None:
+            return _pb2
+        src = os.path.join(_PROTO_DIR, "comm_manager.proto")
+        out = os.path.join(_GEN_DIR, "comm_manager_pb2.py")
+        if not os.path.exists(out) or (
+                os.path.exists(src)
+                and os.path.getmtime(out) < os.path.getmtime(src)):
+            os.makedirs(_GEN_DIR, exist_ok=True)
+            open(os.path.join(_GEN_DIR, "__init__.py"), "a").close()
+            subprocess.run(
+                ["protoc", f"--python_out={_GEN_DIR}", f"-I{_PROTO_DIR}",
+                 "comm_manager.proto"],
+                check=True, capture_output=True)
+        from ._generated import comm_manager_pb2
+        _pb2 = comm_manager_pb2
+        return _pb2
+
+
+def grpc_available() -> bool:
+    try:
+        import grpc  # noqa: F401
+        _load_pb2()
+        return True
+    except Exception:
+        return False
+
+
+class _CommServicer:
+    """Queues every inbound CommRequest (grpc_server.py:9-40 equivalent)."""
+
+    def __init__(self, pb2, inbox: "queue.Queue[bytes]", rank: int):
+        self._pb2 = pb2
+        self._inbox = inbox
+        self._rank = rank
+
+    def send_message(self, request, context):
+        self._inbox.put(request.message)
+        return self._pb2.CommResponse(
+            client_id=self._rank, message="ack")
+
+    def handler(self):
+        import grpc
+
+        pb2 = self._pb2
+        rpc = grpc.unary_unary_rpc_method_handler(
+            self.send_message,
+            request_deserializer=pb2.CommRequest.FromString,
+            response_serializer=pb2.CommResponse.SerializeToString,
+        )
+        method = _SERVICE_METHOD
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                return rpc if details.method == method else None
+
+        return _Generic()
+
+
+class GrpcCommManager(QueueInboxMixin, BaseCommunicationManager):
+    """One rank of a gRPC mesh.
+
+    ``endpoints``: ``[(host, port)] * world_size`` — the reference's
+    ip-config table (``build_ip_table``); a port of 0 in this rank's own
+    entry means "bind an ephemeral port" (the chosen port is exposed as
+    ``.port`` so tests and dynamic deployments can exchange it out of
+    band). Plain host strings get the reference's ``50000 + rank`` scheme
+    via :func:`endpoints_from_hosts`.
+    """
+
+    def __init__(self, rank: int, endpoints: Sequence[Tuple[str, int]]):
+        super().__init__()
+        import grpc
+
+        self._pb2 = _load_pb2()
+        self.rank = rank
+        self.world_size = len(endpoints)
+        self._endpoints = [tuple(e) for e in endpoints]
+        self._init_pump()
+        # receiver rank -> (grpc.Channel, unary-unary callable); the channel
+        # reference is kept so finalize() can close it
+        self._channels: dict[int, Tuple[object, object]] = {}
+        self._chan_lock = threading.Lock()
+
+        opts = [("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES)]
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4), options=opts)
+        self._server.add_generic_rpc_handlers(
+            (_CommServicer(self._pb2, self._inbox, rank).handler(),))
+        host, port = self._endpoints[rank]
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(f"rank {rank}: cannot bind grpc on {host}:{port}")
+        self.port = bound
+        self._endpoints[rank] = (host, bound)
+        self._server.start()
+
+    # -- sending ---------------------------------------------------------------
+    def _stub(self, receiver: int):
+        import grpc
+
+        with self._chan_lock:
+            entry = self._channels.get(receiver)
+            if entry is None:
+                host, port = self._endpoints[receiver]
+                chan = grpc.insecure_channel(
+                    f"{host}:{port}",
+                    options=[("grpc.max_send_message_length",
+                              MAX_MESSAGE_BYTES),
+                             ("grpc.max_receive_message_length",
+                              MAX_MESSAGE_BYTES)])
+                call = chan.unary_unary(
+                    _SERVICE_METHOD,
+                    request_serializer=(
+                        self._pb2.CommRequest.SerializeToString),
+                    response_deserializer=(
+                        self._pb2.CommResponse.FromString),
+                )
+                entry = (chan, call)
+                self._channels[receiver] = entry
+            return entry[1]
+
+    def send_message(self, msg: Message) -> None:
+        req = self._pb2.CommRequest(
+            client_id=self.rank, message=msg.to_bytes())
+        self._stub(msg.receiver_id)(req)
+
+    # -- receiving: recv/pump come from QueueInboxMixin (the servicer feeds
+    # self._inbox) — the message_handling_subroutine equivalent, without the
+    # reference's 0.3 s sleep poll.
+
+    def finalize(self) -> None:
+        self.stop_receive_message()
+        with self._chan_lock:
+            for chan, _call in self._channels.values():
+                chan.close()
+            self._channels.clear()
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+
+
+def endpoints_from_hosts(hosts: Sequence[str]) -> list[Tuple[str, int]]:
+    """Reference port scheme: rank ``i`` serves on ``50000 + i``."""
+    return [(h, GRPC_BASE_PORT + i) for i, h in enumerate(hosts)]

@@ -17,7 +17,7 @@ import subprocess
 import threading
 from typing import List, Optional, Sequence, Tuple
 
-from .base import BaseCommunicationManager
+from .base import BaseCommunicationManager, PollingReceiveLoopMixin
 from .message import Message
 
 logger = logging.getLogger(__name__)
@@ -93,7 +93,7 @@ def native_available() -> bool:
         return False
 
 
-class TcpCommManager(BaseCommunicationManager):
+class TcpCommManager(PollingReceiveLoopMixin, BaseCommunicationManager):
     """One rank of a TCP mesh. ``endpoints`` = [(host, port)] * world_size;
     rank ``i`` listens on endpoints[i] (gRPC backend's port-per-rank scheme,
     ``grpc_comm_manager.py:20-40``, minus the JSON and the broken imports)."""
@@ -112,7 +112,7 @@ class TcpCommManager(BaseCommunicationManager):
             raise OSError(
                 f"comm_init failed (rank {rank}, endpoint "
                 f"{endpoints[rank]}): port in use?")
-        self._stop = threading.Event()
+        self._init_pump()
 
     def send_message(self, msg: Message) -> None:
         payload = msg.to_bytes()
@@ -142,14 +142,7 @@ class TcpCommManager(BaseCommunicationManager):
             self._lib.comm_free_buf(buf)
         return Message.from_bytes(payload)
 
-    def handle_receive_message(self) -> None:
-        while not self._stop.is_set():
-            msg = self.recv(timeout_s=0.1)
-            if msg is not None:
-                self._notify(msg)
-
-    def stop_receive_message(self) -> None:
-        self._stop.set()
+    # handle_receive_message/stop_receive_message from PollingReceiveLoopMixin
 
     def finalize(self) -> None:
         self.stop_receive_message()

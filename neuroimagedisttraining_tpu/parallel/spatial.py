@@ -32,7 +32,6 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
@@ -219,13 +218,3 @@ def shard_hybrid(x: jax.Array, mesh: Mesh, axis_name: str = SPACE_AXIS):
     """Place a (clients, n, D, H, W, C) federated batch with the client axis
     over ``clients`` and volume depth over ``space``."""
     return jax.device_put(x, NamedSharding(mesh, hybrid_batch_spec(axis_name)))
-
-
-def required_halo(kernel_depth: int) -> int:
-    """Halo planes needed per side for a stride-1 depth kernel."""
-    return kernel_depth // 2
-
-
-def max_local_depth(depth: int, n_space: int) -> int:
-    """Local depth extent per shard under jax's tile-based padding."""
-    return int(np.ceil(depth / n_space))
