@@ -55,6 +55,8 @@ class FedAlgorithm(abc.ABC):
         eval_batch: int = 32,
         seed: int = 0,
         client_chunk: Optional[int] = None,
+        compute_dtype: Optional[str] = None,
+        channel_inject: bool = False,
     ):
         self.model = model
         self.data = data
@@ -64,7 +66,21 @@ class FedAlgorithm(abc.ABC):
         self.num_clients = data.num_clients
         self.clients_per_round = max(1, int(round(self.num_clients * frac)))
         self.client_chunk = client_chunk
-        self.apply_fn = make_apply_fn(model)
+        # mixed precision: f32 master weights + (e.g.) bf16 conv/matmul
+        # compute — see make_apply_fn. "bfloat16" is the TPU-native choice.
+        self.compute_dtype = (
+            jnp.dtype(compute_dtype) if compute_dtype is not None else None
+        )
+        # channel_inject: volumes stored channel-less, channel appended at
+        # apply time (see make_apply_fn docstring for the HBM-tiling why)
+        self.channel_inject = channel_inject
+        # shape used for parameter init: stored sample shape plus the
+        # injected channel axis
+        self.init_sample_shape = tuple(data.sample_shape) + (
+            (1,) if channel_inject else ())
+        self.apply_fn = make_apply_fn(
+            model, compute_dtype=self.compute_dtype,
+            channel_inject=channel_inject)
         self.eval_client = make_eval_fn(self.apply_fn, loss_type, eval_batch)
         self._build()
 

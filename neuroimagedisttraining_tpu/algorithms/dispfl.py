@@ -165,7 +165,7 @@ class DisPFL(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> DisPFLState:
         p_rng, m_rng, s_rng = jax.random.split(rng, 3)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         shapes = param_shapes(params)
         sp = erk_sparsities(shapes, self.dense_ratio, self.erk_power_scale)
         mask_keys = jax.random.split(m_rng, self.num_clients)

@@ -90,7 +90,7 @@ class Ditto(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> DittoState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         return DittoState(
             global_params=params,
             personal_params=broadcast_tree(params, self.num_clients),

@@ -56,7 +56,7 @@ class FedAvg(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> FedAvgState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         return FedAvgState(global_params=params, rng=s_rng)
 
     def run_round(self, state: FedAvgState, round_idx: int):

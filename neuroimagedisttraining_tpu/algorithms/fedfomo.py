@@ -147,7 +147,7 @@ class FedFomo(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> FedFomoState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         return FedFomoState(
             personal_params=broadcast_tree(params, self.num_clients),
             p_choose=jnp.ones((self.num_clients, self.num_clients)),

@@ -53,7 +53,7 @@ class LocalOnly(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> LocalOnlyState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         return LocalOnlyState(
             personal_params=broadcast_tree(params, self.num_clients),
             rng=s_rng,

@@ -92,7 +92,7 @@ class SalientGrads(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> SalientGradsState:
         p_rng, m_rng, s_rng = jax.random.split(rng, 3)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         mask = self._global_mask_jit(
             params, self.data.x_train, self.data.y_train, self.data.n_train,
             m_rng,

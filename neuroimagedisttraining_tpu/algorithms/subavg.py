@@ -157,7 +157,7 @@ class SubAvg(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> SubAvgState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         # all clients start from the SAME all-ones mask (subavg_api.py:45-47)
         masks = broadcast_tree(
             jax.tree_util.tree_map(jnp.ones_like, params), self.num_clients

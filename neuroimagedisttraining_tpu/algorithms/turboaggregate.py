@@ -96,7 +96,7 @@ class TurboAggregate(FedAlgorithm):
 
     def init_state(self, rng: jax.Array) -> TurboAggregateState:
         p_rng, s_rng = jax.random.split(rng)
-        params = init_params(self.model, p_rng, self.data.sample_shape)
+        params = init_params(self.model, p_rng, self.init_sample_shape)
         return TurboAggregateState(global_params=params, rng=s_rng)
 
     def run_round(self, state: TurboAggregateState, round_idx: int):

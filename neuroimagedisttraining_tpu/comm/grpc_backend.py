@@ -183,6 +183,9 @@ class GrpcCommManager(QueueInboxMixin, BaseCommunicationManager):
 
     def finalize(self) -> None:
         self.stop_receive_message()
+        # wake any recv() blocked on the inbox: once queued messages drain
+        # it raises ConnectionError instead of spinning forever
+        self._fail_inbox()
         with self._chan_lock:
             for chan, _call in self._channels.values():
                 chan.close()

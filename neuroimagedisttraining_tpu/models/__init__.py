@@ -15,6 +15,7 @@ from .alexnet3d import (
     AlexNet3D,
     AlexNet3DDeeper,
     AlexNet3DRegression,
+    AlexNet3DS2D,
     SmallCNN3D,
 )
 
@@ -39,6 +40,9 @@ def _registry():
     return {
         # reference names (main_*.py --model flags)
         "3dcnn": lambda num_classes, **kw: AlexNet3D(num_classes=num_classes, **kw),
+        # TPU-fast AlexNet3D over phase-decomposed input (ops/s2d.py);
+        # same hypothesis class + outputs, input is (8, D', H', W') phased
+        "3dcnn_s2d": lambda num_classes, **kw: AlexNet3DS2D(num_classes=num_classes, **kw),
         "3dcnn_deeper": lambda num_classes, **kw: AlexNet3DDeeper(num_classes=num_classes, **kw),
         "3dcnn_regression": lambda num_classes, **kw: AlexNet3DRegression(
             num_outputs=num_classes, **kw
@@ -70,15 +74,53 @@ def create_model(name: str, num_classes: int = 1, **kwargs):
     return reg[key](num_classes, **kwargs)
 
 
-def make_apply_fn(model) -> ApplyFn:
-    """Uniform apply closure: dropout rng threaded only in train mode."""
+def make_apply_fn(model, compute_dtype=None, channel_inject=False) -> ApplyFn:
+    """Uniform apply closure: dropout rng threaded only in train mode.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision the
+    TPU way: master weights stay float32 in the optimizer, but params and
+    inputs are cast on entry so every conv/matmul runs on the MXU in
+    bfloat16 (~2.6x step throughput on AlexNet3D at full ABCD resolution);
+    outputs are cast back to float32 so losses, gradients accumulated into
+    the f32 masters, and eval metrics keep full precision.
+
+    ``channel_inject`` appends the trailing channel axis at apply time (the
+    reference's per-batch ``x.unsqueeze(1)``, ``my_model_trainer.py:199``).
+    Storing ABCD volumes channel-less matters on TPU: the last two dims of
+    an array are tile-padded to (8,128)/(16,128), so a resident
+    ``(..., 121, 1)`` cohort costs 8-16x its logical bytes in HBM, while
+    ``(..., 145, 121)`` pads by ~1.1x; injecting onto the small gathered
+    batch keeps the blowup off the big arrays.
+    """
+    import jax.numpy as jnp
+
+    def _cast_in(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            tree,
+        )
+
+    def _cast_out(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            tree,
+        )
 
     def apply_fn(params, x, train: bool, rng):
+        if channel_inject:
+            x = x[..., None]
+        if compute_dtype is not None:
+            params = _cast_in(params)
+            x = x.astype(compute_dtype)
         if train:
-            return model.apply(
+            out = model.apply(
                 {"params": params}, x, train=True, rngs={"dropout": rng}
             )
-        return model.apply({"params": params}, x, train=False)
+        else:
+            out = model.apply({"params": params}, x, train=False)
+        return _cast_out(out) if compute_dtype is not None else out
 
     return apply_fn
 

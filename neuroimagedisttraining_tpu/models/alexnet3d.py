@@ -18,6 +18,8 @@ identical to the reference's Linear input sizes.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
 
 from .layers import Conv3d, avg_pool3d, flatten, group_norm, max_pool3d
 
@@ -52,6 +54,89 @@ class _Features(nn.Module):
         x = group_norm(w5)(x)
         x = nn.relu(x)
         x = max_pool3d(x, kernel=3, strides=3)
+        return x
+
+
+class S2DStem(nn.Module):
+    """Phase-decomposed stem: the TPU-fast form of Conv3d(1->F, k5, s2).
+
+    Consumes the phased NCDHW batch ``(B, 8, D', H', W')`` produced by
+    ``ops.s2d.phase_decompose`` and emits the exact activations of the
+    reference stem in NDHWC. The 91 structurally-unused kernel slots are
+    masked to zero at apply time so the hypothesis class stays identical
+    to the dense stride-2 stem (see ops/s2d.py docstring).
+    """
+
+    features: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.s2d import N_PHASES, R_KERNEL, stem_slot_mask
+
+        # lecun-normal with the MASK-AWARE fan-in: only 125 of the 216
+        # kernel slots are live, so scale variance to match the dense
+        # stride-2 stem's 1/125 (fresh-init dynamics parity, not just
+        # converted-weights parity)
+        w = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(
+                216.0 / 125.0, "fan_in", "truncated_normal",
+                in_axis=(0, 1, 2, 3), batch_axis=()),
+            (R_KERNEL,) * 3 + (N_PHASES, self.features),
+        )
+        b = self.param("bias", nn.initializers.zeros, (self.features,))
+        mask = jnp.asarray(stem_slot_mask(), w.dtype)
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NCDHW", "DHWIO", "NDHWC"))
+        y = lax.conv_general_dilated(
+            x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn)
+        return y + b
+
+
+class AlexNet3DS2D(nn.Module):
+    """AlexNet3D over phase-decomposed input — same function class and
+    output as :class:`AlexNet3D`, restated for the MXU (see ops/s2d.py).
+
+    Input: ``(B, 8, 61, 73, 61)`` phased volumes (for the canonical
+    121x145x121 ABCD volume) instead of ``(B, 121, 145, 121, 1)``.
+    """
+
+    num_classes: int = 1
+    dropout_rate: float = 0.5
+    widths: tuple = (64, 128, 192, 192, 128)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        w1, w2, w3, w4, w5 = self.widths
+        x = S2DStem(features=w1)(x)
+        x = group_norm(w1)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+
+        x = Conv3d(w2, kernel_size=3, strides=1, padding=0)(x)
+        x = group_norm(w2)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+
+        x = Conv3d(w3, kernel_size=3, padding=1)(x)
+        x = group_norm(w3)(x)
+        x = nn.relu(x)
+
+        x = Conv3d(w4, kernel_size=3, padding=1)(x)
+        x = group_norm(w4)(x)
+        x = nn.relu(x)
+
+        x = Conv3d(w5, kernel_size=3, padding=1)(x)
+        x = group_norm(w5)(x)
+        x = nn.relu(x)
+        x = max_pool3d(x, kernel=3, strides=3)
+
+        x = flatten(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
         return x
 
 

@@ -1,0 +1,129 @@
+"""Space-to-depth stem: exact numerical parity with the dense stride-2 stem.
+
+The s2d path (ops/s2d.py + models.AlexNet3DS2D) restates the reference's
+Conv3d(1->64, k5, s2) stem (salient_models.py:146) for the MXU; these tests
+pin the restatement to the original math.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from neuroimagedisttraining_tpu.models.alexnet3d import AlexNet3D, AlexNet3DS2D
+from neuroimagedisttraining_tpu.ops.s2d import (
+    convert_alexnet3d_params,
+    phase_decompose,
+    phase_extent,
+    phased_sample_shape,
+    remap_stem_kernel,
+    stem_slot_mask,
+)
+
+VOL = (29, 33, 29)  # small odd extents, same parity as 121/145/121
+
+
+def _ref_conv(x, w):
+    """The dense stride-2 VALID conv the stem replaces."""
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(
+        x, w, (2, 2, 2), "VALID", dimension_numbers=dn)
+
+
+def _phased_conv(xs, w2):
+    dn = lax.conv_dimension_numbers(
+        xs.shape, w2.shape, ("NCDHW", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(
+        xs, w2, (1, 1, 1), "VALID", dimension_numbers=dn)
+
+
+def test_phase_decompose_roundtrip_values():
+    x = np.arange(np.prod(VOL), dtype=np.float32).reshape(VOL)
+    ph = phase_decompose(x)
+    assert ph.shape == phased_sample_shape(VOL)
+    # phase p at index i must equal x[2i + p] (zero-padded past the edge)
+    d_e = phase_extent(VOL[0])
+    for p_idx, (i, j, k) in enumerate(
+            [(i, j, k) for i in (0, 1) for j in (0, 1) for k in (0, 1)]):
+        sub = ph[p_idx]
+        assert sub[0, 0, 0] == x[i, j, k]
+        assert sub[1, 1, 1] == x[2 + i, 2 + j, 2 + k]
+    assert d_e == (VOL[0] - 5) // 2 + 1 + 2
+
+
+def test_phased_conv_matches_dense_stride2():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2,) + VOL + (1,), jnp.float32)
+    w = jax.random.normal(key, (5, 5, 5, 1, 16), jnp.float32) * 0.1
+    ref = _ref_conv(x, w)
+    xs = phase_decompose(x[..., 0])
+    got = _phased_conv(xs, remap_stem_kernel(w))
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_slot_mask_marks_125_taps():
+    m = stem_slot_mask()
+    assert m.sum() == 125  # 5^3 taps land in distinct slots
+    # the (offset=2, phase-odd) slots are structurally unused
+    assert m[2, 0, 0, 4, 0] == 0  # phase with d-parity 1 at d-offset 2
+
+
+def test_alexnet3d_s2d_forward_parity():
+    """Converted params must give identical logits on identical volumes."""
+    vol = (69, 69, 69)
+    rngs = {"params": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)}
+    dense = AlexNet3D(num_classes=1)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2,) + vol + (1,),
+                          jnp.float32)
+    p1 = dense.init(rngs, jnp.zeros((1,) + vol + (1,)), train=False)["params"]
+    ref = dense.apply({"params": p1}, x, train=False)
+
+    s2d = AlexNet3DS2D(num_classes=1)
+    p2 = convert_alexnet3d_params(p1)
+    xs = phase_decompose(x[..., 0])
+    got = s2d.apply({"params": p2}, xs, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_stem_grads_respect_slot_mask():
+    """Gradients through the stem must vanish on structurally-zero slots."""
+    vol = (13, 15, 13)
+    xs = jax.random.normal(
+        jax.random.PRNGKey(0), (2,) + phased_sample_shape(vol), jnp.float32)
+    from neuroimagedisttraining_tpu.models.alexnet3d import S2DStem
+
+    stem = S2DStem(features=4)
+    p = stem.init(jax.random.PRNGKey(1), xs)["params"]
+
+    def loss(p):
+        return (stem.apply({"params": p}, xs) ** 2).sum()
+
+    g = jax.grad(loss)(p)
+    mask = stem_slot_mask()
+    np.testing.assert_array_equal(
+        np.asarray(g["kernel"]) * (1 - mask), 0.0)
+    assert np.abs(np.asarray(g["kernel"]) * mask).sum() > 0
+
+
+def test_s2d_registry_and_train_mode_forward():
+    """3dcnn_s2d comes from the registry and runs a train-mode forward
+    (dropout rng threaded) at the minimum viable volume."""
+    from neuroimagedisttraining_tpu.models import (
+        create_model,
+        init_params,
+        make_apply_fn,
+    )
+
+    vol = (69, 69, 69)
+    shape = phased_sample_shape(vol)
+    model = create_model("3dcnn_s2d", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), shape)
+    apply_fn = make_apply_fn(model, compute_dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2,) + shape, jnp.float32)
+    out = apply_fn(params, x, train=True, rng=jax.random.PRNGKey(2))
+    assert out.shape == (2, 1) and out.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(out)))
