@@ -80,23 +80,49 @@ def _gather_rows(X, idx: np.ndarray) -> np.ndarray:
     return np.asarray(X[idx], dtype=np.float32)
 
 
+LAYOUTS = ("channels", "flat", "s2d")
+
+
 def _finalize(
     xs_tr, ys_tr, xs_te, ys_te, val_fraction: float, seed: int,
-    normalize: bool,
+    normalize: bool, layout: str = "channels",
 ) -> FederatedData:
-    """Stack per-client splits into FederatedData; add channel axis; optional
-    per-volume standardization; optional val split carved from train (the
-    FedFomo 9-tuple variant, ``data_val_loader.py:275-326``)."""
+    """Stack per-client splits into FederatedData; optional per-volume
+    standardization; optional val split carved from train (the FedFomo
+    9-tuple variant, ``data_val_loader.py:275-326``).
+
+    ``layout`` picks the on-device storage (see SURVEY §5.7 / ops/s2d.py):
+      * ``"channels"`` — (..., D, H, W, 1), the reference's NDHWC shape;
+        note the trailing C=1 tile-pads 8-16x in HBM.
+      * ``"flat"``     — (..., D, H, W) channel-less; pair with the
+        algorithms' ``channel_inject=True`` (apply-time unsqueeze).
+      * ``"s2d"``      — (..., 8, D', H', W') phase-decomposed for the
+        ``3dcnn_s2d`` stem (fastest ABCD path on TPU).
+    """
+    if layout not in LAYOUTS:
+        raise ValueError(f"layout {layout!r} not in {LAYOUTS}")
+
     def prep(x):
         x = np.asarray(x, np.float32)
-        if x.ndim >= 2 and x.shape[-1] != 1:
-            x = x[..., None]  # NDHWC channel for conv kernels
         if normalize and x.size:
             flat = x.reshape(x.shape[0], -1)
             mu = flat.mean(axis=1)
             sd = flat.std(axis=1) + 1e-6
             x = (x - mu[(...,) + (None,) * (x.ndim - 1)]) / \
                 sd[(...,) + (None,) * (x.ndim - 1)]
+        if layout == "channels":
+            if x.ndim >= 2 and x.shape[-1] != 1:
+                x = x[..., None]  # NDHWC channel for conv kernels
+        else:
+            # flat/s2d interpret the last three dims as the volume — drop a
+            # stored trailing channel axis first (cohort files come both
+            # ways; the channels branch above absorbs the same variance)
+            if x.ndim >= 3 and x.shape[-1] == 1:
+                x = x[..., 0]
+            if layout == "s2d":
+                from ..ops.s2d import phase_decompose
+
+                x = np.asarray(phase_decompose(x))
         return x
 
     xs_va, ys_va = [], []
@@ -133,6 +159,7 @@ def load_partition_data_abcd(
     val_fraction: float = 0.0,
     normalize: bool = False,
     seed: int = ABCD_SPLIT_SEED,
+    layout: str = "channels",
 ) -> FederatedData:
     """One federated client per acquisition site (``data_loader.py:164-216``).
 
@@ -148,7 +175,8 @@ def load_partition_data_abcd(
         ys_te.append(y[te])
         logger.info("site %s: %d train / %d test", s, len(tr), len(te))
     _close_if_h5(X)
-    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed, normalize)
+    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
+                     normalize, layout)
 
 
 def load_partition_data_abcd_rescale(
@@ -157,6 +185,7 @@ def load_partition_data_abcd_rescale(
     val_fraction: float = 0.0,
     normalize: bool = False,
     seed: int = ABCD_SPLIT_SEED,
+    layout: str = "channels",
 ) -> FederatedData:
     """Merge all sites' train/test pools (site order), then contiguous equal
     reshard to ``client_number`` clients — ``data_loader.py:220-319``. Client
@@ -182,7 +211,8 @@ def load_partition_data_abcd_rescale(
         logger.info("client %d: %d train / %d test", c, len(rows_tr),
                     len(rows_te))
     _close_if_h5(X)
-    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed, normalize)
+    return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
+                     normalize, layout)
 
 
 def _close_if_h5(X) -> None:

@@ -71,6 +71,14 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "(sailentgrads_api.py:260-265 semantics)")
 
     # -- runtime (new: TPU-native knobs, no reference equivalent)
+    p.add_argument("--layout", type=str, default="channels",
+                   choices=["channels", "flat", "s2d"],
+                   help="volume storage layout: channels=NDHWC (reference); "
+                        "flat=channel-less + apply-time inject; s2d=phase-"
+                        "decomposed stem input (fastest ABCD path on TPU)")
+    p.add_argument("--compute_dtype", type=str, default="",
+                   help="mixed-precision compute dtype (e.g. bfloat16); "
+                        "master weights stay float32")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
     p.add_argument("--mesh_devices", type=int, default=0,

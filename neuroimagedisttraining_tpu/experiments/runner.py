@@ -38,6 +38,8 @@ def build_data(args: argparse.Namespace):
         # CI-scale default; real ABCD shapes come from the .h5 itself
         kwargs["sample_shape"] = (8, 8, 8, 1)
         kwargs["samples_per_client"] = max(args.batch_size, 16)
+    elif _is_abcd_h5(args.dataset):
+        kwargs["layout"] = getattr(args, "layout", "channels")
     return load_federated_data(
         args.dataset,
         data_dir=args.data_dir,
@@ -48,6 +50,12 @@ def build_data(args: argparse.Namespace):
         seed=42,  # the reference's fixed split seed (data_loader.py:67-102)
         **kwargs,
     )
+
+
+def _is_abcd_h5(dataset: str) -> bool:
+    """The cohort-file datasets whose loaders take a ``layout`` (the
+    synthetic stand-ins always store NDHWC)."""
+    return dataset.lower() in ("abcd", "abcd_site", "abcd_rescale")
 
 
 def infer_loss_type(args: argparse.Namespace, class_num: int) -> str:
@@ -67,11 +75,30 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
     from ..core.state import HyperParams
     from ..models import create_model
 
+    # validate the layout/dataset/model coupling BEFORE any data IO so a
+    # mismatched combination dies with an actionable message, not a shape
+    # error (or worse, silent training on misinterpreted tensors)
+    layout = getattr(args, "layout", "channels")
+    model_key = args.model
+    if layout != "channels" and not _is_abcd_h5(args.dataset):
+        raise SystemExit(
+            f"--layout {layout} requires an ABCD cohort dataset "
+            "(abcd | abcd_site | abcd_rescale); other loaders store NDHWC")
+    if layout == "s2d":
+        if model_key == "3dcnn":
+            model_key = "3dcnn_s2d"  # the phased-stem twin of the same model
+        elif model_key != "3dcnn_s2d":
+            raise SystemExit(
+                f"--layout s2d feeds phase-decomposed input that only the "
+                f"s2d-stem models consume; --model {model_key} would "
+                "misread the phase axis. Use --model 3dcnn (auto-mapped) "
+                "or drop --layout s2d")
+
     if data is None:
         data = build_data(args)
     loss_type = infer_loss_type(args, data.class_num)
     num_outputs = 1 if loss_type == "bce" else data.class_num
-    model = create_model(args.model, num_classes=num_outputs)
+    model = create_model(model_key, num_classes=num_outputs)
 
     n_mean = int(np.mean(np.asarray(data.n_train)))
     steps_per_epoch = max(1, n_mean // args.batch_size)
@@ -85,6 +112,8 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
     common = dict(
         loss_type=loss_type, frac=args.frac, seed=args.seed,
         client_chunk=args.client_chunk or None,
+        compute_dtype=getattr(args, "compute_dtype", "") or None,
+        channel_inject=(layout == "flat" and _is_abcd_h5(args.dataset)),
     )
     extra: Dict[str, Any] = {}
     if algo_name == "salientgrads":

@@ -87,14 +87,12 @@ def remap_stem_kernel(w) -> jax.Array:
 
 
 def stem_slot_mask() -> np.ndarray:
-    """(3,3,3,8,1) 0/1 mask of remapped-kernel slots that carry real taps."""
-    m = np.zeros((R_KERNEL,) * 3 + (N_PHASES, 1), dtype=np.float32)
-    for td in range(KERNEL):
-        for th in range(KERNEL):
-            for tw in range(KERNEL):
-                ph = (td % 2) * 4 + (th % 2) * 2 + (tw % 2)
-                m[td // 2, th // 2, tw // 2, ph, 0] = 1.0
-    return m
+    """(3,3,3,8,1) 0/1 mask of remapped-kernel slots that carry real taps.
+
+    Derived from the remap itself so the tap->slot bijection has a single
+    source of truth."""
+    return np.asarray(
+        remap_stem_kernel(np.ones((KERNEL,) * 3 + (1, 1), np.float32)))
 
 
 def convert_alexnet3d_params(params) -> dict:

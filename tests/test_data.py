@@ -265,3 +265,28 @@ def test_compute_brain_mask():
     assert mask.sum() == 27  # mean 0.25 > 0.2 everywhere
     mask = compute_brain_mask(vols, threshold=0.3)
     assert mask.sum() == 0
+
+
+def test_abcd_layouts(abcd_h5):
+    """flat / s2d storage layouts (TPU HBM-tiling-friendly paths)."""
+    from neuroimagedisttraining_tpu.ops.s2d import (
+        phase_decompose,
+        phased_sample_shape,
+    )
+
+    path, X, y, site = abcd_h5
+    flat = load_partition_data_abcd(path, layout="flat")
+    assert flat.sample_shape == (6, 7, 6)  # no channel axis
+
+    s2d = load_partition_data_abcd(path, layout="s2d")
+    assert s2d.sample_shape == phased_sample_shape((6, 7, 6))
+
+    # the phased rows must equal phase_decompose of the flat rows
+    c0 = int(flat.n_train[0])
+    np.testing.assert_allclose(
+        np.asarray(s2d.x_train[0, :c0]),
+        np.asarray(phase_decompose(np.asarray(flat.x_train[0, :c0]))),
+        rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        load_partition_data_abcd(path, layout="nope")

@@ -187,3 +187,37 @@ def test_cost_tracker_accumulates():
     assert r2["sum_training_flops"] == pytest.approx(
         2 * r1["training_flops"])
     assert r2["sum_comm_params"] == 2 * r1["comm_params"]
+
+
+def test_cli_abcd_s2d_layout(tmp_path):
+    """End-to-end CLI on a real cohort .h5 with the s2d layout: the runner
+    must pick the phased-stem model twin and train a round."""
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.data.abcd import write_abcd_h5
+
+    rng = np.random.RandomState(0)
+    # stem-viable small volume: every dim >= 69 is too slow for CI, so use
+    # the small3dcnn path for flat and just exercise s2d data plumbing via
+    # the full 3dcnn on a minimum-viable 69^3 volume with 1 round, 1 step
+    n = 12
+    X = rng.rand(n, 69, 69, 69).astype(np.float32)
+    y = rng.randint(0, 2, size=n)
+    site = rng.randint(0, 2, size=n)
+    path = str(tmp_path / "cohort.h5")
+    write_abcd_h5(path, X, y, site)
+
+    args = parse_args(_argv(tmp_path, **{
+        "--model": "3dcnn",
+        "--dataset": "abcd_site",
+        "--data_dir": path,
+        "--layout": "s2d",
+        "--compute_dtype": "bfloat16",
+        "--client_num_in_total": "0",
+        "--batch_size": "2",
+        "--comm_round": "1",
+        "--frequency_of_the_test": "1",
+    }))
+    out = run_experiment(args, "fedavg")
+    assert len(out["history"]) == 1
+    assert np.isfinite(out["history"][0]["train_loss"])

@@ -127,3 +127,37 @@ def test_s2d_registry_and_train_mode_forward():
     out = apply_fn(params, x, train=True, rng=jax.random.PRNGKey(2))
     assert out.shape == (2, 1) and out.dtype == jnp.float32
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_runner_rejects_s2d_layout_mismatches(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import parse_args, run_experiment
+
+    base = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "2", "--comm_round", "1",
+            "--log_dir", str(tmp_path)]
+    args = parse_args(base + ["--layout", "s2d"])
+    with pytest.raises(SystemExit):
+        run_experiment(args, "fedavg")
+    args = parse_args(["--dataset", "abcd_site", "--model", "small3dcnn",
+                       "--layout", "s2d", "--data_dir", "x.h5",
+                       "--log_dir", str(tmp_path)])
+    with pytest.raises(SystemExit):
+        run_experiment(args, "fedavg")
+
+
+def test_abcd_s2d_layout_squeezes_stored_channel(tmp_path):
+    """Cohort files stored with a trailing (N,D,H,W,1) channel axis must
+    phase-decompose the volume, not the channel."""
+    from neuroimagedisttraining_tpu.data.abcd import (
+        load_partition_data_abcd,
+        write_abcd_h5,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(20, 6, 7, 6, 1).astype(np.float32)
+    y = rng.randint(0, 2, size=20)
+    site = np.zeros(20, np.int64)
+    path = str(tmp_path / "c.h5")
+    write_abcd_h5(path, X, y, site)
+    data = load_partition_data_abcd(path, layout="s2d")
+    assert data.sample_shape == phased_sample_shape((6, 7, 6))
