@@ -154,21 +154,39 @@ def _finalize(
     )
 
 
+def abcd_site_count(data_path: str) -> int:
+    """Number of acquisition sites (= site-clients) in a cohort file.
+    Reads only the tiny ``site`` vector — used by the multi-host path to
+    size the clients mesh before any volume IO."""
+    import h5py
+
+    with h5py.File(data_path, "r") as f:
+        return len(np.unique(np.asarray(f["site"][()])))
+
+
 def load_partition_data_abcd(
     data_path: str,
     val_fraction: float = 0.0,
     normalize: bool = False,
     seed: int = ABCD_SPLIT_SEED,
     layout: str = "channels",
+    client_filter=None,
 ) -> FederatedData:
     """One federated client per acquisition site (``data_loader.py:164-216``).
 
     Reads site by site (lazy), splits 80/20 with the reference's seed
-    contract, and stacks into one device-ready pytree."""
+    contract, and stacks into one device-ready pytree.
+
+    ``client_filter``: load only these client (site-position) indices, in
+    the given order — the multi-host path passes each process's
+    ``local_client_indices`` so no host ever reads the full cohort."""
     X, y, site = load_abcd_h5(data_path)
     splits = site_train_test_split(site, seed=seed)
+    items = list(splits.items())
+    if client_filter is not None:
+        items = [items[int(c)] for c in client_filter]
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
-    for s, (tr, te) in splits.items():
+    for s, (tr, te) in items:
         xs_tr.append(_gather_rows(X, tr))
         ys_tr.append(y[tr])
         xs_te.append(_gather_rows(X, te))
@@ -186,6 +204,7 @@ def load_partition_data_abcd_rescale(
     normalize: bool = False,
     seed: int = ABCD_SPLIT_SEED,
     layout: str = "channels",
+    client_filter=None,
 ) -> FederatedData:
     """Merge all sites' train/test pools (site order), then contiguous equal
     reshard to ``client_number`` clients — ``data_loader.py:220-319``. Client
@@ -198,8 +217,10 @@ def load_partition_data_abcd_rescale(
     te_idx = np.concatenate([te for _, te in splits.values()])
 
     s_tr = len(tr_idx) // client_number
+    clients = (range(client_number) if client_filter is None
+               else [int(c) for c in client_filter])
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
-    for c in range(client_number):
+    for c in clients:
         rows_tr = tr_idx[c * s_tr: (c + 1) * s_tr]
         lo = int(c * s_tr * ABCD_TEST_RATIO)
         hi = int((c + 1) * s_tr * ABCD_TEST_RATIO)

@@ -81,6 +81,10 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "master weights stay float32")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--multihost", action="store_true",
+                   help="initialize jax.distributed and span the clients "
+                        "mesh over every host's devices (TPU pod / "
+                        "multi-slice); single-process runs are unaffected")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="shard client axis over this many devices (0 = all)")
     p.add_argument("--checkpoint_dir", type=str, default="",

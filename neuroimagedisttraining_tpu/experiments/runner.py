@@ -30,7 +30,7 @@ def seed_everything(seed: int) -> None:
     np.random.seed(seed)
 
 
-def build_data(args: argparse.Namespace):
+def build_data(args: argparse.Namespace, client_filter=None):
     from ..data import load_federated_data
 
     kwargs: Dict[str, Any] = {}
@@ -40,6 +40,8 @@ def build_data(args: argparse.Namespace):
         kwargs["samples_per_client"] = max(args.batch_size, 16)
     elif _is_abcd_h5(args.dataset):
         kwargs["layout"] = getattr(args, "layout", "channels")
+        if client_filter is not None:
+            kwargs["client_filter"] = client_filter
     return load_federated_data(
         args.dataset,
         data_dir=args.data_dir,
@@ -93,6 +95,10 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                 f"s2d-stem models consume; --model {model_key} would "
                 "misread the phase axis. Use --model 3dcnn (auto-mapped) "
                 "or drop --layout s2d")
+    elif model_key == "3dcnn_s2d":
+        raise SystemExit(
+            "--model 3dcnn_s2d consumes phase-decomposed input; pair it "
+            f"with --layout s2d (got --layout {layout})")
 
     if data is None:
         data = build_data(args)
@@ -143,6 +149,46 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
 
     cls = ALGORITHMS[algo_name]
     return cls(model, data, hp, **common, **extra), data
+
+
+def build_multihost_data(args: argparse.Namespace):
+    """Per-process data path for a multi-process run: size the clients mesh
+    BEFORE any volume IO, load only this process's clients (ABCD cohort
+    files support this natively — lazy h5 reads), and assemble the global
+    client-sharded pytree. Returns (mesh, global_data) or (None, None)
+    when not applicable."""
+    import jax
+
+    from ..parallel import (
+        local_client_indices,
+        make_multihost_mesh,
+        shard_federated_data_global,
+    )
+
+    if jax.process_count() <= 1:
+        return None, None
+    if _is_abcd_h5(args.dataset):
+        if args.dataset.lower() == "abcd_site" or not args.client_num_in_total:
+            from ..data.abcd import abcd_site_count
+
+            n_clients = abcd_site_count(args.data_dir)
+        else:
+            n_clients = args.client_num_in_total
+        mesh = make_multihost_mesh(
+            num_clients=n_clients,
+            max_client_devices=args.mesh_devices or None)
+        idx = local_client_indices(n_clients, mesh)
+        local = build_data(args, client_filter=idx)
+        return mesh, shard_federated_data_global(local, n_clients, mesh)
+    # other datasets: every process loads the (small) dataset, keeps its
+    # clients, and contributes them to the global arrays
+    data = build_data(args)
+    n_clients = data.num_clients
+    mesh = make_multihost_mesh(
+        num_clients=n_clients, max_client_devices=args.mesh_devices or None)
+    idx = local_client_indices(n_clients, mesh)
+    local = jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], data)
+    return mesh, shard_federated_data_global(local, n_clients, mesh)
 
 
 def maybe_shard(algo, args: argparse.Namespace):
@@ -204,8 +250,19 @@ def run_experiment(args: argparse.Namespace,
         logger.info("run identity: %s", identity)
         seed_everything(args.seed)
 
-        algo, data = build_algorithm(args, algo_name)
-        mesh = maybe_shard(algo, args)
+        mh_mesh = None
+        if getattr(args, "multihost", False):
+            from ..parallel import initialize_distributed
+
+            if initialize_distributed():
+                mh_mesh, gdata = build_multihost_data(args)
+
+        if mh_mesh is not None:
+            algo, data = build_algorithm(args, algo_name, data=gdata)
+            mesh = mh_mesh
+        else:
+            algo, data = build_algorithm(args, algo_name)
+            mesh = maybe_shard(algo, args)
         if mesh is not None:
             logger.info("sharding clients over mesh %s", dict(mesh.shape))
 

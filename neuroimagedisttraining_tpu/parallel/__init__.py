@@ -1,4 +1,11 @@
 from .mesh import make_mesh, shard_over_clients, replicate
+from .multihost import (
+    initialize_distributed,
+    local_client_indices,
+    make_global_client_array,
+    make_multihost_mesh,
+    shard_federated_data_global,
+)
 from .spatial import (
     halo_exchange,
     make_sharded_conv3d,
@@ -13,6 +20,11 @@ __all__ = [
     "make_mesh",
     "shard_over_clients",
     "replicate",
+    "initialize_distributed",
+    "local_client_indices",
+    "make_global_client_array",
+    "make_multihost_mesh",
+    "shard_federated_data_global",
     "halo_exchange",
     "make_sharded_conv3d",
     "make_spatial_forward",

@@ -1,0 +1,117 @@
+"""Multi-host helpers on the single-process 8-virtual-device CPU mesh.
+
+Single-process is the degenerate case of the multi-host path (process
+count 1 owns every client); these tests pin the indexing/assembly logic
+that multi-process runs rely on.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.parallel import (
+    local_client_indices,
+    make_global_client_array,
+    make_multihost_mesh,
+    shard_federated_data_global,
+)
+
+
+def test_multihost_mesh_covers_all_devices():
+    mesh = make_multihost_mesh()
+    assert mesh.shape["clients"] == len(jax.devices())
+
+    mesh2 = make_multihost_mesh(n_space=2)
+    assert mesh2.shape == {"clients": len(jax.devices()) // 2, "space": 2}
+
+
+def test_local_client_indices_single_process_owns_all():
+    mesh = make_multihost_mesh()
+    idx = local_client_indices(16, mesh)
+    np.testing.assert_array_equal(idx, np.arange(16))
+
+
+def test_local_client_indices_rejects_ragged():
+    mesh = make_multihost_mesh()
+    try:
+        local_client_indices(len(jax.devices()) + 1, mesh)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_make_global_client_array_roundtrip():
+    mesh = make_multihost_mesh()
+    n = len(jax.devices())
+    rows = np.arange(n * 6, dtype=np.float32).reshape(n, 6)
+    arr = make_global_client_array(rows, (n, 6), mesh)
+    assert arr.shape == (n, 6)
+    np.testing.assert_array_equal(np.asarray(arr), rows)
+    # sharded over clients: each device holds one row
+    assert len(arr.sharding.device_set) == n
+
+
+def test_shard_federated_data_global_runs_a_round():
+    """Globally-assembled data must drive the standard FedAvg round."""
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    mesh = make_multihost_mesh()
+    n = len(jax.devices())
+    data = make_synthetic_federated(
+        n_clients=n, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    gdata = shard_federated_data_global(data, n, mesh)
+    assert len(gdata.x_train.sharding.device_set) == n
+
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9, weight_decay=0.0,
+                     grad_clip=10.0, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = FedAvg(model, gdata, hp, loss_type="bce", frac=1.0, seed=0)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    state, metrics = algo.run_round(state, 0)
+    assert np.isfinite(float(metrics["train_loss"]))
+
+
+def test_make_multihost_mesh_shrinks_to_divide_clients():
+    n_dev = len(jax.devices())
+    mesh = make_multihost_mesh(num_clients=n_dev // 2)
+    assert mesh.shape["clients"] == n_dev // 2
+    # indivisible client count shrinks to the largest divisor
+    mesh = make_multihost_mesh(num_clients=6)
+    assert 6 % mesh.shape["clients"] == 0
+    mesh = make_multihost_mesh(max_client_devices=2)
+    assert mesh.shape["clients"] == 2
+
+
+def test_abcd_client_filter_loads_subset(tmp_path):
+    from neuroimagedisttraining_tpu.data.abcd import (
+        abcd_site_count,
+        load_partition_data_abcd,
+        load_partition_data_abcd_rescale,
+        write_abcd_h5,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(40, 5, 6, 5).astype(np.float32)
+    y = rng.randint(0, 2, size=40)
+    site = np.repeat(np.arange(4), 10)
+    path = str(tmp_path / "c.h5")
+    write_abcd_h5(path, X, y, site)
+
+    assert abcd_site_count(path) == 4
+    full = load_partition_data_abcd(path)
+    sub = load_partition_data_abcd(path, client_filter=[1, 3])
+    assert sub.num_clients == 2
+    np.testing.assert_array_equal(
+        np.asarray(sub.x_train[0, : int(sub.n_train[0])]),
+        np.asarray(full.x_train[1, : int(full.n_train[1])]))
+
+    full_r = load_partition_data_abcd_rescale(path, client_number=4)
+    sub_r = load_partition_data_abcd_rescale(path, client_number=4,
+                                             client_filter=[2])
+    np.testing.assert_array_equal(
+        np.asarray(sub_r.x_train[0, : int(sub_r.n_train[0])]),
+        np.asarray(full_r.x_train[2, : int(full_r.n_train[2])]))
