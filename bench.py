@@ -6,10 +6,16 @@ Run on real TPU hardware by the driver. Prints ONE JSON line:
 Workload (BASELINE.md north star): SalientGrads-style federated round on
 full-size ABCD volumes (121x145x121), AlexNet3D, 8 site-clients on the
 available chip(s) — broadcast, vmapped local SGD (5 steps x batch 8 per
-client), weighted aggregation, all one jitted program. ``vs_baseline``
-normalizes against the BASELINE.json target of 10 federated rounds/sec
-(v4-32); the reference itself publishes no throughput numbers (BASELINE.md).
+client), weighted aggregation, all one jitted program.
 
+``vs_baseline`` is the raw ratio against the BASELINE.json north star of
+10 federated rounds/sec — a 32-client v4-32 target this single-chip bench
+cannot demonstrate, so it reads well below 1 here by construction. The
+hardware-normalized auxiliary number ``client_rounds_per_sec_per_chip``
+in ``extra`` (target basis: 10 = 10 rounds/sec x 32 clients / 32 chips)
+shows how the per-chip work rate compares without assuming anything about
+multi-chip scaling. The reference itself publishes no throughput numbers
+(BASELINE.md).
 """
 from __future__ import annotations
 
@@ -101,6 +107,10 @@ def main():
 
     rounds_per_sec = n_rounds / dt
     samples_per_round = N_CLIENTS * STEPS * BATCH
+    n_chips = len(jax.devices())
+    # target basis: 10 rounds/sec x 32 clients / 32 chips (v4-32 north
+    # star) = 10 client-rounds/sec/chip; see module docstring
+    client_rounds_per_sec_per_chip = rounds_per_sec * N_CLIENTS / n_chips
     print(json.dumps({
         "metric": "salientgrads_rounds_per_sec_abcd_alexnet3d_8clients",
         "value": round(rounds_per_sec, 4),
@@ -108,7 +118,10 @@ def main():
         "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),
         "extra": {
             "client_samples_per_sec": round(rounds_per_sec * samples_per_round, 2),
-            "n_devices": len(jax.devices()),
+            "client_rounds_per_sec_per_chip": round(
+                client_rounds_per_sec_per_chip, 2),
+            "baseline_basis": "10 client-rounds/sec/chip (v4-32 north star)",
+            "n_devices": n_chips,
             "volume": list(VOLUME),
             "clients": N_CLIENTS,
             "local_steps": STEPS,

@@ -32,11 +32,19 @@ LocalTrainFn = Callable[[Any, int], Tuple[Any, int, float]]
 
 
 class CrossSiloServer(ServerManager):
-    """Rank-0 aggregator."""
+    """Rank-0 aggregator.
 
-    def __init__(self, comm, world_size: int, global_params: Any):
+    ``mask``: optional 0/1 pytree — when set, params travel sparse (values
+    + bitmap, ``Message.add_masked_tensor``), the communication-efficient
+    transport SalientGrads' sparse models enable; clients mirror the mask
+    in their replies.
+    """
+
+    def __init__(self, comm, world_size: int, global_params: Any,
+                 mask: Any = None):
         super().__init__(comm, rank=0, world_size=world_size)
         self.global_params = global_params
+        self.mask = mask
         self._updates: "queue.Queue[Message]" = queue.Queue()
         self.register_message_receive_handler(
             Message.MSG_TYPE_LOCAL_UPDATE, self._updates.put)
@@ -46,7 +54,11 @@ class CrossSiloServer(ServerManager):
         for dest in range(1, self.world_size):
             msg = Message(Message.MSG_TYPE_GLOBAL_MODEL, 0, dest)
             msg.add("round", round_idx)
-            msg.add_tensor("params", self.global_params)
+            if self.mask is not None:
+                msg.add("sparse", True)
+                msg.add_masked_tensor("params", self.global_params, self.mask)
+            else:
+                msg.add_tensor("params", self.global_params)
             self.send_message(msg)
         updates: List[Tuple[Any, float]] = []
         losses: List[float] = []
@@ -111,7 +123,28 @@ class CrossSiloClient(ClientManager):
         reply.add("round", round_idx)
         reply.add("n_samples", int(n_samples))
         reply.add("train_loss", float(loss))
-        reply.add_tensor("params", new_params)
+        if msg.get("sparse"):
+            # mirror the server's sparsity pattern (recovered from the
+            # sparse payload's bitmap). Sparse transport REQUIRES a
+            # mask-respecting train_fn (SalientGrads-style: params are
+            # re-masked after every step) — silently dropping off-mask
+            # updates would corrupt a dense trainer's result, so verify.
+            import jax as _jax
+
+            mask = msg.get_tensor_mask("params")
+            off = _jax.tree_util.tree_map(
+                lambda p, m: bool(np.any(np.asarray(p)[np.asarray(m) == 0])),
+                new_params, mask)
+            if any(_jax.tree_util.tree_leaves(off)):
+                raise ValueError(
+                    "sparse transport: local_train_fn produced nonzero "
+                    "off-mask weights; use a mask-respecting trainer "
+                    "(e.g. SalientGrads' post-step re-masking) or run the "
+                    "server with mask=None")
+            reply.add("sparse", True)
+            reply.add_masked_tensor("params", new_params, mask)
+        else:
+            reply.add_tensor("params", new_params)
         self.send_message(reply)
 
     def _on_finish(self, msg: Message) -> None:

@@ -18,6 +18,37 @@ import numpy as np
 MAGIC = b"NIDT"
 
 
+class _SparseLeaf:
+    """Mask-sparse array: nonzero values + a packed 1-bit/element bitmap."""
+
+    __slots__ = ("values", "bitmap", "shape", "dtype")
+
+    def __init__(self, values: np.ndarray, bitmap: np.ndarray,
+                 shape: Tuple[int, ...], dtype):
+        self.values = values
+        self.bitmap = bitmap
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    @classmethod
+    def from_dense(cls, leaf, mask) -> "_SparseLeaf":
+        arr = np.asarray(leaf)
+        m = np.asarray(mask).reshape(-1) != 0
+        values = np.ascontiguousarray(arr.reshape(-1)[m])
+        return cls(values, np.packbits(m), arr.shape, arr.dtype)
+
+    def to_dense(self) -> np.ndarray:
+        n = int(np.prod(self.shape)) if self.shape else 1
+        m = np.unpackbits(self.bitmap, count=n).astype(bool)
+        out = np.zeros(n, self.dtype)
+        out[m] = self.values
+        return out.reshape(self.shape)
+
+
+def _is_msg_leaf(x) -> bool:
+    return isinstance(x, _SparseLeaf)
+
+
 class Message:
     # op-type constants (message.py:12-15)
     MSG_OP_SEND = "send"
@@ -54,8 +85,47 @@ class Message:
     def add_tensor(self, key: str, tree: Any) -> None:
         self.tensors[key] = tree
 
+    def add_masked_tensor(self, key: str, tree: Any, mask: Any) -> None:
+        """Attach a sparse pytree: only values where ``mask != 0`` ride the
+        wire, plus a 1-bit/element bitmap.
+
+        This is the transport SalientGrads-style sparse FL actually wants:
+        the reference *counts* nonzero comm params
+        (``model_trainer.py:49-53``) but still ships dense state_dicts;
+        here a dense_ratio-0.5 bf16 model costs ~2.5 bytes/param instead
+        of 4 (0.7 at ratio 0.05). ``get_tensor`` densifies transparently
+        (zeros off-mask).
+        """
+        import jax
+
+        self.tensors[key] = jax.tree_util.tree_map(
+            lambda leaf, m: _SparseLeaf.from_dense(leaf, m), tree, mask)
+
     def get_tensor(self, key: str) -> Any:
-        return self.tensors[key]
+        import jax
+
+        tree = self.tensors[key]
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf.to_dense()
+            if isinstance(leaf, _SparseLeaf) else leaf,
+            tree, is_leaf=_is_msg_leaf)
+
+    def get_tensor_mask(self, key: str) -> Any:
+        """0/1 float mask tree of a (sparse) tensor entry — the bitmap
+        rides free with every sparse payload, so receivers recover the
+        sparsity pattern without a separate mask message. Dense leaves
+        yield all-ones."""
+        import jax
+
+        def leaf_mask(leaf):
+            if isinstance(leaf, _SparseLeaf):
+                n = int(np.prod(leaf.shape)) if leaf.shape else 1
+                return np.unpackbits(leaf.bitmap, count=n).astype(
+                    np.float32).reshape(leaf.shape)
+            return np.ones(np.asarray(leaf).shape, np.float32)
+
+        return jax.tree_util.tree_map(
+            leaf_mask, self.tensors[key], is_leaf=_is_msg_leaf)
 
     @property
     def type(self) -> str:
@@ -89,9 +159,25 @@ class Message:
         for key, tree in self.tensors.items():
             import jax
 
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                tree, is_leaf=_is_msg_leaf)
             entries = []
             for leaf in leaves:
+                if isinstance(leaf, _SparseLeaf):
+                    vraw = leaf.values.tobytes()
+                    braw = leaf.bitmap.tobytes()
+                    entries.append({
+                        "kind": "sparse",
+                        "dtype": leaf.dtype.str,
+                        "shape": list(leaf.shape),
+                        "offset": offset,
+                        "nbytes": len(vraw),
+                        "bitmap_nbytes": len(braw),
+                    })
+                    leaves_blob.append(vraw)
+                    leaves_blob.append(braw)
+                    offset += len(vraw) + len(braw)
+                    continue
                 arr = np.asarray(leaf)
                 raw = np.ascontiguousarray(arr).tobytes()
                 entries.append({
@@ -124,8 +210,19 @@ class Message:
             leaves = []
             for e in spec["leaves"]:
                 start = base + e["offset"]
+                dtype = np.dtype(e["dtype"])
+                if e.get("kind") == "sparse":
+                    nnz = e["nbytes"] // dtype.itemsize
+                    values = np.frombuffer(
+                        payload, dtype=dtype, count=nnz, offset=start)
+                    bitmap = np.frombuffer(
+                        payload, dtype=np.uint8, count=e["bitmap_nbytes"],
+                        offset=start + e["nbytes"])
+                    leaves.append(_SparseLeaf(
+                        values, bitmap, tuple(e["shape"]), dtype))
+                    continue
                 arr = np.frombuffer(
-                    payload, dtype=np.dtype(e["dtype"]),
+                    payload, dtype=dtype,
                     count=int(np.prod(e["shape"])) if e["shape"] else 1,
                     offset=start,
                 ).reshape(e["shape"])

@@ -85,7 +85,7 @@ LAYOUTS = ("channels", "flat", "s2d")
 
 def _finalize(
     xs_tr, ys_tr, xs_te, ys_te, val_fraction: float, seed: int,
-    normalize: bool, layout: str = "channels",
+    normalize: bool, layout: str = "channels", pad_to=None,
 ) -> FederatedData:
     """Stack per-client splits into FederatedData; optional per-volume
     standardization; optional val split carved from train (the FedFomo
@@ -98,6 +98,11 @@ def _finalize(
         algorithms' ``channel_inject=True`` (apply-time unsqueeze).
       * ``"s2d"``      — (..., 8, D', H', W') phase-decomposed for the
         ``3dcnn_s2d`` stem (fastest ABCD path on TPU).
+
+    ``pad_to``: optional (train, test) padded lengths. Filtered
+    (per-process multi-host) loads MUST pass the global maxima here — each
+    process pads to the same extents so every host computes identical
+    global array shapes (sites have unequal subject counts).
     """
     if layout not in LAYOUTS:
         raise ValueError(f"layout {layout!r} not in {LAYOUTS}")
@@ -138,14 +143,25 @@ def _finalize(
             ys_va.append(y[perm[:n_val]])
         xs_tr, ys_tr = new_tr_x, new_tr_y
 
-    x_train, n_train = pad_stack([prep(x) for x in xs_tr])
-    y_train, _ = pad_stack([np.asarray(y, np.int32) for y in ys_tr])
-    x_test, n_test = pad_stack([prep(x) for x in xs_te])
-    y_test, _ = pad_stack([np.asarray(y, np.int32) for y in ys_te])
+    pad_tr, pad_te = pad_to if pad_to is not None else (None, None)
+    pad_va = None
+    if val_fraction > 0 and pad_tr is not None:
+        # the val split carves int(n*val) rows out of each train shard;
+        # both n - int(n*vf) and int(n*vf) are nondecreasing in n, so the
+        # global maxima follow from the global max train count
+        pad_va = int(pad_tr * val_fraction)
+        pad_tr = pad_tr - pad_va
+    x_train, n_train = pad_stack([prep(x) for x in xs_tr], pad_to=pad_tr)
+    y_train, _ = pad_stack([np.asarray(y, np.int32) for y in ys_tr],
+                           pad_to=pad_tr)
+    x_test, n_test = pad_stack([prep(x) for x in xs_te], pad_to=pad_te)
+    y_test, _ = pad_stack([np.asarray(y, np.int32) for y in ys_te],
+                          pad_to=pad_te)
     kwargs = {}
     if val_fraction > 0:
-        x_val, n_val = pad_stack([prep(x) for x in xs_va])
-        y_val, _ = pad_stack([np.asarray(y, np.int32) for y in ys_va])
+        x_val, n_val = pad_stack([prep(x) for x in xs_va], pad_to=pad_va)
+        y_val, _ = pad_stack([np.asarray(y, np.int32) for y in ys_va],
+                             pad_to=pad_va)
         kwargs = dict(x_val=x_val, y_val=y_val, n_val=n_val)
     return FederatedData(
         x_train=x_train, y_train=y_train, n_train=n_train,
@@ -183,7 +199,12 @@ def load_partition_data_abcd(
     X, y, site = load_abcd_h5(data_path)
     splits = site_train_test_split(site, seed=seed)
     items = list(splits.items())
+    pad_to = None
     if client_filter is not None:
+        # pad every process's shards to the GLOBAL maxima (sites are
+        # unequal-sized; computed from index lengths, no volume IO)
+        pad_to = (max(len(tr) for tr, _ in splits.values()),
+                  max(len(te) for _, te in splits.values()))
         items = [items[int(c)] for c in client_filter]
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
     for s, (tr, te) in items:
@@ -194,7 +215,7 @@ def load_partition_data_abcd(
         logger.info("site %s: %d train / %d test", s, len(tr), len(te))
     _close_if_h5(X)
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout)
+                     normalize, layout, pad_to=pad_to)
 
 
 def load_partition_data_abcd_rescale(
@@ -219,6 +240,14 @@ def load_partition_data_abcd_rescale(
     s_tr = len(tr_idx) // client_number
     clients = (range(client_number) if client_filter is None
                else [int(c) for c in client_filter])
+    pad_to = None
+    if client_filter is not None:
+        # test windows vary by +-1 row from the int() rounding — pad to
+        # the global maxima so all processes agree on shapes
+        te_sizes = [int((c + 1) * s_tr * ABCD_TEST_RATIO)
+                    - int(c * s_tr * ABCD_TEST_RATIO)
+                    for c in range(client_number)]
+        pad_to = (s_tr, max(te_sizes))
     xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
     for c in clients:
         rows_tr = tr_idx[c * s_tr: (c + 1) * s_tr]
@@ -233,7 +262,7 @@ def load_partition_data_abcd_rescale(
                     len(rows_te))
     _close_if_h5(X)
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout)
+                     normalize, layout, pad_to=pad_to)
 
 
 def _close_if_h5(X) -> None:

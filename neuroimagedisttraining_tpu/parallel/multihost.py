@@ -119,6 +119,14 @@ def make_multihost_mesh(n_space: int = 1,
         if num_clients % rows or rows % n_proc:
             raise ValueError(
                 f"cannot lay {num_clients} clients over {n_proc} processes")
+    else:
+        # even without a client count, rows must split evenly over
+        # processes or the balanced device selection below under-fills
+        rows -= rows % n_proc
+        if rows < n_proc:
+            raise ValueError(
+                f"clients axis of {rows} rows cannot span {n_proc} "
+                "processes; raise max_client_devices")
     # take an equal number of devices from every process, so a shrunk
     # clients axis still spreads across all hosts (a global-order prefix
     # would put every row on the first hosts and starve the rest)

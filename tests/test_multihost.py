@@ -115,3 +115,38 @@ def test_abcd_client_filter_loads_subset(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(sub_r.x_train[0, : int(sub_r.n_train[0])]),
         np.asarray(full_r.x_train[2, : int(full_r.n_train[2])]))
+
+
+def test_abcd_client_filter_uneven_sites_pad_globally(tmp_path):
+    """Filtered (per-process) loads must pad to the GLOBAL maxima so every
+    process computes the same global array shapes (sites are unequal)."""
+    from neuroimagedisttraining_tpu.data.abcd import (
+        load_partition_data_abcd,
+        write_abcd_h5,
+    )
+
+    rng = np.random.RandomState(0)
+    site = np.concatenate([np.zeros(14), np.ones(14), np.full(8, 2),
+                           np.full(8, 3)]).astype(np.int64)
+    n = len(site)
+    X = rng.rand(n, 5, 6, 5).astype(np.float32)
+    y = rng.randint(0, 2, size=n)
+    path = str(tmp_path / "c.h5")
+    write_abcd_h5(path, X, y, site)
+
+    full = load_partition_data_abcd(path)
+    a = load_partition_data_abcd(path, client_filter=[0, 1])
+    b = load_partition_data_abcd(path, client_filter=[2, 3])
+    # same padded extents on both "processes", equal to the global ones
+    assert a.x_train.shape[1:] == b.x_train.shape[1:] == \
+        full.x_train.shape[1:]
+    assert a.x_test.shape[1:] == b.x_test.shape[1:] == full.x_test.shape[1:]
+    # and with a val split too
+    av = load_partition_data_abcd(path, client_filter=[0, 1],
+                                  val_fraction=0.25)
+    bv = load_partition_data_abcd(path, client_filter=[2, 3],
+                                  val_fraction=0.25)
+    fv = load_partition_data_abcd(path, val_fraction=0.25)
+    assert av.x_train.shape[1:] == bv.x_train.shape[1:] == \
+        fv.x_train.shape[1:]
+    assert av.x_val.shape[1:] == bv.x_val.shape[1:] == fv.x_val.shape[1:]
