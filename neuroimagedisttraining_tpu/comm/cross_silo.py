@@ -51,12 +51,18 @@ class CrossSiloServer(ServerManager):
         self.history: List[Dict[str, float]] = []
 
     def run_round(self, round_idx: int, timeout_s: float = 120.0) -> Dict[str, float]:
+        sparse_payload = None
+        if self.mask is not None:
+            # sparsify once; the identical payload goes to every client
+            probe = Message(Message.MSG_TYPE_GLOBAL_MODEL, 0, 0)
+            probe.add_masked_tensor("params", self.global_params, self.mask)
+            sparse_payload = probe.tensors["params"]
         for dest in range(1, self.world_size):
             msg = Message(Message.MSG_TYPE_GLOBAL_MODEL, 0, dest)
             msg.add("round", round_idx)
-            if self.mask is not None:
+            if sparse_payload is not None:
                 msg.add("sparse", True)
-                msg.add_masked_tensor("params", self.global_params, self.mask)
+                msg.tensors["params"] = sparse_payload
             else:
                 msg.add_tensor("params", self.global_params)
             self.send_message(msg)
@@ -65,6 +71,13 @@ class CrossSiloServer(ServerManager):
         seen: set = set()
         while len(updates) < self.world_size - 1:
             msg = self._updates.get(timeout=timeout_s)
+            if msg.get("error"):
+                # a client detected a protocol violation (e.g. off-mask
+                # updates under sparse transport) — fail the round with
+                # the client's reason instead of timing out opaquely
+                raise RuntimeError(
+                    f"client {msg.sender_id} aborted round {round_idx}: "
+                    f"{msg.get('error')}")
             # drop stragglers from earlier rounds and duplicate senders —
             # averaging a stale round-r update into round r+1 would silently
             # corrupt the global model
@@ -110,6 +123,7 @@ class CrossSiloClient(ClientManager):
         super().__init__(comm, rank=rank, world_size=world_size)
         self.local_train_fn = local_train_fn
         self.done = threading.Event()
+        self.error: Optional[str] = None
         self.register_message_receive_handler(
             Message.MSG_TYPE_GLOBAL_MODEL, self._on_global_model)
         self.register_message_receive_handler(
@@ -136,11 +150,17 @@ class CrossSiloClient(ClientManager):
                 lambda p, m: bool(np.any(np.asarray(p)[np.asarray(m) == 0])),
                 new_params, mask)
             if any(_jax.tree_util.tree_leaves(off)):
-                raise ValueError(
-                    "sparse transport: local_train_fn produced nonzero "
-                    "off-mask weights; use a mask-respecting trainer "
-                    "(e.g. SalientGrads' post-step re-masking) or run the "
-                    "server with mask=None")
+                # the receive pump logs-and-continues on handler
+                # exceptions, so raising here would be invisible — tell
+                # the SERVER, which fails its round with this reason
+                err = ("sparse transport: local_train_fn produced nonzero "
+                       "off-mask weights; use a mask-respecting trainer "
+                       "(e.g. SalientGrads' post-step re-masking) or run "
+                       "the server with mask=None")
+                self.error = err
+                reply.add("error", err)
+                self.send_message(reply)
+                return
             reply.add("sparse", True)
             reply.add_masked_tensor("params", new_params, mask)
         else:

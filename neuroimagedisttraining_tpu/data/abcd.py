@@ -86,6 +86,7 @@ LAYOUTS = ("channels", "flat", "s2d")
 def _finalize(
     xs_tr, ys_tr, xs_te, ys_te, val_fraction: float, seed: int,
     normalize: bool, layout: str = "channels", pad_to=None,
+    client_ids=None,
 ) -> FederatedData:
     """Stack per-client splits into FederatedData; optional per-volume
     standardization; optional val split carved from train (the FedFomo
@@ -132,9 +133,14 @@ def _finalize(
 
     xs_va, ys_va = [], []
     if val_fraction > 0:
-        rng = np.random.RandomState(seed)
+        # per-client RNG keyed by the GLOBAL client id: a filtered
+        # (multi-host) load must carve the exact same train/val membership
+        # as the full load, independent of which other clients are present
+        ids = (client_ids if client_ids is not None
+               else list(range(len(xs_tr))))
         new_tr_x, new_tr_y = [], []
-        for x, y in zip(xs_tr, ys_tr):
+        for gid, (x, y) in zip(ids, zip(xs_tr, ys_tr)):
+            rng = np.random.RandomState((seed * 100003 + int(gid)) % 2**31)
             n_val = int(len(y) * val_fraction)
             perm = rng.permutation(len(y))
             new_tr_x.append(x[perm[n_val:]])
@@ -214,8 +220,10 @@ def load_partition_data_abcd(
         ys_te.append(y[te])
         logger.info("site %s: %d train / %d test", s, len(tr), len(te))
     _close_if_h5(X)
+    ids = (list(range(len(splits))) if client_filter is None
+           else [int(c) for c in client_filter])
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout, pad_to=pad_to)
+                     normalize, layout, pad_to=pad_to, client_ids=ids)
 
 
 def load_partition_data_abcd_rescale(
@@ -262,7 +270,8 @@ def load_partition_data_abcd_rescale(
                     len(rows_te))
     _close_if_h5(X)
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout, pad_to=pad_to)
+                     normalize, layout, pad_to=pad_to,
+                     client_ids=list(clients))
 
 
 def _close_if_h5(X) -> None:

@@ -3,6 +3,7 @@
 bilevel architect, genotype derivation, final-training model)."""
 from .architect import Architect, ArchitectState
 from .genotypes import DARTS, DARTS_V1, DARTS_V2, PRIMITIVES, Genotype
+from .visualize import cell_dot, genotype_dot, plot
 from .model import GenotypeCell, NetworkFromGenotype
 from .supernet import (
     GumbelSearchNetwork,
@@ -14,6 +15,9 @@ from .supernet import (
 from .train import search, train_genotype
 
 __all__ = [
+    "cell_dot",
+    "genotype_dot",
+    "plot",
     "Architect",
     "ArchitectState",
     "DARTS",

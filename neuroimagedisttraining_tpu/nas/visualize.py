@@ -1,0 +1,86 @@
+"""Genotype visualization: DOT graphs of DARTS cells.
+
+Rebuild of ``fedml_api/model/cv/darts/visualize.py:6-46`` (graphviz Digraph
+of a cell: c_{k-2}/c_{k-1} inputs, intermediate nodes 0..3, labeled op
+edges, c_{k} concat sink). Emits DOT source directly so the dependency on
+the ``graphviz`` binary/package is optional: :func:`cell_dot` always works;
+:func:`plot` renders to file when graphviz is importable and otherwise
+writes the ``.dot`` source next to the requested path.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Sequence, Tuple
+
+from .genotypes import Genotype
+
+logger = logging.getLogger(__name__)
+
+_STYLE = (
+    '  node [style=filled shape=box align=center fontsize=12 height=0.5 '
+    'width=0.5 penwidth=2 fontname="helvetica"];\n'
+    '  edge [fontsize=11 fontname="helvetica"];\n'
+)
+
+
+def cell_dot(ops: Sequence[Tuple[str, int]], concat: Sequence[int],
+             name: str = "cell") -> str:
+    """DOT source for one cell.
+
+    ``ops`` lists (primitive, input-state) pairs, two per intermediate
+    node; states 0/1 are the cell inputs c_{k-2}/c_{k-1}, state ``i+2`` is
+    intermediate node ``i`` (visualize.py's edge convention).
+    """
+    assert len(ops) % 2 == 0
+    steps = len(ops) // 2
+    lines: List[str] = [f"digraph {name} {{", "  rankdir=LR;", _STYLE]
+    lines.append('  "c_{k-2}" [fillcolor=darkseagreen2];')
+    lines.append('  "c_{k-1}" [fillcolor=darkseagreen2];')
+    for i in range(steps):
+        lines.append(f'  "{i}" [fillcolor=lightblue];')
+    lines.append('  "c_{k}" [fillcolor=palegoldenrod];')
+
+    def state_name(j: int) -> str:
+        if j == 0:
+            return "c_{k-2}"
+        if j == 1:
+            return "c_{k-1}"
+        return str(j - 2)
+
+    for i in range(steps):
+        for k in (2 * i, 2 * i + 1):
+            op, j = ops[k]
+            lines.append(
+                f'  "{state_name(j)}" -> "{i}" [label="{op}"];')
+    for j in concat:
+        lines.append(f'  "{state_name(j)}" -> "c_{{k}}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def genotype_dot(genotype: Genotype) -> Tuple[str, str]:
+    """(normal_dot, reduce_dot) for a genotype."""
+    return (cell_dot(genotype.normal, genotype.normal_concat, "normal"),
+            cell_dot(genotype.reduce, genotype.reduce_concat, "reduce"))
+
+
+def plot(genotype: Genotype, filename: str) -> List[str]:
+    """Render both cells. With graphviz installed this produces
+    ``<filename>_normal.<fmt>``/``_reduce`` images (visualize.py parity);
+    without it, the ``.dot`` sources are written instead. Returns the
+    written paths."""
+    written = []
+    for cell, dot in zip(("normal", "reduce"), genotype_dot(genotype)):
+        base = f"{filename}_{cell}"
+        try:
+            import graphviz
+
+            src = graphviz.Source(dot)
+            written.append(src.render(base, format="pdf", cleanup=True))
+        except Exception as e:  # no graphviz binary/package: keep the DOT
+            path = base + ".dot"
+            with open(path, "w") as f:
+                f.write(dot)
+            logger.info("graphviz unavailable (%s); wrote %s", e, path)
+            written.append(path)
+    return written

@@ -297,8 +297,9 @@ def test_cross_silo_sparse_transport_matches_dense():
 
 
 def test_cross_silo_sparse_rejects_dense_trainer():
-    """A dense (mask-ignoring) trainer under sparse transport must fail
-    loudly, not silently lose off-mask updates."""
+    """A dense (mask-ignoring) trainer under sparse transport must surface
+    the violation to the SERVER's round (not die invisibly in the client's
+    receive thread)."""
     from neuroimagedisttraining_tpu.comm import (
         CrossSiloClient,
         CrossSiloServer,
@@ -313,28 +314,13 @@ def test_cross_silo_sparse_rejects_dense_trainer():
     def dense_fn(params, r):
         return {"w": params["w"] + 1.0}, 10, 0.0  # violates the mask
 
-    errors = []
     client = CrossSiloClient(router.manager(1), 1, 2, dense_fn)
-    orig = client._on_global_model
-
-    def wrapped(msg):
-        try:
-            orig(msg)
-        except ValueError as e:
-            errors.append(e)
-    client.register_message_receive_handler(
-        Message.MSG_TYPE_GLOBAL_MODEL, wrapped)
     client.run(background=True)
+    server.run(background=True)
     try:
-        msg = Message(Message.MSG_TYPE_GLOBAL_MODEL, 0, 1)
-        msg.add("round", 0)
-        msg.add("sparse", True)
-        msg.add_masked_tensor("params", g0, mask)
-        server.send_message(msg)
-        deadline = time.time() + 10
-        while not errors and time.time() < deadline:
-            time.sleep(0.01)
-        assert errors and "off-mask" in str(errors[0])
+        with pytest.raises(RuntimeError, match="off-mask"):
+            server.run_round(0, timeout_s=30)
+        assert client.error and "off-mask" in client.error
     finally:
         client.finish()
         server.finish()

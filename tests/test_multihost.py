@@ -150,3 +150,33 @@ def test_abcd_client_filter_uneven_sites_pad_globally(tmp_path):
     assert av.x_train.shape[1:] == bv.x_train.shape[1:] == \
         fv.x_train.shape[1:]
     assert av.x_val.shape[1:] == bv.x_val.shape[1:] == fv.x_val.shape[1:]
+
+
+def test_abcd_client_filter_val_membership_matches_full(tmp_path):
+    """Filtered loads must carve the SAME train/val membership per client
+    as the full load (per-client RNG keyed by global id)."""
+    from neuroimagedisttraining_tpu.data.abcd import (
+        load_partition_data_abcd,
+        write_abcd_h5,
+    )
+
+    rng = np.random.RandomState(0)
+    site = np.repeat(np.arange(4), 12)
+    X = rng.rand(len(site), 5, 6, 5).astype(np.float32)
+    y = rng.randint(0, 2, size=len(site))
+    path = str(tmp_path / "c.h5")
+    write_abcd_h5(path, X, y, site)
+
+    full = load_partition_data_abcd(path, val_fraction=0.25)
+    sub = load_partition_data_abcd(path, client_filter=[2, 3],
+                                   val_fraction=0.25)
+    for local_i, gid in enumerate([2, 3]):
+        nv = int(sub.n_val[local_i])
+        assert nv == int(full.n_val[gid])
+        np.testing.assert_array_equal(
+            np.asarray(sub.x_val[local_i, :nv]),
+            np.asarray(full.x_val[gid, :nv]))
+        nt = int(sub.n_train[local_i])
+        np.testing.assert_array_equal(
+            np.asarray(sub.x_train[local_i, :nt]),
+            np.asarray(full.x_train[gid, :nt]))

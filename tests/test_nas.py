@@ -109,3 +109,30 @@ def test_train_genotype_from_preset_and_derived():
     assert hist[-1]["train_loss"] < hist[0]["train_loss"] * 1.5
     logits = net.apply({"params": params}, x[:4])
     assert logits.shape == (4, 4)
+
+
+def test_genotype_visualization():
+    """DOT emission for both cells (darts/visualize.py parity)."""
+    import os
+
+    from neuroimagedisttraining_tpu.nas.genotypes import DARTS_V2
+    from neuroimagedisttraining_tpu.nas.visualize import (
+        cell_dot,
+        genotype_dot,
+        plot,
+    )
+
+    normal, reduce = genotype_dot(DARTS_V2)
+    # every op edge appears with its primitive label
+    for op, j in DARTS_V2.normal:
+        assert op in normal
+    assert normal.count("->") == len(DARTS_V2.normal) + len(
+        DARTS_V2.normal_concat)
+    assert '"c_{k-2}"' in reduce and '"c_{k}"' in reduce
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        paths = plot(DARTS_V2, os.path.join(d, "geno"))
+        assert len(paths) == 2
+        for p in paths:
+            assert os.path.exists(p)
