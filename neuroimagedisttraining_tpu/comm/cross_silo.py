@@ -71,6 +71,15 @@ class CrossSiloServer(ServerManager):
         seen: set = set()
         while len(updates) < self.world_size - 1:
             msg = self._updates.get(timeout=timeout_s)
+            # drop stragglers from earlier rounds and duplicate senders —
+            # averaging a stale round-r update into round r+1 would silently
+            # corrupt the global model (a stale ERROR reply must not abort
+            # a later valid round either, so the round filter comes first)
+            if int(msg.get("round", -1)) != round_idx:
+                logger.warning(
+                    "dropping stale update from rank %d (round %s != %d)",
+                    msg.sender_id, msg.get("round"), round_idx)
+                continue
             if msg.get("error"):
                 # a client detected a protocol violation (e.g. off-mask
                 # updates under sparse transport) — fail the round with
@@ -78,14 +87,6 @@ class CrossSiloServer(ServerManager):
                 raise RuntimeError(
                     f"client {msg.sender_id} aborted round {round_idx}: "
                     f"{msg.get('error')}")
-            # drop stragglers from earlier rounds and duplicate senders —
-            # averaging a stale round-r update into round r+1 would silently
-            # corrupt the global model
-            if int(msg.get("round", -1)) != round_idx:
-                logger.warning(
-                    "dropping stale update from rank %d (round %s != %d)",
-                    msg.sender_id, msg.get("round"), round_idx)
-                continue
             if msg.sender_id in seen:
                 logger.warning("duplicate update from rank %d dropped",
                                msg.sender_id)

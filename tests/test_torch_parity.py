@@ -1,0 +1,106 @@
+"""Numerical parity vs PyTorch (CPU) for the conv/pool arithmetic.
+
+The model zoo's docstrings claim torch-exact spatial arithmetic (VALID
+convs with integer padding, floor-mode pooling — models/layers.py). The
+reference is a torch codebase, so these tests pin that claim directly:
+identical weights -> identical outputs, including the odd ABCD extents
+where floor/ceil choices diverge. (Full-model parity is out of scope by
+design: the zoo swaps BatchNorm3d for GroupNorm, a documented deviation.)
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.models.layers import (
+    Conv3d,
+    avg_pool3d,
+    max_pool3d,
+)
+
+
+def _rand(*shape):
+    return np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("kernel,stride,padding,shape", [
+    (5, 2, 0, (25, 29, 25)),   # the AlexNet3D stem arithmetic
+    (3, 1, 0, (11, 13, 11)),
+    (3, 1, 1, (7, 9, 7)),
+])
+def test_conv3d_matches_torch(kernel, stride, padding, shape):
+    cin, cout = 2, 4
+    x = _rand(1, *shape, cin)
+    w = _rand(kernel, kernel, kernel, cin, cout) * 0.2
+    b = _rand(cout) * 0.1
+
+    mod = Conv3d(cout, kernel_size=kernel, strides=stride, padding=padding)
+    params = {"Conv_0": {"kernel": jnp.asarray(w), "bias": jnp.asarray(b)}}
+    ours = np.asarray(mod.apply({"params": params}, jnp.asarray(x)))
+
+    tconv = torch.nn.Conv3d(cin, cout, kernel, stride=stride,
+                            padding=padding)
+    with torch.no_grad():
+        # flax kernel (D,H,W,I,O) -> torch (O,I,D,H,W)
+        tconv.weight.copy_(torch.from_numpy(
+            np.transpose(w, (4, 3, 0, 1, 2))))
+        tconv.bias.copy_(torch.from_numpy(b))
+        tx = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))
+        ref = tconv(tx).numpy()
+    ref = np.transpose(ref, (0, 2, 3, 4, 1))
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(59, 71, 59), (19, 23, 19), (9, 10, 11)])
+def test_maxpool3d_floor_mode_matches_torch(shape):
+    x = _rand(2, *shape, 3)
+    ours = np.asarray(max_pool3d(jnp.asarray(x), kernel=3, strides=3))
+    with torch.no_grad():
+        ref = torch.nn.MaxPool3d(3, stride=3)(
+            torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))).numpy()
+    ref = np.transpose(ref, (0, 2, 3, 4, 1))
+    assert ours.shape == ref.shape  # floor-mode extents
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+
+def test_avgpool3d_matches_torch():
+    x = _rand(1, 9, 12, 9, 2)
+    ours = np.asarray(avg_pool3d(jnp.asarray(x), kernel=3))
+    with torch.no_grad():
+        ref = torch.nn.AvgPool3d(3)(
+            torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))).numpy()
+    np.testing.assert_allclose(
+        ours, np.transpose(ref, (0, 2, 3, 4, 1)), rtol=1e-5)
+
+
+def test_alexnet3d_feature_extents_match_torch_chain():
+    """The 5-conv/3-pool AlexNet3D feature stack must produce the same
+    spatial extents as the equivalent torch chain on the canonical ABCD
+    volume — the flatten width (256) the reference's Linear layers assume
+    (salient_models.py:142-191)."""
+    import torch.nn as tnn
+
+    from neuroimagedisttraining_tpu.models.alexnet3d import _Features
+    from neuroimagedisttraining_tpu.models import init_params
+
+    chain = tnn.Sequential(
+        tnn.Conv3d(1, 64, 5, stride=2), tnn.MaxPool3d(3, 3),
+        tnn.Conv3d(64, 128, 3), tnn.MaxPool3d(3, 3),
+        tnn.Conv3d(128, 192, 3, padding=1),
+        tnn.Conv3d(192, 192, 3, padding=1),
+        tnn.Conv3d(192, 128, 3, padding=1), tnn.MaxPool3d(3, 3),
+    )
+    with torch.no_grad():
+        ref_shape = chain(torch.zeros(1, 1, 121, 145, 121)).shape  # N,C,D,H,W
+
+    feats = _Features()
+    params = feats.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 121, 145, 121, 1)))["params"]
+    out = feats.apply({"params": params}, jnp.zeros((1, 121, 145, 121, 1)))
+    assert tuple(out.shape) == (1, ref_shape[2], ref_shape[3], ref_shape[4],
+                                ref_shape[1])
+    assert int(np.prod(out.shape[1:])) == 256  # the reference Linear width
