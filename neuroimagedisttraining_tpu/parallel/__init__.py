@@ -1,3 +1,4 @@
+from .gossip import ring_mix
 from .mesh import make_mesh, shard_over_clients, replicate
 from .multihost import (
     initialize_distributed,
@@ -17,6 +18,7 @@ from .spatial import (
 )
 
 __all__ = [
+    "ring_mix",
     "make_mesh",
     "shard_over_clients",
     "replicate",
