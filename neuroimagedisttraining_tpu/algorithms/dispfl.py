@@ -35,6 +35,7 @@ from ..models import init_params
 from ..ops.sparsity import (
     cosine_annealing,
     erk_sparsities,
+    uniform_sparsities,
     fire_mask,
     kernel_flags,
     live_counts,
@@ -61,7 +62,16 @@ class DisPFL(FedAlgorithm):
                  anneal_factor: float = 0.5, neighbor_mode: str = "random",
                  active: float = 1.0, static_masks: bool = False,
                  total_rounds: int = 100, erk_power_scale: float = 1.0,
+                 sparsity_distribution: str = "erk",
+                 different_initial: bool = False, diff_spa: bool = False,
                  **kwargs):
+        """Mask-init variants (``dispfl_api.py:48-71``):
+        ``sparsity_distribution``: "erk" (default) or "uniform"
+        (``--uniform``). ``different_initial``: per-client independent
+        initial masks (reference default is one shared initial mask).
+        ``diff_spa``: clients cycle dense ratios [0.2,0.4,0.6,0.8,1.0]
+        (implies different_initial); densities persist through fire/regrow
+        because evolution preserves per-client live counts."""
         self.dense_ratio = dense_ratio
         self.anneal_factor = anneal_factor
         self.neighbor_mode = neighbor_mode
@@ -69,6 +79,13 @@ class DisPFL(FedAlgorithm):
         self.static_masks = static_masks
         self.total_rounds = total_rounds
         self.erk_power_scale = erk_power_scale
+        if sparsity_distribution not in ("erk", "uniform"):
+            raise ValueError(
+                f"sparsity_distribution {sparsity_distribution!r} not in "
+                "('erk', 'uniform')")
+        self.sparsity_distribution = sparsity_distribution
+        self.different_initial = different_initial or diff_spa
+        self.diff_spa = diff_spa
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -163,21 +180,40 @@ class DisPFL(FedAlgorithm):
         self._round_jit = jax.jit(round_fn)
         self._eval_personal = self._make_personal_eval()
 
+    def _client_sparsities(self, shapes, client_idx: int):
+        """Per-layer sparsities for one client's initial mask."""
+        ratio = self.dense_ratio
+        if self.diff_spa:
+            # dispfl_api.py:63-71: cycle dense ratios over clients
+            ratio = (0.2, 0.4, 0.6, 0.8, 1.0)[client_idx % 5]
+        if self.sparsity_distribution == "uniform":
+            return uniform_sparsities(shapes, ratio)
+        return erk_sparsities(shapes, ratio, self.erk_power_scale)
+
     def init_state(self, rng: jax.Array) -> DisPFLState:
         p_rng, m_rng, s_rng = jax.random.split(rng, 3)
         params = init_params(self.model, p_rng, self.init_sample_shape)
         shapes = param_shapes(params)
-        sp = erk_sparsities(shapes, self.dense_ratio, self.erk_power_scale)
-        mask_keys = jax.random.split(m_rng, self.num_clients)
-        masks = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs),
-            *[
+        if self.different_initial:
+            mask_keys = jax.random.split(m_rng, self.num_clients)
+            per_client = [
                 random_masks_from_sparsities(
-                    params, lambda n, s: sp[n], mask_keys[i]
+                    params,
+                    (lambda sp: lambda n, s: sp[n])(
+                        self._client_sparsities(shapes, i)),
+                    mask_keys[i],
                 )
                 for i in range(self.num_clients)
-            ],
-        )
+            ]
+            masks = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_client)
+        else:
+            # reference default: ONE shared initial mask — compute once
+            # and broadcast (not num_clients identical recomputations)
+            sp = self._client_sparsities(shapes, 0)
+            one = random_masks_from_sparsities(
+                params, lambda n, s: sp[n], m_rng)
+            masks = broadcast_tree(one, self.num_clients)
         stacked = broadcast_tree(params, self.num_clients)
         personal = jax.tree_util.tree_map(jnp.multiply, stacked, masks)
         return DisPFLState(personal_params=personal, masks=masks, rng=s_rng)

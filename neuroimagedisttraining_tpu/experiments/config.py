@@ -91,6 +91,9 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="enable round-granular orbax checkpointing here")
     p.add_argument("--resume", action="store_true",
                    help="resume from latest checkpoint in --checkpoint_dir")
+    p.add_argument("--logfile", type=str, default="",
+                   help="override the log filename (default: the run "
+                        "identity string, main_sailentgrads.py:248-253)")
     p.add_argument("--log_dir", type=str, default="LOG",
                    help="per-run file logs (main_sailentgrads.py:184-192)")
     p.add_argument("--results_dir", type=str, default="results",
@@ -134,6 +137,26 @@ def add_algo_args(p: argparse.ArgumentParser, algo: str) -> None:
                       help="freeze masks (no fire/regrow)")
             _add_once(p, "--erk_power_scale", type=float, default=1.0)
             _add_once(p, "--dis_gradient_check", action="store_true")
+            _add_once(p, "--uniform", action="store_true",
+                      help="flat per-layer sparsity instead of ERK "
+                           "(main_dispfl.py:102)")
+            _add_once(p, "--different_initial", action="store_true",
+                      help="per-client independent initial masks "
+                           "(main_dispfl.py:104; default is one shared)")
+            _add_once(p, "--diff_spa", action="store_true",
+                      help="clients cycle dense ratios 0.2..1.0 "
+                           "(main_dispfl.py:106)")
+            _add_once(p, "--save_masks", action="store_true",
+                      help="store final masks in stat_info "
+                           "(main_dispfl.py:103, dispfl_api.py:177-183)")
+            _add_once(p, "--record_mask_diff", action="store_true",
+                      help="store the pairwise mask hamming matrix in "
+                           "stat_info (main_dispfl.py:105)")
+            # accepted for reference CLI compatibility; inert in the
+            # reference too (defined in main_dispfl.py:97,100 but never
+            # consumed by its api/trainer)
+            _add_once(p, "--public_portion", type=float, default=0.0)
+            _add_once(p, "--strict_avg", action="store_true")
     elif algo == "subavg":
         _add_once(p, "--dense_ratio", type=float, default=0.5)
         _add_once(p, "--each_prune_ratio", type=float, default=0.2)

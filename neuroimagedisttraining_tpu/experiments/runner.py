@@ -131,7 +131,13 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                      neighbor_mode=args.cs, active=args.active,
                      static_masks=bool(args.static),
                      total_rounds=args.comm_round,
-                     erk_power_scale=args.erk_power_scale)
+                     erk_power_scale=args.erk_power_scale,
+                     sparsity_distribution=(
+                         "uniform" if getattr(args, "uniform", False)
+                         else "erk"),
+                     different_initial=getattr(args, "different_initial",
+                                               False),
+                     diff_spa=getattr(args, "diff_spa", False))
     elif algo_name == "dpsgd":
         extra = dict(neighbor_mode=args.cs)
     elif algo_name == "subavg":
@@ -212,7 +218,7 @@ def maybe_shard(algo, args: argparse.Namespace):
 
 
 def save_stat_info(args: argparse.Namespace, identity: str,
-                   history, final_eval) -> Optional[str]:
+                   history, final_eval, extras=None) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -230,6 +236,7 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         "person_test_acc": [h.get("personal_acc") for h in history
                             if "personal_acc" in h],
     }
+    stat_info.update(extras or {})
     with open(path, "wb") as f:
         pickle.dump(stat_info, f)
     with open(path + ".json", "w") as f:
@@ -244,7 +251,8 @@ def run_experiment(args: argparse.Namespace,
     algo_name = algo_name or getattr(args, "algo", "fedavg")
     identity = run_identity(args, algo_name)
     configure_console()
-    log_handler = add_run_file_logger(args.log_dir, identity)
+    log_handler = add_run_file_logger(
+        args.log_dir, getattr(args, "logfile", "") or identity)
     ckpt_mgr = None
     try:
         logger.info("run identity: %s", identity)
@@ -309,7 +317,18 @@ def run_experiment(args: argparse.Namespace,
 
         if final_eval is None:  # last round wasn't an eval round
             final_eval = algo.evaluate(state)
-        stat_path = save_stat_info(args, identity, history, final_eval)
+        extras = {}
+        if getattr(args, "save_masks", False) and hasattr(state, "masks"):
+            # dispfl_api.py:177-183: final boolean masks in stat_info
+            extras["final_masks"] = jax.tree_util.tree_map(
+                lambda m: np.asarray(m, np.bool_), state.masks)
+        if getattr(args, "record_mask_diff", False) and \
+                hasattr(algo, "mask_distance_matrix"):
+            # dispfl_api.py:170-175: pairwise mask hamming matrix
+            extras["mask_distance_matrix"] = np.asarray(
+                algo.mask_distance_matrix(state))
+        stat_path = save_stat_info(args, identity, history, final_eval,
+                                   extras)
         return {
             "identity": identity,
             "history": history,

@@ -161,6 +161,10 @@ def erk_sparsities(
     would exceed 1 become dense; epsilon balances the global budget.
     """
     density = dense_ratio
+    if density >= 1.0:
+        # fully dense (e.g. the diff_spa client at ratio 1.0) — the
+        # balancing iteration would divide by zero
+        return {name: 0.0 for name in shapes}
     dense_layers = set(tabu)
     while True:
         divisor = 0.0
@@ -186,6 +190,18 @@ def erk_sparsities(
     for name, shape in shapes.items():
         out[name] = 0.0 if name in dense_layers else 1.0 - eps * raw[name]
     return out
+
+
+def uniform_sparsities(
+    shapes: Dict[str, Tuple[int, ...]],
+    dense_ratio: float = 0.5,
+    tabu: Tuple[str, ...] = (),
+) -> Dict[str, float]:
+    """Flat per-layer sparsity: every non-tabu layer at ``1 - dense_ratio``
+    (the reference's ``calculate_sparsities(distribution="uniform")``,
+    ``DisPFL/my_model_trainer.py:42-46``; enabled by ``--uniform``)."""
+    return {name: 0.0 if name in tabu else 1.0 - dense_ratio
+            for name in shapes}
 
 
 def random_mask_array(

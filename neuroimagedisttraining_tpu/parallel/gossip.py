@@ -36,6 +36,12 @@ def ring_mix(
     ``dpsgd_api.py:169-178``).
     """
     n = mesh.shape[axis_name]
+    if n < 3:
+        raise ValueError(
+            f"ring_mix needs a clients axis of >= 3 (got {n}): with 2 "
+            "devices both rotations hit the same neighbor, which doubles "
+            "its weight relative to the normalized ring adjacency — use "
+            "the adjacency-contraction path for tiny rings")
     w_self, w_left, w_right = weights
     fwd = [(i, (i + 1) % n) for i in range(n)]   # receive from left
     bwd = [(i, (i - 1) % n) for i in range(n)]   # receive from right

@@ -221,3 +221,23 @@ def test_cli_abcd_s2d_layout(tmp_path):
     out = run_experiment(args, "fedavg")
     assert len(out["history"]) == 1
     assert np.isfinite(out["history"][0]["train_loss"])
+
+
+def test_dispfl_cli_variant_flags(tmp_path):
+    """--uniform/--different_initial/--save_masks/--record_mask_diff flow
+    through the CLI to the algorithm and stat_info."""
+    import pickle
+
+    args = parse_args(_argv(tmp_path) + [
+        "--uniform", "--different_initial", "--save_masks",
+        "--record_mask_diff", "--comm_round", "1"], algo="dispfl")
+    out = run_experiment(args, "dispfl")
+    with open(out["stat_path"], "rb") as f:
+        stat = pickle.load(f)
+    assert "final_masks" in stat
+    assert stat["mask_distance_matrix"].shape == (4, 4)
+    # inert reference-compat flags parse too
+    args = parse_args(_argv(tmp_path) + [
+        "--strict_avg", "--public_portion", "0.1",
+        "--logfile", "custom_run"], algo="dispfl")
+    assert args.strict_avg and args.public_portion == 0.1
