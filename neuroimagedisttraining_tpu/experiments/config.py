@@ -157,6 +157,10 @@ def add_algo_args(p: argparse.ArgumentParser, algo: str) -> None:
             # consumed by its api/trainer)
             _add_once(p, "--public_portion", type=float, default=0.0)
             _add_once(p, "--strict_avg", action="store_true")
+            _add_once(p, "--global_test", action="store_true",
+                      help="identity-tag only, as in the reference "
+                           "(main_dispfl.py:198-199 appends '-g' and "
+                           "nothing consumes it further)")
     elif algo == "subavg":
         _add_once(p, "--dense_ratio", type=float, default=0.5)
         _add_once(p, "--each_prune_ratio", type=float, default=0.2)
@@ -225,6 +229,8 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         if v is not None:
             parts.append(f"{extra.replace('_', '')}{v:g}"
                          if isinstance(v, float) else f"{extra[:4]}{v}")
+    if getattr(args, "global_test", False):
+        parts.append("g")  # main_dispfl.py:198-199
     if args.tag:
         parts.append(args.tag)
     return "-".join(str(x) for x in parts)

@@ -236,11 +236,14 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         "person_test_acc": [h.get("personal_acc") for h in history
                             if "personal_acc" in h],
     }
+    json_safe_keys = list(stat_info)  # extras are pickle-only: the JSON
+    # sidecar would stringify (and numpy would elide) large mask arrays
     stat_info.update(extras or {})
     with open(path, "wb") as f:
         pickle.dump(stat_info, f)
     with open(path + ".json", "w") as f:
-        json.dump(stat_info, f, default=str, indent=1)
+        json.dump({k: stat_info[k] for k in json_safe_keys}, f,
+                  default=str, indent=1)
     return path
 
 

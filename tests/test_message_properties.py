@@ -46,7 +46,9 @@ def _assert_tree_equal(a, b):
     assert ta == tb or str(ta) == str(tb).replace("tuple", "list") or \
         _structs_match(a, b)
     for x, y in zip(la, lb):
-        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype, (xa.dtype, ya.dtype)
+        np.testing.assert_array_equal(xa, ya)
 
 
 def _structs_match(a, b):
