@@ -97,7 +97,7 @@ def _finalize(
         note the trailing C=1 tile-pads 8-16x in HBM.
       * ``"flat"``     — (..., D, H, W) channel-less; pair with the
         algorithms' ``channel_inject=True`` (apply-time unsqueeze).
-      * ``"s2d"``      — (..., 8, D', H', W') phase-decomposed for the
+      * ``"s2d"``      — (..., D', H', 8, W') phase-decomposed for the
         ``3dcnn_s2d`` stem (fastest ABCD path on TPU).
 
     ``pad_to``: optional (train, test) padded lengths. Filtered

@@ -41,7 +41,7 @@ def _registry():
         # reference names (main_*.py --model flags)
         "3dcnn": lambda num_classes, **kw: AlexNet3D(num_classes=num_classes, **kw),
         # TPU-fast AlexNet3D over phase-decomposed input (ops/s2d.py);
-        # same hypothesis class + outputs, input is (8, D', H', W') phased
+        # same hypothesis class + outputs, input is (D', H', 8, W') phased
         "3dcnn_s2d": lambda num_classes, **kw: AlexNet3DS2D(num_classes=num_classes, **kw),
         "3dcnn_deeper": lambda num_classes, **kw: AlexNet3DDeeper(num_classes=num_classes, **kw),
         "3dcnn_regression": lambda num_classes, **kw: AlexNet3DRegression(

@@ -60,7 +60,7 @@ class _Features(nn.Module):
 class S2DStem(nn.Module):
     """Phase-decomposed stem: the TPU-fast form of Conv3d(1->F, k5, s2).
 
-    Consumes the phased NCDHW batch ``(B, 8, D', H', W')`` produced by
+    Consumes the phased NDHCW batch ``(B, D', H', 8, W')`` produced by
     ``ops.s2d.phase_decompose`` and emits the exact activations of the
     reference stem in NDHWC. The 91 structurally-unused kernel slots are
     masked to zero at apply time so the hypothesis class stays identical
@@ -87,7 +87,7 @@ class S2DStem(nn.Module):
         b = self.param("bias", nn.initializers.zeros, (self.features,))
         mask = jnp.asarray(stem_slot_mask(), w.dtype)
         dn = lax.conv_dimension_numbers(
-            x.shape, w.shape, ("NCDHW", "DHWIO", "NDHWC"))
+            x.shape, w.shape, ("NDHCW", "DHWIO", "NDHWC"))
         y = lax.conv_general_dilated(
             x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn)
         return y + b
@@ -97,7 +97,7 @@ class AlexNet3DS2D(nn.Module):
     """AlexNet3D over phase-decomposed input — same function class and
     output as :class:`AlexNet3D`, restated for the MXU (see ops/s2d.py).
 
-    Input: ``(B, 8, 61, 73, 61)`` phased volumes (for the canonical
+    Input: ``(B, 61, 73, 8, 61)`` phased volumes (for the canonical
     121x145x121 ABCD volume) instead of ``(B, 121, 145, 121, 1)``.
     """
 

@@ -11,9 +11,13 @@ conv with C_in=8 — mathematically identical outputs, ~2x measured step
 speedup, zero per-step layout cost.
 
 Two layout decisions matter on TPU and are encoded here:
-  * Phases ride as a LEADING channel axis (NCDHW): the last two dims of
-    the stored array stay large spatial extents, so HBM tile padding is
-    ~2.3x instead of the 16x a trailing phase-of-8 axis would cost.
+  * Phases ride NEXT-TO-MINOR (NDHCW — sample shape (D', H', 8, W')):
+    the phase extent of 8 exactly fills the sublane tile and W' stays the
+    lane dim. HBM padding is the same ~2.3x as a leading phase axis;
+    isolated gather+conv measures ~14% faster than NCDHW (no relayout
+    copy), though the fully-fused training round compiles to the same
+    speed either way. A TRAILING phase axis would tile-pad 16x and is
+    right out.
   * The remapped kernel has 3^3 x 8 = 216 slots of which 125 carry the
     original taps; the other 91 are structurally zero and are kept zero by
     a constant mask at apply time, so the model class is exactly the
@@ -51,11 +55,12 @@ def phase_extent(size: int) -> int:
 
 
 def phase_decompose(x) -> jax.Array:
-    """(..., D, H, W) single-channel volume -> (..., 8, D', H', W') phased.
+    """(..., D, H, W) single-channel volume -> (..., D', H', 8, W') phased.
 
     Works on numpy or jax arrays; pads each spatial dim with zeros so every
     phase subgrid has the exact extent (padding never reaches any valid
-    conv window). Phase index is ``pd*4 + ph*2 + pw``.
+    conv window). Phase index is ``pd*4 + ph*2 + pw``, stored on the
+    next-to-minor axis (see module docstring for the layout rationale).
     """
     xp = jnp if isinstance(x, jax.Array) else np
     D, H, W = x.shape[-3:]
@@ -69,7 +74,7 @@ def phase_decompose(x) -> jax.Array:
         x[..., i::2, j::2, k::2][..., :exts[0], :exts[1], :exts[2]]
         for i in (0, 1) for j in (0, 1) for k in (0, 1)
     ]
-    return xp.stack(phases, axis=-4)
+    return xp.stack(phases, axis=-2)
 
 
 def remap_stem_kernel(w) -> jax.Array:
@@ -116,6 +121,6 @@ def convert_alexnet3d_params(params) -> dict:
 
 
 def phased_sample_shape(volume: Tuple[int, int, int]) -> Tuple[int, ...]:
-    """Stored per-sample shape for a (D, H, W) volume: (8, D', H', W')."""
+    """Stored per-sample shape for a (D, H, W) volume: (D', H', 8, W')."""
     d, h, w = volume
-    return (N_PHASES, phase_extent(d), phase_extent(h), phase_extent(w))
+    return (phase_extent(d), phase_extent(h), N_PHASES, phase_extent(w))

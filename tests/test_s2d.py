@@ -33,7 +33,7 @@ def _ref_conv(x, w):
 
 def _phased_conv(xs, w2):
     dn = lax.conv_dimension_numbers(
-        xs.shape, w2.shape, ("NCDHW", "DHWIO", "NDHWC"))
+        xs.shape, w2.shape, ("NDHCW", "DHWIO", "NDHWC"))
     return lax.conv_general_dilated(
         xs, w2, (1, 1, 1), "VALID", dimension_numbers=dn)
 
@@ -42,11 +42,12 @@ def test_phase_decompose_roundtrip_values():
     x = np.arange(np.prod(VOL), dtype=np.float32).reshape(VOL)
     ph = phase_decompose(x)
     assert ph.shape == phased_sample_shape(VOL)
-    # phase p at index i must equal x[2i + p] (zero-padded past the edge)
+    # phase p at index i must equal x[2i + p] (zero-padded past the edge);
+    # phases live on the next-to-minor axis (ops/s2d.py layout rationale)
     d_e = phase_extent(VOL[0])
     for p_idx, (i, j, k) in enumerate(
             [(i, j, k) for i in (0, 1) for j in (0, 1) for k in (0, 1)]):
-        sub = ph[p_idx]
+        sub = ph[:, :, p_idx, :]
         assert sub[0, 0, 0] == x[i, j, k]
         assert sub[1, 1, 1] == x[2 + i, 2 + j, 2 + k]
     assert d_e == (VOL[0] - 5) // 2 + 1 + 2
