@@ -189,3 +189,79 @@ def test_pubsub_manager_observer_dispatch():
         client.finish()
         server.finish()
         broker.stop()
+
+
+def test_pubsub_concurrent_uplink_storm():
+    """Many clients publishing concurrently must all land at the server
+    intact (per-connection broker threads + send locks under load)."""
+    world = 9
+    broker = PubSubBroker()
+    server = PubSubCommManager(0, broker.host, broker.port, world_size=world)
+    clients = [PubSubCommManager(c, broker.host, broker.port,
+                                 world_size=world)
+               for c in range(1, world)]
+    try:
+        payload = np.random.RandomState(0).randn(64, 64).astype(np.float32)
+        n_each = 5
+
+        def blast(mgr, cid):
+            for r in range(n_each):
+                m = Message("client_local_update", sender_id=cid,
+                            receiver_id=0)
+                m.add("round", r)
+                m.add_tensor("w", {"p": payload + cid})
+                mgr.send_message(m)
+
+        threads = [threading.Thread(target=blast, args=(mgr, i + 1))
+                   for i, mgr in enumerate(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        got = []
+        for _ in range((world - 1) * n_each):
+            msg = server.recv(timeout_s=20)
+            assert msg is not None
+            np.testing.assert_array_equal(
+                msg.get_tensor("w")["p"], payload + msg.sender_id)
+            got.append((msg.sender_id, msg.get("round")))
+        assert len(set(got)) == (world - 1) * n_each  # no dup/loss
+    finally:
+        for mgr in clients:
+            mgr.finalize()
+        server.finalize()
+        broker.stop()
+
+
+@needs_grpc
+def test_grpc_concurrent_sends_one_receiver():
+    from neuroimagedisttraining_tpu.comm import GrpcCommManager
+
+    world = 5
+    server = GrpcCommManager(0, [("127.0.0.1", 0)] * world)
+    eps = [("127.0.0.1", server.port)] + [("127.0.0.1", 0)] * (world - 1)
+    clients = [GrpcCommManager(r, list(eps)) for r in range(1, world)]
+    try:
+        def blast(mgr, cid):
+            for r in range(6):
+                m = Message("up", sender_id=cid, receiver_id=0)
+                m.add("round", r)
+                mgr.send_message(m)
+
+        threads = [threading.Thread(target=blast, args=(mgr, i + 1))
+                   for i, mgr in enumerate(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        seen = set()
+        for _ in range((world - 1) * 6):
+            msg = server.recv(timeout_s=20)
+            assert msg is not None
+            seen.add((msg.sender_id, msg.get("round")))
+        assert len(seen) == (world - 1) * 6
+    finally:
+        for c in clients:
+            c.finalize()
+        server.finalize()
