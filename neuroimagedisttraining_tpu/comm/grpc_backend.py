@@ -37,7 +37,7 @@ MAX_MESSAGE_BYTES = 1 << 30
 _SERVICE_METHOD = "/nidt.comm.CommManager/SendMessage"
 
 _PROTO_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native", "comm",
 )
 _GEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_generated")

@@ -23,7 +23,7 @@ from .message import Message
 logger = logging.getLogger(__name__)
 
 _SRC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native", "comm", "tcp_comm.cpp",
 )
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")

@@ -5,7 +5,7 @@
 # so only --python_out is needed — no grpcio-tools plugin dependency.
 set -e
 cd "$(dirname "$0")"
-OUT="../../neuroimagedisttraining_tpu/comm/_generated"
+OUT="../../comm/_generated"
 mkdir -p "$OUT"
 touch "$OUT/__init__.py"
 protoc --python_out="$OUT" -I. comm_manager.proto
