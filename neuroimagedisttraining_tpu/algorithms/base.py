@@ -102,6 +102,43 @@ class FedAlgorithm(abc.ABC):
         """Evaluate per the reference protocol (global and/or personal
         per-client accuracy, mean over clients — sailentgrads_api.py:231-285)."""
 
+    def finalize(self, state: Any):
+        """Optional end-of-training pass after the last round. Returns
+        ``(state, record_or_None)``; the record (if any) is appended to the
+        run history with ``round = -1`` (the reference's convention for the
+        FedAvg final fine-tune pass, ``fedavg_api.py:79-88``)."""
+        return state, None
+
+    # whether per-client masks change between rounds (DisPFL fire/regrow,
+    # SubAvg pruning) — if False the per-round cost record is constant and
+    # the runner reuses it instead of pulling params to host every round
+    masks_evolve: bool = False
+
+    def cost_trained_clients_per_round(self) -> int:
+        """Client training passes one round actually runs (cost accounting).
+        Default: the sampled subset. Decentralized/personalized algorithms
+        that train the whole cohort (DisPFL/DPSGD/FedFomo) or several legs
+        per client (Ditto) override this."""
+        return self.clients_per_round
+
+    def cost_snapshot(self, state: Any):
+        """(params, mask) of one representative client for the per-round
+        FLOPs/comm accounting (``stat_info``'s ``sum_training_flops`` /
+        ``sum_comm_params``, ``sailentgrads_api.py:137-138``). For stacked
+        personalized states, client 0's slice stands in for the cohort
+        (per-client densities differ only by mask evolution noise)."""
+        params = getattr(state, "global_params", None)
+        mask = getattr(state, "mask", None)
+        if mask is None:
+            masks = getattr(state, "masks", None)
+            if masks is not None:
+                mask = jax.tree_util.tree_map(lambda m: m[0], masks)
+        if params is None:
+            stacked = getattr(state, "personal_params", None)
+            if stacked is not None:
+                params = jax.tree_util.tree_map(lambda p: p[0], stacked)
+        return params, mask
+
     # -- shared helpers -------------------------------------------------------
     def _vmap_clients(self, fn, in_axes):
         """vmap ``fn`` over the leading client axis, optionally chunked.
@@ -170,7 +207,8 @@ class FedAlgorithm(abc.ABC):
         SalientGrads): gather the selected clients' shards, broadcast the
         global model (and mask) along the client axis, run vmapped local
         SGD, optionally apply a robust-aggregation defense to the local
-        models, and return the sample-weighted average + mean loss
+        models, and return the sample-weighted average, the (pre-defense)
+        local models, and the mean loss
         (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227)."""
         from ..core.state import (
             broadcast_tree,
@@ -190,12 +228,16 @@ class FedAlgorithm(abc.ABC):
             client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
         )(params0, mom0, mask_b, keys[:s], x_sel, y_sel, n_sel, round_idx,
           params0)
+        # the defense guards the *aggregate*; each client's own (personal)
+        # model stays its locally-trained weights, as in the reference where
+        # w_per_mdls is set before any server-side processing
+        defended = params_out
         if defense is not None:
-            params_out = defense.apply(params_out, global_params, keys[s])
+            defended = defense.apply(params_out, global_params, keys[s])
         weights = n_sel.astype(jnp.float32)
         weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
-        new_global = weighted_tree_sum(params_out, weights)
-        return new_global, jnp.mean(losses)
+        new_global = weighted_tree_sum(defended, weights)
+        return new_global, params_out, jnp.mean(losses)
 
     def _train_stacked(self, client_update, params_stack, mask_stack,
                        round_idx, round_key, x, y, n, prox_target=None):
@@ -259,8 +301,13 @@ class FedAlgorithm(abc.ABC):
         eval_every: int = 1,
         state: Any = None,
         callback=None,
+        finalize: bool = True,
     ):
-        """The federated training driver (the reference's ``API.train()``)."""
+        """The federated training driver (the reference's ``API.train()``).
+
+        ``finalize=False`` skips the algorithm's end-of-training pass (e.g.
+        FedAvg's final fine-tune) for callers that only need the round loop.
+        """
         if state is None:
             state = self.init_state(jax.random.PRNGKey(self.seed))
         history: List[Dict[str, Any]] = []
@@ -277,6 +324,13 @@ class FedAlgorithm(abc.ABC):
             logger.info("%s round %d: %s", self.name, r, record)
             if callback is not None:
                 callback(r, state, record)
+        final_record = None
+        if finalize:
+            state, final_record = self.finalize(state)
+        if final_record is not None:
+            record = {k: _to_float(v) for k, v in final_record.items()}
+            history.append(record)
+            logger.info("%s final: %s", self.name, record)
         return state, history
 
 

@@ -58,6 +58,11 @@ class DisPFLState:
 class DisPFL(FedAlgorithm):
     name = "dispfl"
 
+    def cost_trained_clients_per_round(self) -> int:
+        # inactive clients skip only the aggregation; all train
+        # (dispfl_api.py:96,105-142)
+        return self.num_clients
+
     def __init__(self, *args, dense_ratio: float = 0.5,
                  anneal_factor: float = 0.5, neighbor_mode: str = "random",
                  active: float = 1.0, static_masks: bool = False,
@@ -77,6 +82,7 @@ class DisPFL(FedAlgorithm):
         self.neighbor_mode = neighbor_mode
         self.active = active
         self.static_masks = static_masks
+        self.masks_evolve = not static_masks  # fire/regrow changes density
         self.total_rounds = total_rounds
         self.erk_power_scale = erk_power_scale
         if sparsity_distribution not in ("erk", "uniform"):

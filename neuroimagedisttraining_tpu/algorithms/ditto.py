@@ -38,6 +38,10 @@ class DittoState:
 class Ditto(FedAlgorithm):
     name = "ditto"
 
+    def cost_trained_clients_per_round(self) -> int:
+        # each selected client trains a global AND a personal leg
+        return 2 * self.clients_per_round
+
     def __init__(self, *args, lamda: float = 0.5,
                  personal_hp: Optional[HyperParams] = None, **kwargs):
         self.lamda = lamda
@@ -59,7 +63,7 @@ class Ditto(FedAlgorithm):
                      x_train, y_train, n_train):
             rng, k_global, k_personal = jax.random.split(state.rng, 3)
             # (a) global leg: standard FedAvg round
-            new_global, mean_loss = self._train_selected_weighted(
+            new_global, _, mean_loss = self._train_selected_weighted(
                 self.client_update, state.global_params, state.global_params,
                 sel_idx, round_idx, k_global, x_train, y_train, n_train,
             )

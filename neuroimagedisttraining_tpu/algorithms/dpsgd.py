@@ -36,6 +36,10 @@ class DPSGDState:
 class DPSGD(FedAlgorithm):
     name = "dpsgd"
 
+    def cost_trained_clients_per_round(self) -> int:
+        # gossip rounds train the whole cohort (dpsgd_api.py:41-103)
+        return self.num_clients
+
     def __init__(self, *args, neighbor_mode: str = "random", **kwargs):
         self.neighbor_mode = neighbor_mode
         super().__init__(*args, **kwargs)

@@ -6,6 +6,14 @@ reference runs clients sequentially and averages CPU state_dicts
 (``fedavg_api.py:102-117``); here the entire round — broadcast, vmapped local
 training, weighted aggregation — is a single jitted program, and with the
 client axis sharded over a mesh the weighted sum lowers to an ICI all-reduce.
+
+Like the reference, each client's last locally-trained weights are kept as
+its *personal* model (``w_per_mdls``, ``fedavg_api.py:42-45,66-67``) and both
+global and personal models are evaluated per round
+(``_test_on_all_clients(w_global, w_per_mdls, round_idx)``, ``:119-173``).
+After the last round every client fine-tunes once from the final global
+model with ``round_idx = -1`` and the pair is evaluated one final time
+(``fedavg_api.py:79-88``).
 """
 from __future__ import annotations
 
@@ -15,6 +23,11 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from ..core.state import (
+    broadcast_tree,
+    tree_scatter_update,
+    zeros_like_tree,
+)
 from ..core.trainer import make_client_update
 from ..models import init_params
 from .base import FedAlgorithm, sample_client_indexes
@@ -23,6 +36,7 @@ from .base import FedAlgorithm, sample_client_indexes
 @struct.dataclass
 class FedAvgState:
     global_params: Any
+    personal_params: Any  # [C, ...] — w_per_mdls (fedavg_api.py:42-45)
     rng: jax.Array
 
 
@@ -43,21 +57,49 @@ class FedAvg(FedAlgorithm):
         def round_fn(state: FedAvgState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, mean_loss = self._train_selected_weighted(
+            new_global, locals_, mean_loss = self._train_selected_weighted(
                 self.client_update, state.global_params,
                 state.global_params,  # dense path: mask unused, DCE'd
                 sel_idx, round_idx, round_key, x_train, y_train, n_train,
                 defense=self.defense,
             )
-            return FedAvgState(global_params=new_global, rng=rng), mean_loss
+            new_personal = tree_scatter_update(
+                state.personal_params, sel_idx, locals_)
+            return (
+                FedAvgState(global_params=new_global,
+                            personal_params=new_personal, rng=rng),
+                mean_loss,
+            )
 
         self._round_jit = jax.jit(round_fn)
+
+        def finetune_fn(state: FedAvgState, x_train, y_train, n_train):
+            """Final fine-tune: every client trains once from the final
+            global model at round_idx=-1 (fedavg_api.py:79-88)."""
+            rng, key = jax.random.split(state.rng)
+            c = self.num_clients
+            params0 = broadcast_tree(state.global_params, c)
+            mom0 = zeros_like_tree(params0)
+            keys = jax.random.split(key, c)
+            params_out, _, _ = self._vmap_clients(
+                self.client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
+            )(params0, mom0, params0, keys, x_train, y_train, n_train,
+              jnp.asarray(-1.0, jnp.float32), params0)
+            return FedAvgState(global_params=state.global_params,
+                               personal_params=params_out, rng=rng)
+
+        self._finetune_jit = jax.jit(finetune_fn)
         self._eval_global = self._make_global_eval()
+        self._eval_personal = self._make_personal_eval()
 
     def init_state(self, rng: jax.Array) -> FedAvgState:
         p_rng, s_rng = jax.random.split(rng)
         params = init_params(self.model, p_rng, self.init_sample_shape)
-        return FedAvgState(global_params=params, rng=s_rng)
+        return FedAvgState(
+            global_params=params,
+            personal_params=broadcast_tree(params, self.num_clients),
+            rng=s_rng,
+        )
 
     def run_round(self, state: FedAvgState, round_idx: int):
         sel = sample_client_indexes(
@@ -69,10 +111,24 @@ class FedAvg(FedAlgorithm):
         )
         return state, {"train_loss": loss}
 
+    def finalize(self, state: FedAvgState):
+        state = self._finetune_jit(
+            state, self.data.x_train, self.data.y_train, self.data.n_train)
+        ev = self.evaluate(state)
+        record = {"round": -1, "finetune": True,
+                  **{k: v for k, v in ev.items()
+                     if not k.startswith("acc_per")}}
+        return state, record
+
     def evaluate(self, state: FedAvgState) -> Dict[str, Any]:
         ev = self._eval_global(
             state.global_params, self.data.x_test, self.data.y_test,
             self.data.n_test,
         )
+        evp = self._eval_personal(
+            state.personal_params, self.data.x_test, self.data.y_test,
+            self.data.n_test,
+        )
         return {"global_acc": ev["acc"], "global_loss": ev["loss"],
+                "personal_acc": evp["acc"], "personal_loss": evp["loss"],
                 "acc_per_client": ev["acc_per_client"]}

@@ -43,6 +43,10 @@ class FedFomoState:
 class FedFomo(FedAlgorithm):
     name = "fedfomo"
 
+    def cost_trained_clients_per_round(self) -> int:
+        # every client trains its own model each round (fedfomo_api.py:53-118)
+        return self.num_clients
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         if self.data.x_val is None:

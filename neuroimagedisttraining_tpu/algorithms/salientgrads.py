@@ -41,9 +41,11 @@ class SalientGrads(FedAlgorithm):
     name = "salientgrads"
 
     def __init__(self, *args, dense_ratio: float = 0.5,
-                 itersnip_iterations: int = 1, **kwargs):
+                 itersnip_iterations: int = 1, defense=None, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
+        # optional robust.RobustAggregator (fedml_core/robustness wiring)
+        self.defense = defense
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -77,10 +79,16 @@ class SalientGrads(FedAlgorithm):
         def round_fn(state: SalientGradsState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, mean_loss = self._train_selected_weighted(
+            new_global, _, mean_loss = self._train_selected_weighted(
                 self.client_update, state.global_params, state.mask,
                 sel_idx, round_idx, round_key, x_train, y_train, n_train,
+                defense=self.defense,
             )
+            if self.defense is not None:
+                # weak-DP noise lands on every leaf; re-mask so the global
+                # model keeps the SNIP sparsity invariant
+                new_global = jax.tree_util.tree_map(
+                    lambda p, m: p * m, new_global, state.mask)
             return (
                 SalientGradsState(global_params=new_global, mask=state.mask,
                                   rng=rng),

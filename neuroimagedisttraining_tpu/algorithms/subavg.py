@@ -48,6 +48,7 @@ class SubAvgState:
 
 class SubAvg(FedAlgorithm):
     name = "subavg"
+    masks_evolve = True  # pruning changes per-client density
 
     def __init__(self, *args, each_prune_ratio: float = 0.2,
                  dist_thresh: float = 0.001, acc_thresh: float = 0.5,

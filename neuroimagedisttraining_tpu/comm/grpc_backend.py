@@ -46,23 +46,69 @@ _stub_lock = threading.Lock()
 _pb2 = None
 
 
+def _user_cache_gen_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "neuroimagedisttraining_tpu", "_generated")
+
+
+def _generate_into(gen_dir: str, src: str) -> None:
+    os.makedirs(gen_dir, exist_ok=True)
+    open(os.path.join(gen_dir, "__init__.py"), "a").close()
+    try:
+        subprocess.run(
+            ["protoc", f"--python_out={gen_dir}", f"-I{_PROTO_DIR}",
+             "comm_manager.proto"],
+            check=True, capture_output=True)
+    except FileNotFoundError as e:
+        raise RuntimeError(
+            "the gRPC comm backend needs its protobuf stub generated, but "
+            "`protoc` is not on PATH. Install protoc (protobuf compiler) "
+            f"or pre-generate {src} -> comm_manager_pb2.py with "
+            "native/comm/generate_grpc.sh on a machine that has it."
+        ) from e
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"protoc failed generating the gRPC stub from {src}: "
+            f"{e.stderr.decode(errors='replace').strip()}") from e
+
+
 def _load_pb2():
-    """protoc-compile the IDL into ``comm/_generated`` and import the stub."""
+    """Import the protobuf stub, protoc-generating it if needed.
+
+    Resolution order: (1) an up-to-date pre-generated stub in the package's
+    ``comm/_generated``; (2) regenerate there; (3) if the install dir is
+    read-only, generate into a per-user cache dir and import from it
+    (ADVICE r1: a site-packages install must not require a writable
+    package directory, and a missing protoc must say so by name).
+    """
     global _pb2
     with _stub_lock:
         if _pb2 is not None:
             return _pb2
         src = os.path.join(_PROTO_DIR, "comm_manager.proto")
         out = os.path.join(_GEN_DIR, "comm_manager_pb2.py")
-        if not os.path.exists(out) or (
-                os.path.exists(src)
-                and os.path.getmtime(out) < os.path.getmtime(src)):
-            os.makedirs(_GEN_DIR, exist_ok=True)
-            open(os.path.join(_GEN_DIR, "__init__.py"), "a").close()
-            subprocess.run(
-                ["protoc", f"--python_out={_GEN_DIR}", f"-I{_PROTO_DIR}",
-                 "comm_manager.proto"],
-                check=True, capture_output=True)
+        stale = not os.path.exists(out) or (
+            os.path.exists(src)
+            and os.path.getmtime(out) < os.path.getmtime(src))
+        if stale:
+            try:
+                _generate_into(_GEN_DIR, src)
+            except OSError:  # read-only package dir (incl. PermissionError)
+                cache_dir = _user_cache_gen_dir()
+                cache_out = os.path.join(cache_dir, "comm_manager_pb2.py")
+                if not os.path.exists(cache_out) or (
+                        os.path.exists(src) and
+                        os.path.getmtime(cache_out) < os.path.getmtime(src)):
+                    _generate_into(cache_dir, src)
+                import importlib.util
+
+                spec = importlib.util.spec_from_file_location(
+                    "comm_manager_pb2", cache_out)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _pb2 = mod
+                return _pb2
         from ._generated import comm_manager_pb2
         _pb2 = comm_manager_pb2
         return _pb2

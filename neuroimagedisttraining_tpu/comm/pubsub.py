@@ -135,6 +135,13 @@ class PubSubBroker:
         frame = _pub_frame(topic, payload)
         with self._lock:
             targets = list(self._subs.get(topic, ()))
+        if not targets:
+            # QoS-0 drop (reference MQTT semantics) — but log it, so a
+            # publish racing a subscriber's startup is diagnosable from
+            # broker logs instead of an opaque receive timeout (ADVICE r1)
+            logger.warning(
+                "dropping publish to %r: no subscriber (QoS-0); "
+                "payload %d bytes", topic, len(payload))
         for sub in targets:
             lock = self._locks.get(sub)
             if lock is None:

@@ -69,6 +69,23 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--ci", type=int, default=0,
                    help="smoke mode: tiny eval to catch programming errors "
                         "(sailentgrads_api.py:260-265 semantics)")
+    p.add_argument("--final_finetune", type=int, default=1,
+                   help="run the algorithm's end-of-training pass (FedAvg's "
+                        "final per-client fine-tune, fedavg_api.py:79-88); "
+                        "0 skips it")
+
+    # -- robust aggregation (fedml_core/robustness/robust_aggregation.py;
+    # dead code in the reference — no caller — wired end-to-end here)
+    p.add_argument("--defense_type", type=str, default="none",
+                   choices=["none", "norm_diff_clipping", "weak_dp"],
+                   help="Byzantine defense applied to client updates before "
+                        "averaging (fedavg/salientgrads)")
+    p.add_argument("--norm_bound", type=float, default=5.0,
+                   help="norm-difference clipping bound "
+                        "(robust_aggregation.py:38-50)")
+    p.add_argument("--stddev", type=float, default=0.025,
+                   help="weak-DP Gaussian noise stddev "
+                        "(robust_aggregation.py:52-55)")
 
     # -- runtime (new: TPU-native knobs, no reference equivalent)
     p.add_argument("--layout", type=str, default="channels",
@@ -84,7 +101,17 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--multihost", action="store_true",
                    help="initialize jax.distributed and span the clients "
                         "mesh over every host's devices (TPU pod / "
-                        "multi-slice); single-process runs are unaffected")
+                        "multi-slice); fails fast if no multi-process "
+                        "runtime comes up")
+    p.add_argument("--coordinator_address", type=str, default="",
+                   help="explicit jax.distributed coordinator (host:port) "
+                        "for manually launched CPU/GPU clusters; TPU pods "
+                        "auto-detect")
+    p.add_argument("--num_processes", type=int, default=0,
+                   help="world size for explicit jax.distributed init")
+    p.add_argument("--process_id", type=int, default=-1,
+                   help="this process's rank for explicit jax.distributed "
+                        "init")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="shard client axis over this many devices (0 = all)")
     p.add_argument("--checkpoint_dir", type=str, default="",
@@ -229,6 +256,15 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         if v is not None:
             parts.append(f"{extra.replace('_', '')}{v:g}"
                          if isinstance(v, float) else f"{extra[:4]}{v}")
+    # defense and fine-tune knobs change training behavior — they must
+    # split checkpoint/log/stat_info lineages (unlike inert identity tags)
+    if getattr(args, "defense_type", "none") != "none":
+        parts.append(f"def{args.defense_type}")
+        parts.append(f"nb{args.norm_bound:g}")
+        if args.defense_type == "weak_dp":
+            parts.append(f"sd{args.stddev:g}")
+    if not getattr(args, "final_finetune", 1):
+        parts.append("noft")
     if getattr(args, "global_test", False):
         parts.append("g")  # main_dispfl.py:198-199
     if args.tag:

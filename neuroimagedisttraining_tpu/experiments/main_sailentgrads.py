@@ -1,5 +1,7 @@
-"""CLI main for salientgrads (rebuild of main_salientgrads.py in the reference's
-fedml_experiments/standalone tree)."""
+"""CLI main for salientgrads (rebuild of the reference's
+``fedml_experiments/standalone/sailentgrads/main_sailentgrads.py`` — the
+reference's own spelling).
+"""
 from .runner import main
 
 if __name__ == "__main__":

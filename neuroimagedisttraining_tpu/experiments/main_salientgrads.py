@@ -1,5 +1,7 @@
-"""CLI main for salientgrads (rebuild of main_salientgrads.py in the reference's
-fedml_experiments/standalone tree)."""
+"""CLI main for salientgrads — corrected-spelling alias of
+``main_sailentgrads.py`` (the reference file name is ``main_sailentgrads.py``,
+sic).
+"""
 from .runner import main
 
 if __name__ == "__main__":

@@ -121,10 +121,26 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         compute_dtype=getattr(args, "compute_dtype", "") or None,
         channel_inject=(layout == "flat" and _is_abcd_h5(args.dataset)),
     )
+    defense = None
+    if getattr(args, "defense_type", "none") != "none":
+        from ..robust import RobustAggregator
+
+        if algo_name not in ("fedavg", "salientgrads"):
+            raise SystemExit(
+                f"--defense_type {args.defense_type} guards the global "
+                "aggregation of fedavg/salientgrads; "
+                f"{algo_name} has no central aggregate to defend")
+        defense = RobustAggregator(
+            defense_type=args.defense_type,
+            norm_bound=args.norm_bound, stddev=args.stddev)
+
     extra: Dict[str, Any] = {}
     if algo_name == "salientgrads":
         extra = dict(dense_ratio=args.dense_ratio,
-                     itersnip_iterations=args.itersnip_iteration)
+                     itersnip_iterations=args.itersnip_iteration,
+                     defense=defense)
+    elif algo_name == "fedavg":
+        extra = dict(defense=defense)
     elif algo_name == "dispfl":
         extra = dict(dense_ratio=args.dense_ratio,
                      anneal_factor=args.anneal_factor,
@@ -218,7 +234,8 @@ def maybe_shard(algo, args: argparse.Namespace):
 
 
 def save_stat_info(args: argparse.Namespace, identity: str,
-                   history, final_eval, extras=None) -> Optional[str]:
+                   history, final_eval, extras=None,
+                   cost=None) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -235,6 +252,9 @@ def save_stat_info(args: argparse.Namespace, identity: str,
                             if "global_acc" in h],
         "person_test_acc": [h.get("personal_acc") for h in history
                             if "personal_acc" in h],
+        # stat_info cost counters (sailentgrads_api.py:334-346)
+        "sum_training_flops": getattr(cost, "sum_training_flops", 0.0),
+        "sum_comm_params": getattr(cost, "sum_comm_params", 0),
     }
     json_safe_keys = list(stat_info)  # extras are pickle-only: the JSON
     # sidecar would stringify (and numpy would elide) large mask arrays
@@ -265,8 +285,21 @@ def run_experiment(args: argparse.Namespace,
         if getattr(args, "multihost", False):
             from ..parallel import initialize_distributed
 
-            if initialize_distributed():
+            coord = getattr(args, "coordinator_address", "") or None
+            nproc = getattr(args, "num_processes", 0) or None
+            pid = getattr(args, "process_id", -1)
+            if initialize_distributed(
+                    coordinator_address=coord, num_processes=nproc,
+                    process_id=pid if pid >= 0 else None):
                 mh_mesh, gdata = build_multihost_data(args)
+            else:
+                # --multihost was explicit; training alone while believing
+                # we're a pod is the worst failure mode (ADVICE r1)
+                raise SystemExit(
+                    "--multihost: no multi-process runtime came up "
+                    "(jax.process_count() == 1). On TPU pods launch via the "
+                    "pod runtime; elsewhere pass --coordinator_address/"
+                    "--num_processes/--process_id explicitly.")
 
         if mh_mesh is not None:
             algo, data = build_algorithm(args, algo_name, data=gdata)
@@ -300,12 +333,35 @@ def run_experiment(args: argparse.Namespace,
 
             trace_one_round(algo, state, args.profile_dir)
 
+        # per-round cost accounting (stat_info's sum_training_flops /
+        # sum_comm_params, sailentgrads_api.py:137-138,334-346)
+        from ..utils.flops import CostTracker
+
+        cost = CostTracker(model=algo.model,
+                           sample_shape=algo.init_sample_shape)
+        samples_per_client = algo.hp.local_steps * algo.hp.batch_size
+
         history = []
         final_eval = None
         for r in range(start_round, max(start_round, args.comm_round)):
             state, rec = algo.run_round(state, r)
             record = {"round": r,
                       **{k: _scalar(v) for k, v in rec.items()}}
+            if cost.per_round and not algo.masks_evolve:
+                # static masks: per-round cost is constant; skip the
+                # device→host param pull
+                crec = cost.record_repeat()
+            else:
+                cost_params, cost_mask = algo.cost_snapshot(state)
+                crec = None
+                if cost_params is not None:
+                    crec = cost.record_round(
+                        cost_params, cost_mask,
+                        n_clients=algo.cost_trained_clients_per_round(),
+                        samples_per_client=samples_per_client)
+            if crec is not None:
+                record["sum_training_flops"] = crec["sum_training_flops"]
+                record["sum_comm_params"] = crec["sum_comm_params"]
             final_eval = None  # state changed; any cached eval is stale
             if args.frequency_of_the_test and \
                     (r + 1) % args.frequency_of_the_test == 0:
@@ -318,6 +374,25 @@ def run_experiment(args: argparse.Namespace,
             if ckpt_mgr is not None:
                 ckpt_mgr.save(r + 1, state)
 
+        fin_rec = None
+        if getattr(args, "final_finetune", 1):
+            state, fin_rec = algo.finalize(state)
+        if fin_rec is not None:
+            # the reference's final fine-tune record (round -1)
+            record = {k: v if k in ("round", "finetune") else _scalar(v)
+                      for k, v in fin_rec.items()}
+            history.append(record)
+            logger.info("%s final: %s", algo_name, record)
+            # the fine-tune pass trains every client once — count it
+            cost_params, cost_mask = algo.cost_snapshot(state)
+            if cost_params is not None:
+                cost.record_round(cost_params, cost_mask,
+                                  n_clients=algo.num_clients,
+                                  samples_per_client=samples_per_client)
+            # finalize() already evaluated the post-fine-tune state; reuse
+            # its metrics instead of re-running the full-cohort evals
+            final_eval = {k: v for k, v in fin_rec.items()
+                          if k not in ("round", "finetune")}
         if final_eval is None:  # last round wasn't an eval round
             final_eval = algo.evaluate(state)
         extras = {}
@@ -331,7 +406,7 @@ def run_experiment(args: argparse.Namespace,
             extras["mask_distance_matrix"] = np.asarray(
                 algo.mask_distance_matrix(state))
         stat_path = save_stat_info(args, identity, history, final_eval,
-                                   extras)
+                                   extras, cost=cost)
         return {
             "identity": identity,
             "history": history,

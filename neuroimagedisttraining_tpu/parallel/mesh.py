@@ -6,7 +6,7 @@ state_dicts, ``mpi/com_manager.py:13-98``): instead of explicit peer sends,
 per-client values carry a leading client axis laid out over a ``clients`` mesh
 axis, and aggregation/gossip lower to XLA collectives over ICI. Multi-host
 (DCN) uses the same mesh spanning all processes after
-``jax.distributed.initialize`` (``parallel/multihost.py``, planned).
+``jax.distributed.initialize`` — see ``parallel/multihost.py``.
 
 Mesh axes:
   * ``clients`` — the federated axis: one (or more) simulated site/hospital

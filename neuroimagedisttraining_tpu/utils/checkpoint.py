@@ -62,8 +62,18 @@ class CheckpointManager:
             if hasattr(x, "shape") else x,
             template,
         )
-        state = self.mgr.restore(
-            step, args=self._ocp.args.StandardRestore(abstract))
+        try:
+            state = self.mgr.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+        except (KeyError, ValueError, TypeError) as e:
+            # most common cause: the state schema changed between framework
+            # versions (e.g. a new field on an algorithm's State dataclass)
+            raise RuntimeError(
+                f"checkpoint at {self.directory} step {step} does not match "
+                "the current state structure — it was likely written by an "
+                "older framework version. Restart without --resume (or point "
+                "--checkpoint_dir elsewhere) to begin a fresh lineage."
+            ) from e
         logger.info("restored checkpoint step %d from %s", step,
                     self.directory)
         return state, step

@@ -169,18 +169,42 @@ class CostTracker:
         self.sum_training_flops = 0.0
         self.sum_comm_params = 0
         self.per_round: list = []
+        self._dense_flops = None  # per-layer cache: shapes are static
+
+    def _dense_per_layer(self, params) -> Dict[Tuple[str, ...], float]:
+        if self._dense_flops is None:
+            self._dense_flops = per_layer_flops(
+                self.model, params, self.sample_shape)
+        return self._dense_flops
 
     def record_round(self, params, mask=None, n_clients: int = 1,
                      samples_per_client: int = 1) -> Dict[str, float]:
         flops = 0.0
         if self.model is not None and self.sample_shape is not None:
-            flops = n_clients * training_flops(
-                self.model, params, self.sample_shape, mask,
-                n_samples=samples_per_client)
+            dense = self._dense_per_layer(params)
+            fracs = nonzero_fraction(params, mask)
+            per_sample = sum(
+                f * fracs.get(p, 1.0) for p, f in dense.items())
+            flops = (n_clients * TRAIN_TO_INFER_RATIO * samples_per_client
+                     * float(per_sample))
         comm = n_clients * count_communication_params(params, mask)
         self.sum_training_flops += flops
         self.sum_comm_params += comm
         rec = {"training_flops": flops, "comm_params": comm,
+               "sum_training_flops": self.sum_training_flops,
+               "sum_comm_params": self.sum_comm_params}
+        self.per_round.append(rec)
+        return rec
+
+    def record_repeat(self) -> Dict[str, float]:
+        """Accumulate another round identical to the last recorded one —
+        avoids the device→host param pull when masks are static (dense
+        FedAvg, fixed SNIP masks)."""
+        last = self.per_round[-1]
+        self.sum_training_flops += last["training_flops"]
+        self.sum_comm_params += last["comm_params"]
+        rec = {"training_flops": last["training_flops"],
+               "comm_params": last["comm_params"],
                "sum_training_flops": self.sum_training_flops,
                "sum_comm_params": self.sum_comm_params}
         self.per_round.append(rec)

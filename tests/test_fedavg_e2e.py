@@ -41,14 +41,14 @@ def test_fedavg_learns_bce():
     algo = _make_algo("bce")
     state = algo.init_state(jax.random.PRNGKey(0))
     ev0 = algo.evaluate(state)
-    state, hist = algo.run(comm_rounds=10, eval_every=0, state=state)
+    state, hist = algo.run(comm_rounds=10, eval_every=0, state=state, finalize=False)
     ev = algo.evaluate(state)
     assert ev["global_acc"] > 0.8, (float(ev0["global_acc"]), float(ev["global_acc"]))
 
 
 def test_fedavg_learns_ce():
     algo = _make_algo("ce")
-    state, _ = algo.run(comm_rounds=20, eval_every=0)
+    state, _ = algo.run(comm_rounds=20, eval_every=0, finalize=False)
     ev = algo.evaluate(state)
     assert ev["global_acc"] > 0.8
 
@@ -56,7 +56,7 @@ def test_fedavg_learns_ce():
 def test_fedavg_partial_participation():
     algo = _make_algo("bce", frac=0.5)
     assert algo.clients_per_round == 4
-    state, hist = algo.run(comm_rounds=4, eval_every=2)
+    state, hist = algo.run(comm_rounds=4, eval_every=2, finalize=False)
     assert len(hist) == 4
     assert "global_acc" in hist[1]
 
@@ -70,7 +70,7 @@ def test_fedavg_on_sharded_mesh(eight_devices):
         if hasattr(x, "shape") and x.ndim and x.shape[0] == 8 else x,
         algo.data,
     )
-    state, _ = algo.run(comm_rounds=3, eval_every=0)
+    state, _ = algo.run(comm_rounds=3, eval_every=0, finalize=False)
     ev = algo.evaluate(state)
     assert np.isfinite(float(ev["global_loss"]))
 
@@ -78,8 +78,8 @@ def test_fedavg_on_sharded_mesh(eight_devices):
 def test_fedavg_deterministic():
     a1 = _make_algo("bce")
     a2 = _make_algo("bce")
-    s1, _ = a1.run(comm_rounds=2, eval_every=0)
-    s2, _ = a2.run(comm_rounds=2, eval_every=0)
+    s1, _ = a1.run(comm_rounds=2, eval_every=0, finalize=False)
+    s2, _ = a2.run(comm_rounds=2, eval_every=0, finalize=False)
     l1 = jax.tree_util.tree_leaves(s1.global_params)
     l2 = jax.tree_util.tree_leaves(s2.global_params)
     for x, y in zip(l1, l2):
@@ -99,7 +99,7 @@ def test_fedavg_learns_bf16_compute():
                      batch_size=8)
     algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
                   compute_dtype="bfloat16")
-    state, _ = algo.run(comm_rounds=10, eval_every=0)
+    state, _ = algo.run(comm_rounds=10, eval_every=0, finalize=False)
     assert all(
         leaf.dtype == jnp.float32
         for leaf in jax.tree_util.tree_leaves(state.global_params)
@@ -125,8 +125,8 @@ def test_fedavg_channel_inject_path():
     a = FedAvg(model, with_ch, hp, loss_type="bce", frac=1.0, seed=0)
     b = FedAvg(model, no_ch, hp, loss_type="bce", frac=1.0, seed=0,
                channel_inject=True)
-    sa, _ = a.run(comm_rounds=3, eval_every=0)
-    sb, _ = b.run(comm_rounds=3, eval_every=0)
+    sa, _ = a.run(comm_rounds=3, eval_every=0, finalize=False)
+    sb, _ = b.run(comm_rounds=3, eval_every=0, finalize=False)
     for la, lb in zip(jax.tree_util.tree_leaves(sa.global_params),
                       jax.tree_util.tree_leaves(sb.global_params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
@@ -147,6 +147,48 @@ def test_fedavg_learns_2d_cifar_path():
                      grad_clip=10.0, local_epochs=1, steps_per_epoch=3,
                      batch_size=8)
     algo = FedAvg(model, data, hp, loss_type="ce", frac=1.0, seed=0)
-    state, _ = algo.run(comm_rounds=10, eval_every=0)
+    state, _ = algo.run(comm_rounds=10, eval_every=0, finalize=False)
     ev = algo.evaluate(state)
     assert ev["global_acc"] > 0.5, float(ev["global_acc"])  # chance = 0.25
+
+
+def test_fedavg_final_finetune_and_personal_eval():
+    """The reference's end-of-training pass (fedavg_api.py:79-88): every
+    client fine-tunes once from the final global model (round_idx=-1) into
+    its personal model, and the final record evaluates both."""
+    algo = _make_algo("bce", n_clients=4)
+    state, hist = algo.run(comm_rounds=3, eval_every=0)
+    final = hist[-1]
+    assert final["round"] == -1 and final.get("finetune")
+    assert "personal_acc" in final and "global_acc" in final
+    assert np.isfinite(final["personal_loss"])
+    # per-round evals also carry personal metrics (w_per_mdls tracking,
+    # fedavg_api.py:42-45,66-67 + _test_on_all_clients :119-173)
+    ev = algo.evaluate(state)
+    assert "personal_acc" in ev
+    # the fine-tune actually moved the personal models off the global model
+    g = jax.tree_util.tree_leaves(state.global_params)
+    p = jax.tree_util.tree_leaves(state.personal_params)
+    diffs = [np.abs(np.asarray(pp) - np.asarray(gg)[None]).max()
+             for gg, pp in zip(g, p)]
+    assert max(diffs) > 0
+
+
+def test_fedavg_personal_tracking_updates_selected_only():
+    """w_per_mdls semantics: a round updates only the sampled clients'
+    personal models; the rest keep their previous weights."""
+    algo = _make_algo("bce", frac=0.5)  # 4 of 8 clients per round
+    state = algo.init_state(jax.random.PRNGKey(0))
+    sel = sample_client_indexes(0, algo.num_clients, algo.clients_per_round)
+    state2, _ = algo.run_round(state, 0)
+    unsel = np.setdiff1d(np.arange(algo.num_clients), sel)
+    for l0, l1 in zip(jax.tree_util.tree_leaves(state.personal_params),
+                      jax.tree_util.tree_leaves(state2.personal_params)):
+        # unselected rows unchanged
+        np.testing.assert_array_equal(np.asarray(l0)[unsel],
+                                      np.asarray(l1)[unsel])
+    changed = any(
+        not np.array_equal(np.asarray(l0)[sel], np.asarray(l1)[sel])
+        for l0, l1 in zip(jax.tree_util.tree_leaves(state.personal_params),
+                          jax.tree_util.tree_leaves(state2.personal_params)))
+    assert changed

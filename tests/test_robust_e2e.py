@@ -1,0 +1,158 @@
+"""Byzantine-robust training end-to-end (VERDICT r1 item 2).
+
+The reference ships `RobustAggregator` (fedml_core/robustness/
+robust_aggregation.py:32-55) as dead code — no algorithm calls it. Here the
+defense is a product feature: `--defense_type/--norm_bound/--stddev` plumb a
+RobustAggregator into FedAvg/SalientGrads aggregation, inside the jitted
+round. These tests exercise the whole path: a malicious client injects a
+scaled update; clipping bounds the damage; the undefended run degrades.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import FedAvg, SalientGrads
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.experiments import parse_args, run_experiment
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.ops.sparsity import mask_density
+from neuroimagedisttraining_tpu.robust import RobustAggregator
+
+
+def _poisoned_data(scale=1e4):
+    """Client 0 is Byzantine; its shard is tagged with huge input values so
+    the in-graph attack (see _inject_scaled_update) can identify itself
+    under vmap. GroupNorm makes the scale itself training-neutral."""
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=24, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2,
+    )
+    x = np.array(data.x_train)  # writable copy
+    x[0] = x[0] * scale
+    return data.replace(x_train=jnp.asarray(x))
+
+
+def _inject_scaled_update(algo, boost=1000.0):
+    """Model-replacement attack: the client whose shard carries the poison
+    tag scales its local model delta by `boost` before it leaves the
+    client — the classic scaled-update Byzantine attack, injected inside
+    the jitted round."""
+    orig = algo.client_update
+
+    def malicious(params, mom, mask, rng, x, y, n, round_idx, prox):
+        p, m, loss = orig(params, mom, mask, rng, x, y, n, round_idx, prox)
+        factor = jnp.where(jnp.mean(jnp.abs(x)) > 100.0, boost, 1.0)
+        p = jax.tree_util.tree_map(
+            lambda p0, pt: p0 + (pt - p0) * factor.astype(p0.dtype),
+            params, p)
+        return p, m, loss
+
+    algo.client_update = malicious
+
+
+def _hp():
+    return HyperParams(lr=0.5, lr_decay=1.0, momentum=0.9, weight_decay=0.0,
+                       grad_clip=1e9, local_epochs=1, steps_per_epoch=4,
+                       batch_size=8)
+
+
+def _global_drift(s0, s1):
+    return float(jnp.sqrt(sum(
+        jnp.sum((a - b) ** 2) for a, b in zip(
+            jax.tree_util.tree_leaves(s0.global_params),
+            jax.tree_util.tree_leaves(s1.global_params)))))
+
+
+def test_norm_clipping_bounds_byzantine_damage():
+    data = _poisoned_data()
+    model = create_model("small3dcnn", num_classes=1)
+    bound = 1.0
+
+    defended = FedAvg(model, data, _hp(), loss_type="bce", frac=1.0, seed=0,
+                      defense=RobustAggregator("norm_diff_clipping",
+                                               norm_bound=bound))
+    undefended = FedAvg(model, data, _hp(), loss_type="bce", frac=1.0,
+                        seed=0)
+    _inject_scaled_update(defended)
+    _inject_scaled_update(undefended)
+
+    s0 = defended.init_state(jax.random.PRNGKey(0))
+    s1, _ = defended.run_round(s0, 0)
+    # every client's diff is clipped to `bound`; the weighted mean of
+    # clipped diffs cannot drift farther than `bound`
+    assert _global_drift(s0, s1) <= bound + 1e-4
+
+    u0 = undefended.init_state(jax.random.PRNGKey(0))
+    u1, _ = undefended.run_round(u0, 0)
+    # the Byzantine update dominates (or destroys) the undefended aggregate
+    drift_u = _global_drift(u0, u1)
+    assert not np.isfinite(drift_u) or drift_u > 10 * bound
+
+
+def test_weak_dp_adds_noise_and_trains():
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=24, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2,
+    )
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9, weight_decay=0.0,
+                     grad_clip=10.0, local_epochs=1, steps_per_epoch=4,
+                     batch_size=8)
+    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                  defense=RobustAggregator("weak_dp", norm_bound=5.0,
+                                           stddev=1e-3))
+    state, _ = algo.run(comm_rounds=6, eval_every=0, finalize=False)
+    ev = algo.evaluate(state)
+    assert np.isfinite(float(ev["global_loss"]))
+    assert float(ev["global_acc"]) > 0.6  # still learns through the noise
+
+
+def test_salientgrads_defense_keeps_mask_invariant():
+    """Weak-DP noise lands on every leaf; the defended SalientGrads round
+    must re-mask so the global model keeps its SNIP sparsity."""
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2,
+    )
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.0, weight_decay=0.0,
+                     grad_clip=10.0, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                        dense_ratio=0.3,
+                        defense=RobustAggregator("weak_dp", stddev=1e-3))
+    state = algo.init_state(jax.random.PRNGKey(0))
+    state, _ = algo.run_round(state, 0)
+    # global params outside the mask stay exactly zero despite the noise
+    for p, m in zip(jax.tree_util.tree_leaves(state.global_params),
+                    jax.tree_util.tree_leaves(state.mask)):
+        assert np.all(np.asarray(p)[np.asarray(m) == 0] == 0)
+    assert float(mask_density(state.mask)) < 0.5
+
+
+def test_defense_cli_wiring(tmp_path):
+    """--defense_type reaches the algorithm from the flag surface."""
+    argv = ["--model", "small3dcnn", "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--batch_size", "8",
+            "--epochs", "1", "--comm_round", "2", "--lr", "0.05",
+            "--defense_type", "weak_dp", "--norm_bound", "5.0",
+            "--stddev", "0.001",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="fedavg")
+    out = run_experiment(args, "fedavg")
+    assert all(np.isfinite(h["train_loss"]) for h in out["history"]
+               if "train_loss" in h)
+
+
+def test_defense_rejected_for_decentralized(tmp_path):
+    argv = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "4", "--comm_round", "1",
+            "--defense_type", "weak_dp",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="dispfl")
+    with pytest.raises(SystemExit):
+        run_experiment(args, "dispfl")

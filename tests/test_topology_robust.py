@@ -112,7 +112,7 @@ def test_weak_dp_defense_runs():
     algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
                   defense=RobustAggregator("weak_dp", norm_bound=5.0,
                                            stddev=0.001))
-    state, hist = algo.run(comm_rounds=2, eval_every=0)
+    state, hist = algo.run(comm_rounds=2, eval_every=0, finalize=False)
     assert np.isfinite(hist[-1]["train_loss"])
     with pytest.raises(ValueError):
         RobustAggregator("bad_defense")
