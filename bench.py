@@ -41,13 +41,14 @@ def _device_synth_data(n_clients, n, shape, key):
     from neuroimagedisttraining_tpu.ops.s2d import phased_sample_shape
 
     kx, ky = jax.random.split(key)
-    # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py);
+    # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py),
+    # stored bf16 (the compute dtype — skips the per-step convert/relayout);
     # random phased tensors are distributionally the same workload
     x = jax.random.normal(
-        kx, (n_clients, n) + phased_sample_shape(shape), jnp.float32)
+        kx, (n_clients, n) + phased_sample_shape(shape), jnp.bfloat16)
     y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
     # plant a mean-shift signal so losses stay in a realistic regime
-    x = x + 0.75 * (y[..., None, None, None, None].astype(jnp.float32) * 2 - 1)
+    x = x + 0.75 * (y[..., None, None, None, None].astype(x.dtype) * 2 - 1)
     counts = jnp.full((n_clients,), n, jnp.int32)
     m = max(4, n // 4)
     return FederatedData(
@@ -84,9 +85,31 @@ def main():
     # single chip (1.40 r/s vs 1.25 at chunk=4; chunk=8 OOMs). On a pod
     # (device per client) the full vmap shards clients across chips.
     chunk = None if n_dev >= N_CLIENTS else 1
+    mesh = None
+    if n_dev > 1:
+        # multi-chip: shard the client axis over the devices so the SAME
+        # script measures the real distributed round (vmapped local train
+        # per chip + cross-chip weighted-sum aggregation over ICI)
+        from neuroimagedisttraining_tpu.parallel import (
+            make_mesh,
+            shard_over_clients,
+        )
+
+        rows = min(n_dev, N_CLIENTS)
+        while N_CLIENTS % rows:
+            rows -= 1
+        if rows > 1:
+            mesh = make_mesh(rows)
+            data = shard_over_clients(data, mesh)
+            chunk = None if rows == N_CLIENTS else 1
+    import os
+    if os.environ.get("BENCH_CHUNK"):  # perf-tuning override
+        chunk = int(os.environ["BENCH_CHUNK"]) or None
+    remat = bool(int(os.environ.get("BENCH_REMAT", "0")))
     algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
                         client_chunk=chunk, dense_ratio=0.5,
-                        itersnip_iterations=1, compute_dtype="bfloat16")
+                        itersnip_iterations=1, compute_dtype="bfloat16",
+                        remat_local=remat)
     state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
 
     def _sync(s):
@@ -122,6 +145,8 @@ def main():
                 client_rounds_per_sec_per_chip, 2),
             "baseline_basis": "10 client-rounds/sec/chip (v4-32 north star)",
             "n_devices": n_chips,
+            "client_mesh_devices": (
+                int(mesh.shape["clients"]) if mesh is not None else 1),
             "volume": list(VOLUME),
             "clients": N_CLIENTS,
             "local_steps": STEPS,

@@ -57,6 +57,7 @@ class FedAlgorithm(abc.ABC):
         client_chunk: Optional[int] = None,
         compute_dtype: Optional[str] = None,
         channel_inject: bool = False,
+        remat_local: bool = False,
     ):
         self.model = model
         self.data = data
@@ -74,6 +75,9 @@ class FedAlgorithm(abc.ABC):
         # channel_inject: volumes stored channel-less, channel appended at
         # apply time (see make_apply_fn docstring for the HBM-tiling why)
         self.channel_inject = channel_inject
+        # remat_local: rematerialized local steps (core/trainer.py) — more
+        # concurrent clients per chip at the cost of a second forward pass
+        self.remat_local = remat_local
         # shape used for parameter init: stored sample shape plus the
         # injected channel axis
         self.init_sample_shape = tuple(data.sample_shape) + (

@@ -98,6 +98,7 @@ class DisPFL(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=True, mask_params_post_step=True,
+            remat=self.remat_local,
         )
         loss_fn = make_loss_fn(self.loss_type)
 

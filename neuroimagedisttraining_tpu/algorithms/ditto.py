@@ -52,11 +52,13 @@ class Ditto(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
+            remat=self.remat_local,
         )
         self.personal_update = make_client_update(
             self.apply_fn, self.loss_type, self._personal_hp or self.hp,
             mask_grads=False, mask_params_post_step=False,
             prox_lambda=self.lamda,
+            remat=self.remat_local,
         )
 
         def round_fn(state: DittoState, sel_idx, round_idx,

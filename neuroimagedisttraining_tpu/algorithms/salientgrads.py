@@ -52,6 +52,7 @@ class SalientGrads(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=True,
+            remat=self.remat_local,
         )
         self.snip_scores = make_snip_score_fn(
             self.apply_fn, self.loss_type, self.hp.batch_size

@@ -66,11 +66,13 @@ class SubAvg(FedAlgorithm):
         self._update_first = make_client_update(
             self.apply_fn, self.loss_type, hp_first,
             mask_grads=True, mask_params_post_step=False,
+            remat=self.remat_local,
         )
         self._update_rest = (
             make_client_update(
                 self.apply_fn, self.loss_type, hp_rest,
                 mask_grads=True, mask_params_post_step=False,
+                remat=self.remat_local,
             )
             if hp_rest.local_epochs > 0 else None
         )

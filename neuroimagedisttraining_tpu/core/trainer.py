@@ -37,6 +37,7 @@ def make_client_update(
     mask_grads: bool = False,
     mask_params_post_step: bool = True,
     prox_lambda: float = 0.0,
+    remat: bool = False,
 ):
     """Build the per-client local-training function.
 
@@ -46,6 +47,9 @@ def make_client_update(
     step (SalientGrads, ``my_model_trainer.py:213-216``).
     ``prox_lambda``: Ditto's personalization pull — after each step,
     ``w -= lr * lambda * (w - w_global)`` (``ditto/my_model_trainer.py:63-64``).
+    ``remat``: rematerialize the per-batch loss (activations recomputed in
+    the backward pass) — trades FLOPs for HBM so more clients fit
+    concurrently under the vmap (``client_chunk`` can rise).
 
     Returns ``client_update(params, momentum, mask, rng, x, y, n_valid,
     round_idx, prox_target) -> (params, momentum, mean_loss)``; vmap over a
@@ -58,6 +62,9 @@ def make_client_update(
         logits = apply_fn(params, xb, train=True, rng=dropout_rng)
         return loss_fn(logits, yb)
 
+    if remat:
+        batch_loss = jax.checkpoint(
+            batch_loss, policy=jax.checkpoint_policies.nothing_saveable)
     grad_fn = jax.value_and_grad(batch_loss)
 
     def client_update(params, momentum, mask, rng, x, y, n_valid, round_idx,

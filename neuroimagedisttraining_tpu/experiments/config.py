@@ -96,8 +96,16 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--compute_dtype", type=str, default="",
                    help="mixed-precision compute dtype (e.g. bfloat16); "
                         "master weights stay float32")
+    p.add_argument("--data_dtype", type=str, default="",
+                   choices=["", "float32", "bfloat16"],
+                   help="store volumes in this dtype on device (bfloat16 "
+                        "halves HBM for data and skips the per-step "
+                        "convert when paired with --compute_dtype bfloat16)")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--remat", type=int, default=0,
+                   help="rematerialize local-step activations (trades FLOPs "
+                        "for HBM so --client_chunk can rise)")
     p.add_argument("--multihost", action="store_true",
                    help="initialize jax.distributed and span the clients "
                         "mesh over every host's devices (TPU pod / "
@@ -114,6 +122,10 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "init")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="shard client axis over this many devices (0 = all)")
+    p.add_argument("--mesh_space", type=int, default=1,
+                   help="shard each volume's depth over this many devices "
+                        "(hybrid clients x space mesh — the context-parallel "
+                        "axis; volumes are zero-padded to divide it)")
     p.add_argument("--checkpoint_dir", type=str, default="",
                    help="enable round-granular orbax checkpointing here")
     p.add_argument("--resume", action="store_true",

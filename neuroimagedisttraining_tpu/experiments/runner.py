@@ -102,6 +102,29 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
 
     if data is None:
         data = build_data(args)
+    n_space = max(1, getattr(args, "mesh_space", 1))
+    if n_space > 1:
+        # pad volume depth BEFORE model construction so init sees the
+        # padded sample shape (parallel/spatial.py)
+        from ..parallel.spatial import pad_federated_depth
+
+        data = pad_federated_depth(data, n_space)
+    ddt = getattr(args, "data_dtype", "")
+    if ddt:
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(ddt)
+
+        def cast(x):
+            if x is None:
+                return None
+            if isinstance(x, jax.Array):
+                return jnp.asarray(x, dt)
+            return np.asarray(x).astype(dt)  # host-side (ml_dtypes bf16)
+
+        data = data.replace(x_train=cast(data.x_train),
+                            x_test=cast(data.x_test),
+                            x_val=cast(data.x_val))
     loss_type = infer_loss_type(args, data.class_num)
     num_outputs = 1 if loss_type == "bce" else data.class_num
     model = create_model(model_key, num_classes=num_outputs)
@@ -120,6 +143,7 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         client_chunk=args.client_chunk or None,
         compute_dtype=getattr(args, "compute_dtype", "") or None,
         channel_inject=(layout == "flat" and _is_abcd_h5(args.dataset)),
+        remat_local=bool(getattr(args, "remat", 0)),
     )
     defense = None
     if getattr(args, "defense_type", "none") != "none":
@@ -189,6 +213,17 @@ def build_multihost_data(args: argparse.Namespace):
 
     if jax.process_count() <= 1:
         return None, None
+
+    def pad_local(local):
+        n_space = max(1, getattr(args, "mesh_space", 1))
+        if n_space <= 1:
+            return local
+        from ..parallel.spatial import pad_federated_depth
+
+        # pad on host BEFORE lifting to global device arrays; the later
+        # build_algorithm pad is then a no-op
+        return pad_federated_depth(local, n_space)
+
     if _is_abcd_h5(args.dataset):
         if args.dataset.lower() == "abcd_site" or not args.client_num_in_total:
             from ..data.abcd import abcd_site_count
@@ -197,39 +232,51 @@ def build_multihost_data(args: argparse.Namespace):
         else:
             n_clients = args.client_num_in_total
         mesh = make_multihost_mesh(
+            n_space=max(1, getattr(args, "mesh_space", 1)),
             num_clients=n_clients,
             max_client_devices=args.mesh_devices or None)
         idx = local_client_indices(n_clients, mesh)
-        local = build_data(args, client_filter=idx)
+        local = pad_local(build_data(args, client_filter=idx))
         return mesh, shard_federated_data_global(local, n_clients, mesh)
     # other datasets: every process loads the (small) dataset, keeps its
     # clients, and contributes them to the global arrays
     data = build_data(args)
     n_clients = data.num_clients
     mesh = make_multihost_mesh(
-        num_clients=n_clients, max_client_devices=args.mesh_devices or None)
+        n_space=max(1, getattr(args, "mesh_space", 1)),
+        num_clients=n_clients,
+        max_client_devices=args.mesh_devices or None)
     idx = local_client_indices(n_clients, mesh)
-    local = jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], data)
+    local = pad_local(jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[idx], data))
     return mesh, shard_federated_data_global(local, n_clients, mesh)
 
 
 def maybe_shard(algo, args: argparse.Namespace):
-    """Place the client-stacked data on a ``clients`` mesh so the vmapped
-    round runs SPMD over devices (SURVEY §7 design stance)."""
+    """Place the client-stacked data on a ``clients[, space]`` mesh so the
+    vmapped round runs SPMD over devices (SURVEY §7 design stance). With
+    ``--mesh_space N`` each volume's depth is sharded over a second mesh
+    axis (the context-parallel slot, SURVEY §5.7) and XLA GSPMD inserts the
+    conv halo exchanges."""
     import jax
 
-    from ..parallel import make_mesh, shard_over_clients
+    from ..parallel import make_mesh
+    from ..parallel.mesh import shard_federated_hybrid
 
-    n_dev = args.mesh_devices or len(jax.devices())
-    n_dev = min(n_dev, len(jax.devices()), algo.num_clients)
-    if n_dev <= 1:
-        return None
+    n_space = max(1, getattr(args, "mesh_space", 1))
+    avail = len(jax.devices())
+    if n_space > avail:
+        raise SystemExit(
+            f"--mesh_space {n_space} needs at least that many devices "
+            f"(have {avail})")
+    n_dev = args.mesh_devices or (avail // n_space)
+    n_dev = min(n_dev, avail // n_space, algo.num_clients)
     while algo.num_clients % n_dev:
         n_dev -= 1
-    if n_dev <= 1:
+    if n_dev <= 1 and n_space == 1:
         return None
-    mesh = make_mesh(n_dev)
-    algo.data = shard_over_clients(algo.data, mesh)
+    mesh = make_mesh(n_dev, n_space)
+    algo.data = shard_federated_hybrid(algo.data, mesh)
     return mesh
 
 

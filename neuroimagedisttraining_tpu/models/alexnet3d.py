@@ -93,25 +93,119 @@ class S2DStem(nn.Module):
         return y + b
 
 
+def _group_stats(zf, groups, eps):
+    """Per-(sample, group) mean and 1/std of a channels-last f32 tensor,
+    broadcast back per channel: returns (mu_c, sig_c) shaped
+    (B, 1, 1, 1, C). Shared by both S2DStemStage branches so the
+    pool_first == textbook equivalence cannot drift."""
+    F = zf.shape[-1]
+    zg = zf.reshape(zf.shape[:-1] + (groups, F // groups))
+    mu = zg.mean(axis=(1, 2, 3, 5))                      # (B, g)
+    var = (zg * zg).mean(axis=(1, 2, 3, 5)) - mu * mu
+    sig = jnp.sqrt(jnp.maximum(var, 0) + eps)
+    mu_c = jnp.repeat(mu, F // groups, axis=-1)[:, None, None, None, :]
+    sig_c = jnp.repeat(sig, F // groups, axis=-1)[:, None, None, None, :]
+    return mu_c, sig_c
+
+
+class S2DStemStage(nn.Module):
+    """Fused stem stage: phased conv + GroupNorm + ReLU + MaxPool3(s3) with
+    the pool hoisted before the normalize affine ("pool-first").
+
+    Exact restatement of ``S2DStem -> GroupNorm -> relu -> max_pool3d(3,3)``
+    (same function, verified to 1e-6): max-pool commutes with the monotone
+    per-channel affine+relu — channels with negative GroupNorm scale need
+    the window *min*, which is obtained by folding ``sign(scale)`` into the
+    conv kernel so exactly ONE pool runs on the conv output and the
+    full-size normalized tensor is never materialized. On TPU the training
+    step is HBM-bandwidth-bound in this stage; dropping that 253 MB
+    materialization measures ~15-20% faster end-to-end (RESULTS.md r2).
+
+    Params: ``kernel``/``bias`` (the masked phased conv — SNIP, weight
+    decay and the copy converter see the usual "kernel" leaf) and
+    ``scale``/``bias_gn`` (the GroupNorm affine pair).
+
+    ``pool_first=False`` computes the textbook order with the SAME
+    parameters (equivalence testing / fallback).
+    """
+
+    features: int = 64
+    max_groups: int = 32
+    pool_first: bool = True
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.s2d import N_PHASES, R_KERNEL, stem_slot_mask
+
+        F = self.features
+        g = min(self.max_groups, F)
+        while F % g:
+            g -= 1
+        w = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(
+                216.0 / 125.0, "fan_in", "truncated_normal",
+                in_axis=(0, 1, 2, 3), batch_axis=()),
+            (R_KERNEL,) * 3 + (N_PHASES, F),
+        )
+        b = self.param("bias", nn.initializers.zeros, (F,))
+        gamma = self.param("scale", nn.initializers.ones, (F,))
+        beta = self.param("bias_gn", nn.initializers.zeros, (F,))
+        mask = jnp.asarray(stem_slot_mask(), w.dtype)
+        dn_args = ("NDHCW", "DHWIO", "NDHWC")
+
+        if not self.pool_first:
+            dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_args)
+            z = lax.conv_general_dilated(
+                x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn) + b
+            self.sow("intermediates", "conv_out", z)
+            # normalize explicitly with this module's own affine params
+            zf = z.astype(jnp.float32)
+            mu_c, sig_c = _group_stats(zf, g, self.eps)
+            y = (zf - mu_c) / sig_c * gamma + beta
+            y = nn.relu(y).astype(z.dtype)
+            return max_pool3d(y, kernel=3, strides=3)
+
+        sign = jnp.where(gamma >= 0, 1.0, -1.0).astype(w.dtype)
+        ws = (w * mask) * sign
+        dn = lax.conv_dimension_numbers(x.shape, ws.shape, dn_args)
+        zs = lax.conv_general_dilated(
+            x, ws, (1, 1, 1), "VALID", dimension_numbers=dn)
+        zs = zs + (b * sign.astype(b.dtype))
+        self.sow("intermediates", "conv_out", zs)
+        # group stats of z = zs * sign, in f32
+        sf = sign.astype(jnp.float32)
+        zf = zs.astype(jnp.float32) * sf
+        mu_c, sig_c = _group_stats(zf, g, self.eps)
+        # ONE pool on zs = max over window of z for scale>=0 channels,
+        # -min for scale<0 channels
+        m = max_pool3d(zs, kernel=3, strides=3)
+        sel = m.astype(jnp.float32) * sf
+        y = (sel - mu_c) / sig_c * gamma + beta
+        return nn.relu(y).astype(zs.dtype)
+
+
 class AlexNet3DS2D(nn.Module):
     """AlexNet3D over phase-decomposed input — same function class and
     output as :class:`AlexNet3D`, restated for the MXU (see ops/s2d.py).
 
     Input: ``(B, 61, 73, 8, 61)`` phased volumes (for the canonical
     121x145x121 ABCD volume) instead of ``(B, 121, 145, 121, 1)``.
+    The first stage (stem conv/GN/relu/pool) runs as the fused pool-first
+    :class:`S2DStemStage`; its GroupNorm lives inside the stage, so the
+    remaining norms are ``GroupNorm_0..3`` (for convs 2-5).
     """
 
     num_classes: int = 1
     dropout_rate: float = 0.5
     widths: tuple = (64, 128, 192, 192, 128)
+    pool_first: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         w1, w2, w3, w4, w5 = self.widths
-        x = S2DStem(features=w1)(x)
-        x = group_norm(w1)(x)
-        x = nn.relu(x)
-        x = max_pool3d(x, kernel=3, strides=3)
+        x = S2DStemStage(features=w1, pool_first=self.pool_first)(x)
 
         x = Conv3d(w2, kernel_size=3, strides=1, padding=0)(x)
         x = group_norm(w2)(x)

@@ -103,18 +103,21 @@ def stem_slot_mask() -> np.ndarray:
 def convert_alexnet3d_params(params) -> dict:
     """Map an :class:`AlexNet3D` param tree to :class:`AlexNet3DS2D`.
 
-    The stem kernel is remapped tap-for-tap; every other layer transfers
-    unchanged (the two models share all post-stem structure).
+    The stem kernel is remapped tap-for-tap into the fused
+    ``S2DStemStage`` (which also owns the stem GroupNorm's affine pair);
+    every other layer transfers unchanged (the two models share all
+    post-stem structure, with the remaining GroupNorms renumbered 0..3).
     """
     feats = params["_Features_0"]
-    out = {"S2DStem_0": {
+    out = {"S2DStemStage_0": {
         "kernel": remap_stem_kernel(feats["Conv3d_0"]["Conv_0"]["kernel"]),
         "bias": feats["Conv3d_0"]["Conv_0"]["bias"],
+        "scale": feats["GroupNorm_0"]["scale"],
+        "bias_gn": feats["GroupNorm_0"]["bias"],
     }}
     for i in range(1, 5):
         out[f"Conv3d_{i-1}"] = feats[f"Conv3d_{i}"]
-    for i in range(5):
-        out[f"GroupNorm_{i}"] = feats[f"GroupNorm_{i}"]
+        out[f"GroupNorm_{i-1}"] = feats[f"GroupNorm_{i}"]
     out["Dense_0"] = params["Dense_0"]
     out["Dense_1"] = params["Dense_1"]
     return out

@@ -51,6 +51,25 @@ def shard_over_clients(tree: Any, mesh: Mesh) -> Any:
     return jax.device_put(tree, sharding)
 
 
+def shard_federated_hybrid(tree: Any, mesh: Mesh) -> Any:
+    """Place a FederatedData pytree on a (clients[, space]) mesh: the client
+    axis over ``clients`` and — when the mesh has a ``space`` axis — each
+    volume's depth (leaf axis 2 of the [C, n, D, H, W, ...] arrays) over
+    ``space``. Labels/counts ([C, n] / [C]) shard over clients only."""
+    has_space = "space" in mesh.axis_names
+
+    def put(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        if has_space and x.ndim >= 3:
+            spec = P("clients", None, "space")
+        else:
+            spec = P("clients")
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Replicate a pytree (e.g. global model params) across the mesh."""
     sharding = NamedSharding(mesh, P())

@@ -182,10 +182,22 @@ def shard_federated_data_global(local_data: Any, num_clients: int,
                                 mesh: Mesh) -> Any:
     """Lift a process-local FederatedData (holding only this process's
     clients, in ``local_client_indices`` order) to the global sharded
-    pytree every process passes to the same jitted round."""
+    pytree every process passes to the same jitted round.
+
+    On a (clients, space) mesh the volume arrays ([C, n, D, ...]) are
+    additionally depth-sharded over ``space`` (context parallelism) — the
+    same placement as the single-host ``shard_federated_hybrid``."""
+    has_space = "space" in mesh.axis_names
+
     def lift(x):
         x = np.asarray(x)
-        return make_global_client_array(
-            x, (num_clients,) + x.shape[1:], mesh)
+        if has_space and x.ndim >= 3:
+            spec = P("clients", None, "space")
+        else:
+            spec = P("clients")
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(x),
+            (num_clients,) + x.shape[1:])
 
     return jax.tree_util.tree_map(lift, local_data)

@@ -66,19 +66,24 @@ def shard_spatial(x: jax.Array, mesh: Mesh, axis_name: str = SPACE_AXIS):
     return jax.device_put(x, NamedSharding(mesh, spatial_spec(x.ndim, axis_name)))
 
 
-def pad_depth_to(x: jax.Array, multiple: int, depth_axis: int = 1) -> jax.Array:
+def pad_depth_to(x, multiple: int, depth_axis: int = 1):
     """Zero-pad the depth axis up to the next multiple (background padding).
 
     Note conv arithmetic sees the padded extent, so model init must use the
     padded shape too — flax infers Dense fan-in at init, nothing else changes.
+    Host numpy arrays stay on host (padding a full cohort must not stage it
+    onto one device before sharding).
     """
+    import numpy as np
+
     d = x.shape[depth_axis]
     pad = (-d) % multiple
     if not pad:
         return x
     widths = [(0, 0)] * x.ndim
     widths[depth_axis] = (0, pad)
-    return jnp.pad(x, widths)
+    xp = jnp if isinstance(x, jax.Array) else np
+    return xp.pad(x, widths)
 
 
 def make_spatial_forward(
@@ -201,6 +206,28 @@ def make_sharded_conv3d(mesh: Mesh, axis_name: str = SPACE_AXIS):
         out_specs=spec_x,
         check_vma=False,
     )
+
+
+def pad_federated_depth(data: Any, multiple: int) -> Any:
+    """Zero-pad every volume array of a FederatedData so its depth (axis 2
+    of the [C, n, D, H, W, ...] layout) divides the ``space`` mesh axis.
+
+    Background padding is neutral for brain-masked MRI (the cohort's
+    background is already zero, ``Preprocess_ABCD.ipynb`` mean-mask step);
+    model init must use the padded sample shape (flax infers Dense fan-in
+    at init), which falls out naturally when the data is padded before the
+    algorithm is constructed."""
+    if multiple <= 1:
+        return data
+
+    def pad(x):
+        if x is None:
+            return None
+        return pad_depth_to(x, multiple, depth_axis=2)
+
+    return data.replace(
+        x_train=pad(data.x_train), x_test=pad(data.x_test),
+        x_val=pad(data.x_val))
 
 
 # ---------------------------------------------------------------------------

@@ -88,7 +88,13 @@ def per_layer_flops(model, params, sample_shape: Tuple[int, ...]
         layer_path = path[:-1]
         called = _lookup(inter, layer_path)
         kshape = tuple(leaf.shape)
-        if called is not None and "__call__" in called:
+        if called is not None and "conv_out" in called:
+            # fused stages (e.g. S2DStemStage) expose their conv output
+            # explicitly — their __call__ returns the pooled tensor, which
+            # would undercount the conv's spatial extent by the pool factor
+            y = called["conv_out"][0]
+            yshape = tuple(np.asarray(y.shape, dtype=np.int64))
+        elif called is not None and "__call__" in called:
             y = called["__call__"][0]
             yshape = tuple(np.asarray(y.shape, dtype=np.int64))
         else:

@@ -162,3 +162,50 @@ def test_abcd_s2d_layout_squeezes_stored_channel(tmp_path):
     write_abcd_h5(path, X, y, site)
     data = load_partition_data_abcd(path, layout="s2d")
     assert data.sample_shape == phased_sample_shape((6, 7, 6))
+
+
+def test_pool_first_stage_matches_textbook_order():
+    """The fused pool-first stem stage is EXACT: same params, both orders,
+    identical outputs — including channels with negative GroupNorm scale
+    (which take the window min through the sign-folded kernel)."""
+    from neuroimagedisttraining_tpu.models.alexnet3d import S2DStemStage
+
+    vol = (13, 15, 13)
+    xs = jax.random.normal(
+        jax.random.PRNGKey(0), (2,) + phased_sample_shape(vol), jnp.float32)
+    a = S2DStemStage(features=16, pool_first=True)
+    b = S2DStemStage(features=16, pool_first=False)
+    p = a.init(jax.random.PRNGKey(1), xs)["params"]
+    g = np.array(p["scale"])
+    g[::3] = -np.abs(g[::3]) - 0.5  # exercise the min path
+    p = dict(p, scale=jnp.asarray(g))
+    ya = a.apply({"params": p}, xs)
+    yb = b.apply({"params": p}, xs)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pool_first_stage_grads_match():
+    """Autodiff through both orders gives the same parameter gradients."""
+    from neuroimagedisttraining_tpu.models.alexnet3d import S2DStemStage
+
+    vol = (13, 15, 13)
+    xs = jax.random.normal(
+        jax.random.PRNGKey(0), (2,) + phased_sample_shape(vol), jnp.float32)
+    a = S2DStemStage(features=16, pool_first=True)
+    b = S2DStemStage(features=16, pool_first=False)
+    p = a.init(jax.random.PRNGKey(1), xs)["params"]
+    g = np.array(p["scale"]); g[::4] = -np.abs(g[::4]) - 0.3
+    p = dict(p, scale=jnp.asarray(g))
+
+    def loss(mod):
+        def f(p):
+            y = mod.apply({"params": p}, xs)
+            return jnp.sum(y * jnp.sin(jnp.arange(y.size).reshape(y.shape)))
+        return f
+
+    ga = jax.grad(loss(a))(p)
+    gb = jax.grad(loss(b))(p)
+    for k in ga:
+        np.testing.assert_allclose(np.asarray(ga[k]), np.asarray(gb[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)

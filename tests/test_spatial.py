@@ -198,3 +198,48 @@ def test_ring_mix_direction_semantics(eight_devices):
                      weights=(0.0, 1.0, 0.0))  # pure left-neighbor copy
     expect = jnp.roll(x["v"], 1, axis=0)  # out_i = x_{i-1}
     np.testing.assert_allclose(np.asarray(mixed["v"]), np.asarray(expect))
+
+
+def test_mesh_space_cli_product_path(tmp_path):
+    """--mesh_space is a product feature (VERDICT r1 item 6): a real
+    algorithm trains through the CLI runner on a hybrid clients x space
+    mesh, with volume depth zero-padded to divide the space axis."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    argv = ["--model", "small3dcnn", "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--batch_size", "8",
+            "--epochs", "1", "--comm_round", "2", "--lr", "0.05",
+            "--mesh_space", "2", "--final_finetune", "0",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="fedavg")
+    out = run_experiment(args, "fedavg")
+    rounds = [h for h in out["history"] if h["round"] >= 0]
+    assert len(rounds) == 2
+    assert all(np.isfinite(h["train_loss"]) for h in rounds)
+    assert np.isfinite(rounds[-1]["global_acc"])
+
+
+def test_mesh_space_pads_odd_depth(tmp_path):
+    """Odd-depth volumes (the canonical 121 has no factors of 2) must be
+    zero-padded so the space axis divides the depth — checked via the
+    padding helper the runner uses."""
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.parallel.spatial import (
+        pad_federated_depth,
+    )
+
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=8, test_per_client=4,
+        sample_shape=(7, 8, 8, 1), loss_type="bce", class_num=2)
+    padded = pad_federated_depth(data, 4)
+    assert padded.x_train.shape[2] == 8
+    assert padded.x_test.shape[2] == 8
+    # padding is zeros (background), data preserved
+    assert jnp.allclose(padded.x_train[:, :, :7], data.x_train)
+    assert jnp.all(padded.x_train[:, :, 7:] == 0)
