@@ -94,22 +94,27 @@ def main():
             make_mesh,
             shard_over_clients,
         )
+        from neuroimagedisttraining_tpu.parallel.mesh import (
+            fit_client_devices,
+        )
 
-        rows = min(n_dev, N_CLIENTS)
-        while N_CLIENTS % rows:
-            rows -= 1
+        rows = fit_client_devices(N_CLIENTS, n_dev)
         if rows > 1:
             mesh = make_mesh(rows)
             data = shard_over_clients(data, mesh)
-            chunk = None if rows == N_CLIENTS else 1
+            # full client vmap: anything else (lax.map chunking) would
+            # serialize clients and idle the other chips; per-chip
+            # concurrency is N_CLIENTS/rows
+            chunk = None
     import os
     if os.environ.get("BENCH_CHUNK"):  # perf-tuning override
         chunk = int(os.environ["BENCH_CHUNK"]) or None
     remat = bool(int(os.environ.get("BENCH_REMAT", "0")))
+    fused = bool(int(os.environ.get("BENCH_FUSED", "0")))
     algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
                         client_chunk=chunk, dense_ratio=0.5,
                         itersnip_iterations=1, compute_dtype="bfloat16",
-                        remat_local=remat)
+                        remat_local=remat, fused_kernels=fused)
     state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
 
     def _sync(s):

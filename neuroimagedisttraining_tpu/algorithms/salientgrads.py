@@ -41,11 +41,13 @@ class SalientGrads(FedAlgorithm):
     name = "salientgrads"
 
     def __init__(self, *args, dense_ratio: float = 0.5,
-                 itersnip_iterations: int = 1, defense=None, **kwargs):
+                 itersnip_iterations: int = 1, defense=None,
+                 fused_kernels: bool = False, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
         self.defense = defense
+        self.fused_kernels = fused_kernels
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -53,6 +55,7 @@ class SalientGrads(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=True,
             remat=self.remat_local,
+            fused_kernels=self.fused_kernels,
         )
         self.snip_scores = make_snip_score_fn(
             self.apply_fn, self.loss_type, self.hp.batch_size

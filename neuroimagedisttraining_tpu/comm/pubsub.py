@@ -81,6 +81,7 @@ class PubSubBroker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._subs: Dict[str, Set[socket.socket]] = {}
         self._locks: Dict[socket.socket, threading.Lock] = {}
+        self._warned_topics: Set[str] = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -138,10 +139,15 @@ class PubSubBroker:
         if not targets:
             # QoS-0 drop (reference MQTT semantics) — but log it, so a
             # publish racing a subscriber's startup is diagnosable from
-            # broker logs instead of an opaque receive timeout (ADVICE r1)
-            logger.warning(
-                "dropping publish to %r: no subscriber (QoS-0); "
-                "payload %d bytes", topic, len(payload))
+            # broker logs instead of an opaque receive timeout (ADVICE r1).
+            # Once per topic: steady-state publishes to an unconsumed topic
+            # are legitimate and must not flood the log.
+            if topic not in self._warned_topics:
+                self._warned_topics.add(topic)
+                logger.warning(
+                    "dropping publish to %r: no subscriber (QoS-0); "
+                    "payload %d bytes (warned once per topic)",
+                    topic, len(payload))
         for sub in targets:
             lock = self._locks.get(sub)
             if lock is None:

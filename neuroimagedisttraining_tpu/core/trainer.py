@@ -38,6 +38,7 @@ def make_client_update(
     mask_params_post_step: bool = True,
     prox_lambda: float = 0.0,
     remat: bool = False,
+    fused_kernels: bool = False,
 ):
     """Build the per-client local-training function.
 
@@ -50,6 +51,8 @@ def make_client_update(
     ``remat``: rematerialize the per-batch loss (activations recomputed in
     the backward pass) — trades FLOPs for HBM so more clients fit
     concurrently under the vmap (``client_chunk`` can rise).
+    ``fused_kernels``: route the optimizer update through the Pallas fused
+    masked-SGD kernel (ops/pallas_kernels.py) instead of the XLA chain.
 
     Returns ``client_update(params, momentum, mask, rng, x, y, n_valid,
     round_idx, prox_target) -> (params, momentum, mean_loss)``; vmap over a
@@ -80,6 +83,16 @@ def make_client_update(
             yb = jnp.take(y, idx, axis=0)
             loss, grads = grad_fn(params, xb, yb, k_drop)
             grads = clip_by_global_norm(grads, hp.grad_clip)
+            if fused_kernels and not prox_lambda:
+                from ..ops.pallas_kernels import fused_masked_sgd_step
+
+                ones = mask if (mask_grads or mask_params_post_step) \
+                    else jax.tree_util.tree_map(jnp.ones_like, params)
+                params, momentum = fused_masked_sgd_step(
+                    params, momentum, grads, ones, lr,
+                    momentum=hp.momentum, wd=hp.weight_decay,
+                    mask_grads=mask_grads)
+                return (params, momentum), loss
             if mask_grads:
                 grads = jax.tree_util.tree_map(lambda g, m: g * m, grads, mask)
             params, momentum = sgd_momentum_step(

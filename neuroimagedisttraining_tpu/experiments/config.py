@@ -103,6 +103,10 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "convert when paired with --compute_dtype bfloat16)")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--fused_kernels", type=int, default=0,
+                   help="route the optimizer update through the Pallas "
+                        "fused masked-SGD kernel (salientgrads; measured "
+                        "neutral on AlexNet3D — see RESULTS.md)")
     p.add_argument("--remat", type=int, default=0,
                    help="rematerialize local-step activations (trades FLOPs "
                         "for HBM so --client_chunk can rise)")

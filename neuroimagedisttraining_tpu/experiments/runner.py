@@ -162,7 +162,8 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
     if algo_name == "salientgrads":
         extra = dict(dense_ratio=args.dense_ratio,
                      itersnip_iterations=args.itersnip_iteration,
-                     defense=defense)
+                     defense=defense,
+                     fused_kernels=bool(getattr(args, "fused_kernels", 0)))
     elif algo_name == "fedavg":
         extra = dict(defense=defense)
     elif algo_name == "dispfl":
@@ -269,10 +270,11 @@ def maybe_shard(algo, args: argparse.Namespace):
         raise SystemExit(
             f"--mesh_space {n_space} needs at least that many devices "
             f"(have {avail})")
-    n_dev = args.mesh_devices or (avail // n_space)
-    n_dev = min(n_dev, avail // n_space, algo.num_clients)
-    while algo.num_clients % n_dev:
-        n_dev -= 1
+    from ..parallel.mesh import fit_client_devices
+
+    n_dev = fit_client_devices(
+        algo.num_clients,
+        min(args.mesh_devices or (avail // n_space), avail // n_space))
     if n_dev <= 1 and n_space == 1:
         return None
     mesh = make_mesh(n_dev, n_space)
@@ -387,6 +389,19 @@ def run_experiment(args: argparse.Namespace,
         cost = CostTracker(model=algo.model,
                            sample_shape=algo.init_sample_shape)
         samples_per_client = algo.hp.local_steps * algo.hp.batch_size
+        if start_round > 0:
+            # resumed run: seed the cumulative counters with the rounds
+            # that ran before the checkpoint, from the restored state's
+            # snapshot (exact for static masks; for evolving-mask
+            # algorithms this uses the current density as the estimate)
+            cost_params, cost_mask = algo.cost_snapshot(state)
+            if cost_params is not None:
+                cost.record_round(
+                    cost_params, cost_mask,
+                    n_clients=algo.cost_trained_clients_per_round(),
+                    samples_per_client=samples_per_client)
+                for _ in range(start_round - 1):
+                    cost.record_repeat()
 
         history = []
         final_eval = None
@@ -422,7 +437,12 @@ def run_experiment(args: argparse.Namespace,
                 ckpt_mgr.save(r + 1, state)
 
         fin_rec = None
-        if getattr(args, "final_finetune", 1):
+        # skip the end-of-training pass when a resumed run had nothing
+        # left to do — the checkpointed state was already finalized once;
+        # re-running would double-fine-tune the personal models
+        ran_rounds = max(0, args.comm_round - start_round)
+        if getattr(args, "final_finetune", 1) and \
+                (ran_rounds > 0 or start_round == 0):
             state, fin_rec = algo.finalize(state)
         if fin_rec is not None:
             # the reference's final fine-tune record (round -1)

@@ -34,8 +34,10 @@ def _registry():
         VGG11,
         VGG16,
     )
-    from .meta import CNNCifar10Meta
+    from .meta import CNNCifar10Meta, MetaResNet20
     from .resnet_gn import resnet18_gn, resnet34_gn, resnet50_gn
+    from .resnet2d import OriginalResNet18
+    from .resnet_ip import ResNetIP
 
     return {
         # reference names (main_*.py --model flags)
@@ -49,6 +51,12 @@ def _registry():
         ),
         "3dresnet": lambda num_classes, **kw: ResNet3DL3(num_classes=num_classes, **kw),
         "resnet18": lambda num_classes, **kw: ResNet18GN(num_classes=num_classes, **kw),
+        # BatchNorm variant (forward/eval parity; mutable batch_stats —
+        # FL trainers use the GN twin, models/resnet2d.py docstring)
+        "original_resnet18": lambda num_classes, **kw: OriginalResNet18(num_classes=num_classes, **kw),
+        # research-leftover families (resnet_ip.py / resnet_meta*.py)
+        "resnet_ip": lambda num_classes, **kw: ResNetIP(num_classes=num_classes, **kw),
+        "resnet_meta": lambda num_classes, **kw: MetaResNet20(num_classes=num_classes, **kw),
         "tiny_resnet18": lambda num_classes, **kw: TinyResNet18(num_classes=num_classes, **kw),
         "cnn_cifar10": lambda num_classes, **kw: CNNCifar10(num_classes=num_classes, **kw),
         "cnn_cifar100": lambda num_classes, **kw: CNNCifar100(num_classes=num_classes, **kw),

@@ -79,3 +79,58 @@ class TinyResNet18(nn.Module):
                 x = BasicBlock2D(planes=planes, stride=stride)(x)
         x = x.mean(axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
+
+
+class _BNBasicBlock2D(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def bn(v):
+            return nn.BatchNorm(use_running_average=not train,
+                                momentum=0.9)(v)
+
+        residual = x
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                    use_bias=False)(x)
+        y = bn(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), strides=1, padding=1,
+                    use_bias=False)(y)
+        y = bn(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            residual = nn.Conv(self.planes, (1, 1), strides=self.stride,
+                               use_bias=False)(x)
+            residual = bn(residual)
+        return nn.relu(y + residual)
+
+
+class OriginalResNet18(nn.Module):
+    """original_resnet18 (resnet.py:42-89): the BatchNorm CIFAR ResNet18.
+
+    Provided for forward/eval parity with the reference's named variant.
+    BatchNorm carries mutable ``batch_stats`` (apply with
+    ``mutable=["batch_stats"]`` in train mode); the FL training paths use
+    stateless norms by policy (models/layers.py docstring) — which is the
+    very reason the reference added ``customized_resnet18``.
+    """
+
+    num_classes: int = 10
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (3, 3), strides=1, padding=1, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        for stage, (planes, n) in enumerate(
+            zip((64, 128, 256, 512), self.num_blocks)
+        ):
+            for b in range(n):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = _BNBasicBlock2D(planes=planes, stride=stride)(
+                    x, train=train)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,138 @@
+"""ResNet-IP — additively-decomposed (global + personal) CIFAR ResNet.
+
+Behavioral rebuild of the reference's ``fedml_api/model/cv/resnet_ip.py``
+(``ResNet_ip``, ``resnet29_ip/56/110`` @ :179-346): every conv, norm-affine
+and fc weight exists TWICE — a global leg (``*_g``) and a personal/variant
+leg (``*_v``) — and the forward always uses their SUM ``w_g + w_v``
+(``Bottleneck.forward`` :152-176). Norms are BatchNorm with
+``track_running_stats=False`` (:133-146), i.e. *stateless* batch-statistic
+normalization at train AND eval — reproduced here exactly (no mutable
+collections, so the FL trainers can carry this model like any other).
+
+TPU-native form: instead of duplicating modules, each layer declares a
+``g`` and ``v`` param pair and applies one conv/linear with the summed
+weights — one XLA op per layer, no second compute pass. A federated
+algorithm can aggregate only the ``g`` leaves (pytree path filtering) and
+keep ``v`` personal, which is the decomposition's purpose.
+
+Structure (reference ``resnet29_ip``): conv3x3 stem (16), three bottleneck
+stages of widths 16/32/64 (expansion 4), adaptive avg-pool, fc. The 29/56/
+110 depth variants use 3/6/12 bottlenecks per stage.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _batch_stat_norm(x, scale, bias, eps=1e-5):
+    """BatchNorm with track_running_stats=False: always batch statistics
+    (stateless — the reference's per_batch_norm path, resnet_ip.py:33-74)."""
+    mu = x.mean(axis=(0, 1, 2), keepdims=True)
+    var = x.var(axis=(0, 1, 2), keepdims=True)
+    y = (x - mu) / jnp.sqrt(var + eps)
+    return y * scale + bias
+
+
+class _DualConv(nn.Module):
+    """Conv whose effective kernel is w_g + w_v (resnet_ip.py:152-157)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str | int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        kshape = self.kernel + (x.shape[-1], self.features)
+        wg = self.param("kernel_g", nn.initializers.he_normal(), kshape)
+        wv = self.param("kernel_v", nn.initializers.zeros, kshape)
+        pad = self.padding if isinstance(self.padding, str) else \
+            [(self.padding, self.padding)] * 2
+        dn = ("NHWC", "HWIO", "NHWC")
+        import jax.lax as lax
+
+        return lax.conv_general_dilated(
+            x, wg + wv, self.strides, pad,
+            dimension_numbers=lax.conv_dimension_numbers(
+                x.shape, kshape, dn))
+
+
+class _DualNorm(nn.Module):
+    """Stateless batch-stat norm with summed affine (g + v)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        sg = self.param("scale_g", nn.initializers.ones, (self.features,))
+        sv = self.param("scale_v", nn.initializers.zeros, (self.features,))
+        bg = self.param("bias_g", nn.initializers.zeros, (self.features,))
+        bv = self.param("bias_v", nn.initializers.zeros, (self.features,))
+        return _batch_stat_norm(x, sg + sv, bg + bv)
+
+
+class _DualDense(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        kshape = (x.shape[-1], self.features)
+        wg = self.param("kernel_g", nn.initializers.lecun_normal(), kshape)
+        wv = self.param("kernel_v", nn.initializers.zeros, kshape)
+        bg = self.param("bias_g", nn.initializers.zeros, (self.features,))
+        bv = self.param("bias_v", nn.initializers.zeros, (self.features,))
+        return x @ (wg + wv) + (bg + bv)
+
+
+class _BottleneckIP(nn.Module):
+    """conv1x1 -> conv3x3(stride) -> conv1x1(expansion 4), all dual."""
+
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_ch = self.planes * self.expansion
+        y = _DualConv(self.planes, (1, 1))(x)
+        y = _DualNorm(self.planes)(y)
+        y = nn.relu(y)
+        y = _DualConv(self.planes, (3, 3), strides=(self.stride,) * 2,
+                      padding=1)(y)
+        y = _DualNorm(self.planes)(y)
+        y = nn.relu(y)
+        y = _DualConv(out_ch, (1, 1))(y)
+        y = _DualNorm(out_ch)(y)
+        if x.shape[-1] != out_ch or self.stride != 1:
+            x = _DualConv(out_ch, (1, 1), strides=(self.stride,) * 2)(x)
+            x = _DualNorm(out_ch)(x)
+        return nn.relu(y + x)
+
+
+class ResNetIP(nn.Module):
+    """ResNet_ip (resnet_ip.py:179-289). ``layers=(3,3,3)`` = resnet29_ip;
+    (6,6,6) = resnet56_ip; (12,12,12) = resnet110_ip. ``kd=True`` returns
+    ``[features, logits]`` like the reference's KD flag."""
+
+    num_classes: int = 10
+    layers: Tuple[int, int, int] = (3, 3, 3)
+    kd: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = _DualConv(16, (3, 3), padding=1)(x)
+        x = _DualNorm(16)(x)
+        x = nn.relu(x)
+        for stage, (planes, n_blocks) in enumerate(
+                zip((16, 32, 64), self.layers)):
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = _BottleneckIP(planes=planes, stride=stride)(x)
+        x = x.mean(axis=(1, 2))  # adaptive avg-pool to 1x1
+        logits = _DualDense(self.num_classes)(x)
+        if self.kd:
+            return [x, logits]
+        return logits

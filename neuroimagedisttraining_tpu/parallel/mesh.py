@@ -44,6 +44,16 @@ def make_mesh(
     return Mesh(arr.reshape(n_client_devices, n_space), ("clients", "space"))
 
 
+def fit_client_devices(n_clients: int, available: int) -> int:
+    """Largest device count <= available that divides ``n_clients`` (the
+    clients mesh axis must divide the client count). Shared by the runner
+    and bench.py so device-fitting policy lives in one place."""
+    n = min(max(1, available), max(1, n_clients))
+    while n_clients % n:
+        n -= 1
+    return n
+
+
 def shard_over_clients(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree whose leaves have a leading client axis onto the mesh,
     sharded over ``clients``."""

@@ -19,6 +19,17 @@ import jax  # noqa: E402  (import after env setup)
 # sitecustomize, so the env var above can be too late — force the config too.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is compile-bound, and xdist
+# workers / repeat runs re-trace identical programs. Harmless if the dir
+# can't be created (jax falls back silently).
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.expanduser("~"), ".cache",
+                                   "nidt_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 

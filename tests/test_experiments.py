@@ -197,6 +197,7 @@ def test_cost_tracker_accumulates():
     assert r2["sum_comm_params"] == 2 * r1["comm_params"]
 
 
+@pytest.mark.slow
 def test_cli_abcd_s2d_layout(tmp_path):
     """End-to-end CLI on a real cohort .h5 with the s2d layout: the runner
     must pick the phased-stem model twin and train a round."""
@@ -252,6 +253,7 @@ def test_dispfl_cli_variant_flags(tmp_path):
     assert args.strict_avg and args.public_portion == 0.1
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_dispfl_preserves_masks(tmp_path):
     """DisPFL state (personal params + evolving masks + rng) must survive
     checkpoint/resume — the reference's DisPFL runs are the ones that died

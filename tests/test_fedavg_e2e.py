@@ -6,6 +6,7 @@ broadcast on synthetic ABCD-like data, learning to above-chance accuracy.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from neuroimagedisttraining_tpu.algorithms import FedAvg, sample_client_indexes
 from neuroimagedisttraining_tpu.core.state import HyperParams
@@ -135,6 +136,7 @@ def test_fedavg_channel_inject_path():
     assert np.isfinite(float(ev["global_acc"]))
 
 
+@pytest.mark.slow
 def test_fedavg_learns_2d_cifar_path():
     """The 2D (CIFAR-shaped) model path must LEARN, not just run: FedAvg +
     cnn_cifar10 with CE loss on a 4-class planted-signal task beats chance

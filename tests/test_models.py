@@ -50,6 +50,7 @@ def test_alexnet3d_runs_on_smallest_valid_volume():
     assert out.shape == (1, 1)
 
 
+@pytest.mark.slow
 def test_multi_output_models_return_pairs():
     model = create_model("3dresnet", num_classes=2)
     params = init_params(model, jax.random.PRNGKey(0), (32, 32, 32, 1))
@@ -60,6 +61,7 @@ def test_multi_output_models_return_pairs():
     assert out[1].shape == (2, 512)
 
 
+@pytest.mark.slow
 def test_cifar_models_shapes():
     for name, nc in [("cnn_cifar10", 10), ("resnet18", 10), ("lenet5", 10)]:
         shape = (32, 32, 3) if name != "lenet5" else (28, 28, 1)
@@ -81,6 +83,7 @@ def test_cnn_cifar10_flatten_width():
     assert sorted(k.shape[0] for k in kernels) == [192, 384, 1600]
 
 
+@pytest.mark.slow
 def test_new_zoo_models_shapes():
     """CNN_DropOut / VGG16 / meta CNN / ImageNet GN-ResNets forward shapes."""
     cases = [
@@ -165,3 +168,76 @@ def test_resnet_gn_zero_init_residual():
         if path[-1].key == "scale" and float(np.abs(np.asarray(p)).sum()) == 0
     ]
     assert len(zero_scales) == 8  # 2 blocks x 4 stages
+
+
+@pytest.mark.slow
+def test_resnet_ip_dual_params_forward():
+    """resnet_ip (reference resnet_ip.py:179-289): forward uses w_g + w_v;
+    zeroing every personal leg must give the g-only function."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+
+    model = create_model("resnet_ip", num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    params = init_params(model, jax.random.PRNGKey(1), (32, 32, 3))
+    y = model.apply({"params": params}, x, train=False)
+    assert y.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(y)))
+    # v-legs init to zero, so perturbing v changes the function
+    perturbed = jax.tree_util.tree_map_with_path(
+        lambda path, l: l + 0.01 if "_v" in str(path[-1]) else l, params)
+    y2 = model.apply({"params": perturbed}, x, train=False)
+    assert not np.allclose(np.asarray(y), np.asarray(y2))
+    # g and v leaves exist pairwise (the federated aggregation split)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = {str(p[-1]) for p, _ in flat}
+    assert any("kernel_g" in n for n in names)
+    assert any("kernel_v" in n for n in names)
+
+
+@pytest.mark.slow
+def test_resnet_meta_hypernetwork_scales():
+    """resnet_meta (reference resnet_meta_2.py behavior): conv kernels come
+    from per-layer hypernetworks conditioned on channel scales; narrower
+    scales zero the inactive channels."""
+    import jax
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+
+    model = create_model("resnet_meta", num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    params = init_params(model, jax.random.PRNGKey(1), (32, 32, 3))
+    y_full = model.apply({"params": params}, x, train=False)
+    assert y_full.shape == (2, 10)
+    # half-width everywhere still runs and differs from full width
+    y_half = model.apply({"params": params}, x,
+                         stage_scale_ids=[1, 1, 1],
+                         mid_scale_ids=[1] * 6, train=False)
+    assert np.all(np.isfinite(np.asarray(y_half)))
+    assert not np.allclose(np.asarray(y_full), np.asarray(y_half))
+
+
+@pytest.mark.slow
+def test_original_resnet18_bn_forward():
+    """original_resnet18 (resnet.py:42-89): BatchNorm variant; train mode
+    mutates batch_stats, eval mode uses the running averages."""
+    import jax
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.models import create_model
+
+    model = create_model("original_resnet18", num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(1), x, train=False)
+    assert "batch_stats" in variables
+    y, updated = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    assert y.shape == (2, 10)
+    y_eval = model.apply({"params": variables["params"],
+                          "batch_stats": updated["batch_stats"]},
+                         x, train=False)
+    assert np.all(np.isfinite(np.asarray(y_eval)))

@@ -42,6 +42,7 @@ def test_ops_registry_shapes():
                 f"{name} stride={stride}: {y.shape}"
 
 
+@pytest.mark.slow
 def test_search_network_forward():
     net = SearchNetwork(C=4, num_classes=3, layers=4, steps=2, multiplier=2)
     alphas = init_alphas(steps=2)
@@ -80,6 +81,7 @@ def test_derive_genotype_valid():
             assert 0 <= j < 2 + i  # edge from an earlier state only
 
 
+@pytest.mark.slow
 def test_search_learns_and_derives(caplog):
     x, y = _toy_data()
     genotype, alphas, hist = search(
@@ -91,6 +93,7 @@ def test_search_learns_and_derives(caplog):
     assert isinstance(genotype, Genotype)
 
 
+@pytest.mark.slow
 def test_first_order_architect_runs():
     x, y = _toy_data(n=32)
     genotype, _, hist = search(

@@ -132,6 +132,7 @@ def test_salientgrads_defense_keeps_mask_invariant():
     assert float(mask_density(state.mask)) < 0.5
 
 
+@pytest.mark.slow
 def test_defense_cli_wiring(tmp_path):
     """--defense_type reaches the algorithm from the flag surface."""
     argv = ["--model", "small3dcnn", "--dataset", "synthetic",
