@@ -32,6 +32,7 @@ VOLUME = (121, 145, 121)  # canonical ABCD volume (stored phase-decomposed)
 BATCH = 8
 STEPS = 5
 TARGET_ROUNDS_PER_SEC = 10.0  # BASELINE.json north star (v4-32)
+MODEL_KEY = "3dcnn_s2d"  # tests override with a CI-scale model
 
 
 def _device_synth_data(n_clients, n, shape, key):
@@ -44,8 +45,9 @@ def _device_synth_data(n_clients, n, shape, key):
     # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py),
     # stored bf16 (the compute dtype — skips the per-step convert/relayout);
     # random phased tensors are distributionally the same workload
-    x = jax.random.normal(
-        kx, (n_clients, n) + phased_sample_shape(shape), jnp.bfloat16)
+    sshape = (phased_sample_shape(shape) if MODEL_KEY == "3dcnn_s2d"
+              else tuple(shape) + (1,))
+    x = jax.random.normal(kx, (n_clients, n) + sshape, jnp.bfloat16)
     y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
     # plant a mean-shift signal so losses stay in a realistic regime
     x = x + 0.75 * (y[..., None, None, None, None].astype(x.dtype) * 2 - 1)
@@ -59,6 +61,25 @@ def _device_synth_data(n_clients, n, shape, key):
     )
 
 
+def _sync_state(state):
+    """Force a host transfer: on the experimental axon platform
+    block_until_ready can return before execution completes."""
+    leaves = jax.tree_util.tree_leaves(
+        getattr(state, "global_params", state))
+    return float(leaves[0].sum())
+
+
+def _timed_rounds(algo, state, n_rounds=5):
+    """Shared timing harness: one warmup/compile round, then n timed."""
+    state, _ = algo.run_round(state, 0)
+    _sync_state(state)
+    t0 = time.perf_counter()
+    for r in range(1, n_rounds + 1):
+        state, _ = algo.run_round(state, r)
+    _sync_state(state)
+    return n_rounds / (time.perf_counter() - t0)
+
+
 def main():
     from neuroimagedisttraining_tpu.algorithms import SalientGrads
     from neuroimagedisttraining_tpu.core.state import HyperParams
@@ -67,7 +88,7 @@ def main():
     data = _device_synth_data(
         N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0)
     )
-    model = create_model("3dcnn_s2d", num_classes=1)
+    model = create_model(MODEL_KEY, num_classes=1)
     hp = HyperParams(
         lr=1e-3, lr_decay=0.998, momentum=0.9, weight_decay=5e-4,
         grad_clip=10.0, local_epochs=1, steps_per_epoch=STEPS,
@@ -116,31 +137,16 @@ def main():
                         itersnip_iterations=1, compute_dtype="bfloat16",
                         remat_local=remat, fused_kernels=fused)
     state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
-
-    def _sync(s):
-        # force a host transfer: on the experimental axon platform
-        # block_until_ready can return before execution completes
-        return float(jax.tree_util.tree_leaves(s.global_params)[0].sum())
-
-    # warmup / compile
-    state, _ = algo.run_round(state, 0)
-    _sync(state)
-
-    n_rounds = 5
-    t0 = time.perf_counter()
-    for r in range(1, n_rounds + 1):
-        state, m = algo.run_round(state, r)
-    _sync(state)
-    dt = time.perf_counter() - t0
-
-    rounds_per_sec = n_rounds / dt
+    rounds_per_sec = _timed_rounds(algo, state)
     samples_per_round = N_CLIENTS * STEPS * BATCH
     n_chips = len(jax.devices())
     # target basis: 10 rounds/sec x 32 clients / 32 chips (v4-32 north
     # star) = 10 client-rounds/sec/chip; see module docstring
     client_rounds_per_sec_per_chip = rounds_per_sec * N_CLIENTS / n_chips
-    print(json.dumps({
-        "metric": "salientgrads_rounds_per_sec_abcd_alexnet3d_8clients",
+    result = {
+        "metric": ("salientgrads_rounds_per_sec_abcd_alexnet3d_8clients"
+                   if MODEL_KEY == "3dcnn_s2d" else
+                   f"salientgrads_rounds_per_sec_abcd_{MODEL_KEY}_8clients"),
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),
@@ -157,8 +163,55 @@ def main():
             "local_steps": STEPS,
             "batch": BATCH,
         },
-    }))
+    }
+    print(json.dumps(result))
+    return result
+
+
+def tracked_config(name: str):
+    """Secondary BASELINE.json tracked configs (BENCH_CONFIG=<name>);
+    the default invocation keeps the primary one-JSON-line contract."""
+    global MODEL_KEY, VOLUME, N_CLIENTS, BATCH, STEPS
+    if name == "resnet3d":
+        # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort")
+        MODEL_KEY, VOLUME = "3dresnet", (121, 145, 121)
+        return main()
+    if name == "byzantine":
+        # Byzantine-robust 64-client FedAvg with weak-DP defense
+        from neuroimagedisttraining_tpu.algorithms import FedAvg
+        from neuroimagedisttraining_tpu.core.state import HyperParams
+        from neuroimagedisttraining_tpu.models import create_model
+        from neuroimagedisttraining_tpu.robust import RobustAggregator
+
+        MODEL_KEY = "small3dcnn"  # shallow CNN; channel-ful storage path
+        n_clients = 64
+        data = _device_synth_data(n_clients, 16, (61, 73, 61),
+                                  jax.random.PRNGKey(0))
+        model = create_model("small3dcnn", num_classes=1)
+        hp = HyperParams(lr=1e-3, momentum=0.9, local_epochs=1,
+                         steps_per_epoch=STEPS, batch_size=BATCH)
+        algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                      compute_dtype="bfloat16", client_chunk=8,
+                      defense=RobustAggregator("weak_dp", norm_bound=5.0,
+                                               stddev=0.025))
+        state = algo.init_state(jax.random.PRNGKey(0))
+        rps = _timed_rounds(algo, state)
+        result = {
+            "metric": "byzantine_robust_fedavg_rounds_per_sec_64clients",
+            "value": round(rps, 4),
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,  # no published number; tracked config
+        }
+        print(json.dumps(result))
+        return result
+    raise SystemExit(f"unknown BENCH_CONFIG {name!r}")
 
 
 if __name__ == "__main__":
-    main()
+    import os as _os
+
+    cfg = _os.environ.get("BENCH_CONFIG", "")
+    if cfg:
+        tracked_config(cfg)
+    else:
+        main()

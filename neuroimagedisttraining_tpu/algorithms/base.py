@@ -58,6 +58,7 @@ class FedAlgorithm(abc.ABC):
         compute_dtype: Optional[str] = None,
         channel_inject: bool = False,
         remat_local: bool = False,
+        eval_clients: int = 0,
     ):
         self.model = model
         self.data = data
@@ -78,6 +79,15 @@ class FedAlgorithm(abc.ABC):
         # remat_local: rematerialized local steps (core/trainer.py) — more
         # concurrent clients per chip at the cost of a second forward pass
         self.remat_local = remat_local
+        # eval_clients: sampled-eval mode (SURVEY §7's O(N^2)-eval
+        # hard-part): evaluate a fixed seeded subset of clients instead of
+        # the whole cohort; 0 = all. Reported means are over the subset.
+        self._eval_idx = None
+        if eval_clients and eval_clients < self.num_clients:
+            self._eval_idx = jnp.asarray(np.sort(
+                np.random.RandomState(seed).choice(
+                    self.num_clients, eval_clients, replace=False)
+            ).astype(np.int32))
         # shape used for parameter init: stored sample shape plus the
         # injected channel axis
         self.init_sample_shape = tuple(data.sample_shape) + (
@@ -263,9 +273,14 @@ class FedAlgorithm(abc.ABC):
 
     def _make_global_eval(self):
         eval_client = self.eval_client
+        eval_idx = self._eval_idx
 
         @jax.jit
         def eval_all(params, x_test, y_test, n_test):
+            if eval_idx is not None:  # sampled-eval subset
+                x_test = jnp.take(x_test, eval_idx, axis=0)
+                y_test = jnp.take(y_test, eval_idx, axis=0)
+                n_test = jnp.take(n_test, eval_idx)
             correct, loss_sum, total = jax.vmap(
                 lambda x, y, n: eval_client(params, x, y, n)
             )(x_test, y_test, n_test)
@@ -282,9 +297,17 @@ class FedAlgorithm(abc.ABC):
     def _make_personal_eval(self):
         """Eval stacked per-client params, each on its own client's test set."""
         eval_client = self.eval_client
+        eval_idx = self._eval_idx
 
         @jax.jit
         def eval_personal(params_stack, x_test, y_test, n_test):
+            if eval_idx is not None:  # sampled-eval subset
+                from ..core.state import tree_index
+
+                params_stack = tree_index(params_stack, eval_idx)
+                x_test = jnp.take(x_test, eval_idx, axis=0)
+                y_test = jnp.take(y_test, eval_idx, axis=0)
+                n_test = jnp.take(n_test, eval_idx)
             correct, loss_sum, total = jax.vmap(eval_client)(
                 params_stack, x_test, y_test, n_test
             )

@@ -103,6 +103,12 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "convert when paired with --compute_dtype bfloat16)")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--eval_clients", type=int, default=0,
+                   help="sampled-eval mode: evaluate only this many "
+                        "(seeded) clients per eval instead of the whole "
+                        "cohort — bounds the O(N) full-cohort / O(N^2) "
+                        "personal eval cost at large client counts "
+                        "(0 = all)")
     p.add_argument("--fused_kernels", type=int, default=0,
                    help="route the optimizer update through the Pallas "
                         "fused masked-SGD kernel (salientgrads; measured "

@@ -144,6 +144,7 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         compute_dtype=getattr(args, "compute_dtype", "") or None,
         channel_inject=(layout == "flat" and _is_abcd_h5(args.dataset)),
         remat_local=bool(getattr(args, "remat", 0)),
+        eval_clients=getattr(args, "eval_clients", 0),
     )
     defense = None
     if getattr(args, "defense_type", "none") != "none":
@@ -284,7 +285,7 @@ def maybe_shard(algo, args: argparse.Namespace):
 
 def save_stat_info(args: argparse.Namespace, identity: str,
                    history, final_eval, extras=None,
-                   cost=None) -> Optional[str]:
+                   cost=None, eval_client_ids=None) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -305,6 +306,10 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         "sum_training_flops": getattr(cost, "sum_training_flops", 0.0),
         "sum_comm_params": getattr(cost, "sum_comm_params", 0),
     }
+    if eval_client_ids is not None:
+        # sampled-eval mode: per-client eval outputs are indexed by subset
+        # position; persist the client-id mapping alongside them
+        stat_info["eval_client_ids"] = [int(i) for i in eval_client_ids]
     json_safe_keys = list(stat_info)  # extras are pickle-only: the JSON
     # sidecar would stringify (and numpy would elide) large mask arrays
     stat_info.update(extras or {})
@@ -437,12 +442,11 @@ def run_experiment(args: argparse.Namespace,
                 ckpt_mgr.save(r + 1, state)
 
         fin_rec = None
-        # skip the end-of-training pass when a resumed run had nothing
-        # left to do — the checkpointed state was already finalized once;
-        # re-running would double-fine-tune the personal models
-        ran_rounds = max(0, args.comm_round - start_round)
-        if getattr(args, "final_finetune", 1) and \
-                (ran_rounds > 0 or start_round == 0):
+        # checkpoints are saved inside the round loop (pre-finalize), so a
+        # resumed run — even one with no rounds left — re-runs finalize
+        # from the same pre-finalize state and reproduces the original
+        # metrics; no double fine-tune is possible
+        if getattr(args, "final_finetune", 1):
             state, fin_rec = algo.finalize(state)
         if fin_rec is not None:
             # the reference's final fine-tune record (round -1)
@@ -472,8 +476,10 @@ def run_experiment(args: argparse.Namespace,
             # dispfl_api.py:170-175: pairwise mask hamming matrix
             extras["mask_distance_matrix"] = np.asarray(
                 algo.mask_distance_matrix(state))
-        stat_path = save_stat_info(args, identity, history, final_eval,
-                                   extras, cost=cost)
+        stat_path = save_stat_info(
+            args, identity, history, final_eval, extras, cost=cost,
+            eval_client_ids=(np.asarray(algo._eval_idx)
+                             if algo._eval_idx is not None else None))
         return {
             "identity": identity,
             "history": history,

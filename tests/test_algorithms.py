@@ -228,3 +228,35 @@ def test_dispfl_mask_init_variants():
     for algo, st_ in ((uni, st3), (spa, st4)):
         st_, m = algo.run_round(st_, 0)
         assert np.isfinite(float(m["train_loss"]))
+
+
+def test_sampled_eval_mode():
+    """--eval_clients K (SURVEY §7 O(N^2)-eval hard-part): evaluation runs
+    on a fixed seeded subset; the reported mean equals the mean of that
+    subset's per-client accuracies from the full eval."""
+    import jax
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = make_synthetic_federated(
+        n_clients=6, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    full = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0)
+    sub = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                 eval_clients=3)
+    state = full.init_state(jax.random.PRNGKey(0))
+    ev_full = full.evaluate(state)
+    ev_sub = sub.evaluate(state)
+    idx = np.asarray(sub._eval_idx)
+    assert idx.shape == (3,)
+    expected = float(np.mean(np.asarray(ev_full["acc_per_client"])[idx]))
+    assert abs(float(ev_sub["global_acc"]) - expected) < 1e-6
+    # personal eval path honors the subset too
+    assert np.isfinite(float(ev_sub["personal_acc"]))

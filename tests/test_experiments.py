@@ -316,3 +316,34 @@ def test_cost_tracker_sparse_vs_dense_ratio(tmp_path):
     # comm ratio tracks overall nonzero density: strictly below dense,
     # above the kernel-only dense_ratio since biases/norm params stay dense
     assert 0.2 < cs / cd < 0.9
+
+
+def test_bench_multichip_path_on_virtual_mesh():
+    """bench.py's multi-device branch (VERDICT r1 item 9: same script, 1..N
+    chips): on the 8-virtual-device CPU mesh it must shard the client axis
+    over all 8 devices, run the full client vmap, and emit the metric."""
+    import importlib
+    import sys
+
+    import jax
+
+    sys.path.insert(0, ".")
+    import bench as bench_mod
+
+    bench_mod = importlib.reload(bench_mod)
+    old = (bench_mod.MODEL_KEY, bench_mod.VOLUME, bench_mod.BATCH,
+           bench_mod.STEPS, bench_mod.SAMPLES_PER_CLIENT)
+    try:
+        bench_mod.MODEL_KEY = "small3dcnn"
+        bench_mod.VOLUME = (8, 8, 8)
+        bench_mod.BATCH = 4
+        bench_mod.STEPS = 2
+        bench_mod.SAMPLES_PER_CLIENT = 8
+        result = bench_mod.main()
+    finally:
+        (bench_mod.MODEL_KEY, bench_mod.VOLUME, bench_mod.BATCH,
+         bench_mod.STEPS, bench_mod.SAMPLES_PER_CLIENT) = old
+    assert result["value"] > 0
+    assert result["extra"]["n_devices"] == len(jax.devices())
+    assert result["extra"]["client_mesh_devices"] == min(
+        8, len(jax.devices()))
