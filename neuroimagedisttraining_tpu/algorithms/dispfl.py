@@ -69,6 +69,7 @@ class DisPFL(FedAlgorithm):
                  total_rounds: int = 100, erk_power_scale: float = 1.0,
                  sparsity_distribution: str = "erk",
                  different_initial: bool = False, diff_spa: bool = False,
+                 dis_gradient_check: bool = False,
                  **kwargs):
         """Mask-init variants (``dispfl_api.py:48-71``):
         ``sparsity_distribution``: "erk" (default) or "uniform"
@@ -92,6 +93,10 @@ class DisPFL(FedAlgorithm):
         self.sparsity_distribution = sparsity_distribution
         self.different_initial = different_initial or diff_spa
         self.diff_spa = diff_spa
+        # --dis_gradient_check: regrow uniformly at random among dead
+        # weights instead of by |grad| (and skip the screening batch) —
+        # DisPFL/client.py:54,91-98
+        self.dis_gradient_check = dis_gradient_check
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -158,9 +163,23 @@ class DisPFL(FedAlgorithm):
             else:
                 c = x_train.shape[0]
                 keys = jax.random.split(k_screen, c)
-                grads = self._vmap_clients(
-                    screen_gradients, in_axes=(0, 0, 0, 0, 0)
-                )(trained, x_train, y_train, n_train, keys)
+                if self.dis_gradient_check:
+                    # random regrow: uniform scores stand in for |grad| —
+                    # top-n random dead == multinomial without replacement
+                    # (DisPFL/client.py:96-98); no screening batch runs
+                    def rand_tree(p, key):
+                        leaves, treedef = jax.tree_util.tree_flatten(p)
+                        ks = jax.random.split(key, len(leaves))
+                        return jax.tree_util.tree_unflatten(
+                            treedef,
+                            [jax.random.uniform(k2, l.shape)
+                             for l, k2 in zip(leaves, ks)])
+
+                    grads = jax.vmap(rand_tree)(trained, keys)
+                else:
+                    grads = self._vmap_clients(
+                        screen_gradients, in_axes=(0, 0, 0, 0, 0)
+                    )(trained, x_train, y_train, n_train, keys)
                 rate = cosine_annealing(
                     self.anneal_factor, round_idx, self.total_rounds
                 )

@@ -42,12 +42,19 @@ class SalientGrads(FedAlgorithm):
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
-                 fused_kernels: bool = False, **kwargs):
+                 fused_kernels: bool = False, snip_mask: bool = True,
+                 stratified_sampling: bool = False, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
         self.defense = defense
         self.fused_kernels = fused_kernels
+        # --snip_mask 0: all-ones mask, the reference's dense-control mode
+        # (sailentgrads_api.py:91-103)
+        self.snip_mask = snip_mask
+        # --stratified_sampling: class-balanced scoring batches over 25
+        # iterations (client.py:32-42; see ops/sparsity docstring)
+        self.stratified_sampling = stratified_sampling
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -58,7 +65,9 @@ class SalientGrads(FedAlgorithm):
             fused_kernels=self.fused_kernels,
         )
         self.snip_scores = make_snip_score_fn(
-            self.apply_fn, self.loss_type, self.hp.batch_size
+            self.apply_fn, self.loss_type, self.hp.batch_size,
+            stratified=self.stratified_sampling,
+            num_classes=self.data.class_num,
         )
 
         def global_mask_fn(params, x_train, y_train, n_train, rng):
@@ -66,9 +75,13 @@ class SalientGrads(FedAlgorithm):
             c = x_train.shape[0]
             keys = jax.random.split(rng, c)
             params_b = broadcast_tree(params, c)
+            # stratified mode scores over 25 balanced batches (the
+            # reference's StratifiedKFold(n_splits=25), client.py:36)
+            n_iters = 25 if self.stratified_sampling \
+                else self.itersnip_iterations
             scores = self._vmap_clients(
                 lambda p, x, y, n, k: self.snip_scores(
-                    p, x, y, n, k, self.itersnip_iterations
+                    p, x, y, n, k, n_iters
                 ),
                 in_axes=(0, 0, 0, 0, 0),
             )(params_b, x_train, y_train, n_train, keys)
@@ -105,10 +118,15 @@ class SalientGrads(FedAlgorithm):
     def init_state(self, rng: jax.Array) -> SalientGradsState:
         p_rng, m_rng, s_rng = jax.random.split(rng, 3)
         params = init_params(self.model, p_rng, self.init_sample_shape)
-        mask = self._global_mask_jit(
-            params, self.data.x_train, self.data.y_train, self.data.n_train,
-            m_rng,
-        )
+        if not self.snip_mask:
+            # --snip_mask 0: dense-control mode, all-ones mask
+            # (sailentgrads/client.py:95-103)
+            mask = jax.tree_util.tree_map(jnp.ones_like, params)
+        else:
+            mask = self._global_mask_jit(
+                params, self.data.x_train, self.data.y_train,
+                self.data.n_train, m_rng,
+            )
         return SalientGradsState(global_params=params, mask=mask, rng=s_rng)
 
     def run_round(self, state: SalientGradsState, round_idx: int):

@@ -164,6 +164,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         extra = dict(dense_ratio=args.dense_ratio,
                      itersnip_iterations=args.itersnip_iteration,
                      defense=defense,
+                     snip_mask=bool(getattr(args, "snip_mask", 1)),
+                     stratified_sampling=bool(
+                         getattr(args, "stratified_sampling", 0)),
                      fused_kernels=bool(getattr(args, "fused_kernels", 0)))
     elif algo_name == "fedavg":
         extra = dict(defense=defense)
@@ -179,7 +182,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                          else "erk"),
                      different_initial=getattr(args, "different_initial",
                                                False),
-                     diff_spa=getattr(args, "diff_spa", False))
+                     diff_spa=getattr(args, "diff_spa", False),
+                     dis_gradient_check=getattr(args, "dis_gradient_check",
+                                                False))
     elif algo_name == "dpsgd":
         extra = dict(neighbor_mode=args.cs)
     elif algo_name == "subavg":
@@ -285,7 +290,8 @@ def maybe_shard(algo, args: argparse.Namespace):
 
 def save_stat_info(args: argparse.Namespace, identity: str,
                    history, final_eval, extras=None,
-                   cost=None, eval_client_ids=None) -> Optional[str]:
+                   cost=None, eval_client_ids=None,
+                   avg_inference_flops: float = 0.0) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -305,6 +311,7 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         # stat_info cost counters (sailentgrads_api.py:334-346)
         "sum_training_flops": getattr(cost, "sum_training_flops", 0.0),
         "sum_comm_params": getattr(cost, "sum_comm_params", 0),
+        "avg_inference_flops": avg_inference_flops,
     }
     if eval_client_ids is not None:
         # sampled-eval mode: per-client eval outputs are indexed by subset
@@ -476,10 +483,23 @@ def run_experiment(args: argparse.Namespace,
             # dispfl_api.py:170-175: pairwise mask hamming matrix
             extras["mask_distance_matrix"] = np.asarray(
                 algo.mask_distance_matrix(state))
+        # avg per-sample inference FLOPs of the final (masked) model(s) —
+        # record_avg_inference_flops (sailentgrads_api.py:319-332);
+        # per-client-mask algorithms average over the cohort
+        from ..utils.flops import avg_inference_flops
+
+        try:
+            avg_inf = avg_inference_flops(
+                algo.model, state, algo.init_sample_shape,
+                algo.num_clients, algo.cost_snapshot)
+        except Exception:  # cost model unavailable on exotic models
+            avg_inf = 0.0
+            logger.debug("inference-FLOPs counting skipped", exc_info=True)
         stat_path = save_stat_info(
             args, identity, history, final_eval, extras, cost=cost,
             eval_client_ids=(np.asarray(algo._eval_idx)
-                             if algo._eval_idx is not None else None))
+                             if algo._eval_idx is not None else None),
+            avg_inference_flops=avg_inf)
         return {
             "identity": identity,
             "history": history,

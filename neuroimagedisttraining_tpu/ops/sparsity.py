@@ -72,7 +72,8 @@ def mask_density(mask: Any) -> jax.Array:
 # SNIP
 # ---------------------------------------------------------------------------
 
-def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int):
+def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int,
+                       stratified: bool = False, num_classes: int = 2):
     """Build the per-client SNIP scoring function.
 
     ``snip_scores(params, x, y, n_valid, rng, n_iters)`` samples
@@ -80,6 +81,13 @@ def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int):
     ``sailentgrads/client.py:29-50``), computes |dL/dmask| per batch and
     returns the mean score pytree (zeros on non-kernel leaves).
     vmap over a leading client axis for the all-clients scoring pass.
+
+    ``stratified``: class-balanced batch draws — the reference's
+    ``--stratified_sampling`` runs the scoring over 25 label-stratified
+    folds (``client.py:32-42``); under jit the static-shape equivalent is
+    sampling each scoring batch with per-example probability
+    ∝ 1/count(class) so every class contributes equally to the saliency
+    mean (documented deviation: balanced draws instead of exact folds).
     """
     loss_fn = make_loss_fn(loss_type)
 
@@ -100,11 +108,25 @@ def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int):
         )
 
     def snip_scores(params, x, y, n_valid, rng, n_iters: int):
+        if stratified:
+            # class-balanced draw probabilities: loop-invariant, computed
+            # once per client (not inside the scoring scan)
+            valid = jnp.arange(y.shape[0]) < n_valid
+            yc = jnp.clip(y.astype(jnp.int32), 0, num_classes - 1)
+            counts = jnp.zeros((num_classes,)).at[yc].add(
+                valid.astype(jnp.float32))
+            p = valid / jnp.maximum(counts[yc], 1.0)
+            p = p / jnp.maximum(p.sum(), 1e-9)
+
         def body(carry, key):
             k_idx, k_drop = jax.random.split(key)
-            idx = jax.random.randint(
-                k_idx, (batch_size,), 0, jnp.maximum(n_valid, 1)
-            )
+            if stratified:
+                idx = jax.random.choice(
+                    k_idx, y.shape[0], (batch_size,), replace=True, p=p)
+            else:
+                idx = jax.random.randint(
+                    k_idx, (batch_size,), 0, jnp.maximum(n_valid, 1)
+                )
             s = batch_scores(
                 params, jnp.take(x, idx, axis=0), jnp.take(y, idx, axis=0),
                 k_drop,

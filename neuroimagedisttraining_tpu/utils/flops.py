@@ -142,6 +142,42 @@ def training_flops(model, params, sample_shape, mask=None,
         model, params, sample_shape, mask)
 
 
+def avg_inference_flops(model, state, sample_shape, num_clients: int,
+                        cost_snapshot_fn) -> float:
+    """Cohort-mean per-sample inference FLOPs of the final model(s) —
+    ``record_avg_inference_flops`` (sailentgrads_api.py:319-332).
+
+    Global-mask algorithms: one count stands for the cohort. Per-client
+    masks (DisPFL/SubAvg, incl. --diff_spa's mixed densities): average the
+    mask-aware count over every client's slice, with the dense per-layer
+    FLOPs computed once."""
+    import jax
+
+    masks = getattr(state, "masks", None)
+    params = getattr(state, "global_params", None)
+    stacked = getattr(state, "personal_params", None)
+    if masks is None:
+        p, m = cost_snapshot_fn(state)
+        if p is None:
+            return 0.0
+        return inference_flops(model, p, sample_shape, mask=m)
+    # per-client masks: average over the cohort. Params are either the
+    # stacked personal models (DisPFL) or one global model (SubAvg).
+    def slice_c(tree, c):
+        return jax.tree_util.tree_map(lambda l: l[c], tree)
+
+    def params_of(c):
+        return slice_c(stacked, c) if stacked is not None else params
+
+    dense = per_layer_flops(model, params_of(0), sample_shape)
+    total = 0.0
+    for c in range(num_clients):
+        fracs = nonzero_fraction(params_of(c), slice_c(masks, c))
+        total += float(sum(f * fracs.get(path, 1.0)
+                           for path, f in dense.items()))
+    return total / max(1, num_clients)
+
+
 # -- communication accounting -------------------------------------------------
 
 def count_params(params) -> int:

@@ -165,3 +165,95 @@ def test_fire_regrow_jittable_with_traced_rate():
     m1 = evolve(mask, params, grads, jnp.float32(1))
     m2 = evolve(mask, params, grads, jnp.float32(50))
     assert jax.tree_util.tree_structure(m1) == jax.tree_util.tree_structure(m2)
+
+
+def test_snip_mask_off_gives_dense_mask():
+    """--snip_mask 0: the reference's dense-control mode replaces the SNIP
+    mask with all-ones (sailentgrads/client.py:95-103)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.algorithms import SalientGrads
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = make_synthetic_federated(
+        n_clients=2, samples_per_client=16, test_per_client=4,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                        dense_ratio=0.3, snip_mask=False)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    for m in jax.tree_util.tree_leaves(state.mask):
+        assert np.all(np.asarray(m) == 1)
+
+
+def test_stratified_snip_balances_classes():
+    """--stratified_sampling: scoring batches are drawn class-balanced
+    (client.py:32-42 semantics under static shapes) — on a shard with a
+    99:1 label imbalance the minority class still contributes to scores;
+    the mask differs from the unstratified draw."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+    from neuroimagedisttraining_tpu.ops.sparsity import make_snip_score_fn
+    from neuroimagedisttraining_tpu.models import make_apply_fn
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    apply_fn = make_apply_fn(model)
+    n = 64
+    x = jax.random.normal(jax.random.PRNGKey(1), (n, 8, 8, 8, 1))
+    y = jnp.zeros((n,), jnp.int32).at[0].set(1)  # one minority example
+    plain = make_snip_score_fn(apply_fn, "bce", batch_size=16)
+    strat = make_snip_score_fn(apply_fn, "bce", batch_size=16,
+                               stratified=True, num_classes=2)
+    s0 = plain(params, x, y, jnp.asarray(n), jax.random.PRNGKey(2), 4)
+    s1 = strat(params, x, y, jnp.asarray(n), jax.random.PRNGKey(2), 4)
+    l0 = np.concatenate([np.asarray(v).ravel()
+                         for v in jax.tree_util.tree_leaves(s0)])
+    l1 = np.concatenate([np.asarray(v).ravel()
+                         for v in jax.tree_util.tree_leaves(s1)])
+    assert np.all(np.isfinite(l1))
+    assert not np.allclose(l0, l1)  # balanced draws change the scores
+
+
+def test_dispfl_random_regrow_mode():
+    """--dis_gradient_check: regrow is uniform-random among dead weights
+    (DisPFL/client.py:91-98); live counts are still preserved and the
+    algorithm still trains."""
+    import jax
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.algorithms import DisPFL
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.ops.sparsity import live_counts
+
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=16, test_per_client=4,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = DisPFL(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                  dense_ratio=0.5, total_rounds=4, dis_gradient_check=True)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    before = jax.tree_util.tree_map(
+        lambda c: np.asarray(c),
+        jax.vmap(live_counts)(state.masks))
+    state, rec = algo.run_round(state, 0)
+    after = jax.tree_util.tree_map(
+        lambda c: np.asarray(c),
+        jax.vmap(live_counts)(state.masks))
+    for b, a in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(b, a)
+    assert np.isfinite(rec["train_loss"])
