@@ -69,6 +69,16 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--ci", type=int, default=0,
                    help="smoke mode: tiny eval to catch programming errors "
                         "(sailentgrads_api.py:260-265 semantics)")
+    # accepted for reference sweep-script compatibility; inert here
+    # (--gpu is CUDA device selection; --type step is dead code in the
+    # reference too — dpsgd's step_train is commented out,
+    # dpsgd/my_model_trainer.py:67-82)
+    p.add_argument("--gpu", type=int, default=0,
+                   help="inert (reference CUDA device id; TPU runs use "
+                        "the attached mesh)")
+    p.add_argument("--type", type=str, default="epoch",
+                   help="inert (reference epoch|step local-loop switch; "
+                        "'step' is dead code in the reference)")
     p.add_argument("--final_finetune", type=int, default=1,
                    help="run the algorithm's end-of-training pass (FedAvg's "
                         "final per-client fine-tune, fedavg_api.py:79-88); "

@@ -485,16 +485,20 @@ def run_experiment(args: argparse.Namespace,
                 algo.mask_distance_matrix(state))
         # avg per-sample inference FLOPs of the final (masked) model(s) —
         # record_avg_inference_flops (sailentgrads_api.py:319-332);
-        # per-client-mask algorithms average over the cohort
-        from ..utils.flops import avg_inference_flops
+        # per-client-mask algorithms average over the cohort. Only computed
+        # when a stat_info artifact will actually be written (it can pull
+        # every client's params to host).
+        avg_inf = 0.0
+        if args.results_dir:
+            from ..utils.flops import avg_inference_flops
 
-        try:
-            avg_inf = avg_inference_flops(
-                algo.model, state, algo.init_sample_shape,
-                algo.num_clients, algo.cost_snapshot)
-        except Exception:  # cost model unavailable on exotic models
-            avg_inf = 0.0
-            logger.debug("inference-FLOPs counting skipped", exc_info=True)
+            try:
+                avg_inf = avg_inference_flops(
+                    algo.model, state, algo.init_sample_shape,
+                    algo.num_clients, algo.cost_snapshot)
+            except Exception:  # cost model unavailable on exotic models
+                logger.debug("inference-FLOPs counting skipped",
+                             exc_info=True)
         stat_path = save_stat_info(
             args, identity, history, final_eval, extras, cost=cost,
             eval_client_ids=(np.asarray(algo._eval_idx)

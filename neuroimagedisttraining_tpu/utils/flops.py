@@ -128,12 +128,19 @@ def nonzero_fraction(params, mask=None) -> Dict[Tuple[str, ...], float]:
     return fracs
 
 
+def _scaled_flops(dense: Dict[Tuple[str, ...], float],
+                  fracs: Dict[Tuple[str, ...], float]) -> float:
+    """Sparsity-scaled total of a per-layer dense-FLOPs dict (the single
+    place the scaling convention lives — layers without a recorded
+    fraction count dense)."""
+    return float(sum(f * fracs.get(p, 1.0) for p, f in dense.items()))
+
+
 def inference_flops(model, params, sample_shape: Tuple[int, ...],
                     mask=None) -> float:
     """Per-sample analytical inference FLOPs, honoring weight sparsity."""
     dense = per_layer_flops(model, params, sample_shape)
-    fracs = nonzero_fraction(params, mask)
-    return float(sum(f * fracs.get(p, 1.0) for p, f in dense.items()))
+    return _scaled_flops(dense, nonzero_fraction(params, mask))
 
 
 def training_flops(model, params, sample_shape, mask=None,
@@ -172,9 +179,8 @@ def avg_inference_flops(model, state, sample_shape, num_clients: int,
     dense = per_layer_flops(model, params_of(0), sample_shape)
     total = 0.0
     for c in range(num_clients):
-        fracs = nonzero_fraction(params_of(c), slice_c(masks, c))
-        total += float(sum(f * fracs.get(path, 1.0)
-                           for path, f in dense.items()))
+        total += _scaled_flops(
+            dense, nonzero_fraction(params_of(c), slice_c(masks, c)))
     return total / max(1, num_clients)
 
 
@@ -224,11 +230,9 @@ class CostTracker:
         flops = 0.0
         if self.model is not None and self.sample_shape is not None:
             dense = self._dense_per_layer(params)
-            fracs = nonzero_fraction(params, mask)
-            per_sample = sum(
-                f * fracs.get(p, 1.0) for p, f in dense.items())
+            per_sample = _scaled_flops(dense, nonzero_fraction(params, mask))
             flops = (n_clients * TRAIN_TO_INFER_RATIO * samples_per_client
-                     * float(per_sample))
+                     * per_sample)
         comm = n_clients * count_communication_params(params, mask)
         self.sum_training_flops += flops
         self.sum_comm_params += comm

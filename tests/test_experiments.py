@@ -362,9 +362,29 @@ def test_avg_inference_flops_per_client_masks(tmp_path):
     out = run_experiment(args, "dispfl")
     with open(out["stat_path"], "rb") as f:
         stat = pkl.load(f)
-    assert stat["avg_inference_flops"] > 0
-    # cohort mean across densities [0.2..0.8 for 4 clients] exceeds the
-    # lone 0.2-density client's count
-    from neuroimagedisttraining_tpu.utils.flops import inference_flops
-    # sanity only: value present and finite
-    assert np.isfinite(stat["avg_inference_flops"])
+    avg = stat["avg_inference_flops"]
+    assert avg > 0 and np.isfinite(avg)
+    # the cohort mean must differ from any single client's count: diff_spa
+    # cycles densities, so client 0 (lowest) and the last client (highest)
+    # bracket the mean strictly
+    import jax
+
+    from neuroimagedisttraining_tpu.utils.flops import (
+        inference_flops,
+    )
+
+    state = out["state"]
+
+    from neuroimagedisttraining_tpu.models import create_model
+
+    model = create_model("small3dcnn", num_classes=1)
+
+    def client_count(c):
+        params = jax.tree_util.tree_map(lambda l: l[c],
+                                        state.personal_params)
+        mask = jax.tree_util.tree_map(lambda l: l[c], state.masks)
+        return inference_flops(model, params, (8, 8, 8, 1), mask=mask)
+
+    lo = client_count(0)
+    hi = client_count(3)
+    assert lo < avg < hi, (lo, avg, hi)

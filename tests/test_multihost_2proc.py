@@ -108,3 +108,67 @@ def test_two_process_multihost_fedavg(tmp_path):
     l0 = outs[0].split("loss=")[1].split()[0]
     l1 = outs[1].split("loss=")[1].split()[0]
     assert l0 == l1, (l0, l1)
+
+
+def _derive_space_worker():
+    subs = [
+        ("mesh = make_multihost_mesh(num_clients=N)",
+         "mesh = make_multihost_mesh(n_space=2, num_clients=N)"),
+        ("N = 8", "N = 4"),
+        ("assert len(idx) == 4, idx  # each process owns half the clients",
+         "assert len(idx) == 2, idx  # 4 clients over 2 procs, 2 space cols\n"
+         "assert dict(mesh.shape) == {'clients': 4, 'space': 2}, mesh.shape"),
+    ]
+    out = _WORKER
+    for old, new in subs:
+        assert old in out, f"_WORKER drifted; substitution lost: {old!r}"
+        out = out.replace(old, new)
+    return out
+
+
+_WORKER_SPACE = _derive_space_worker()
+
+
+@pytest.mark.slow
+def test_two_process_multihost_hybrid_space_mesh(tmp_path):
+    """Multihost + --mesh_space: the (clients, space) mesh spans both
+    processes, volume depth is sharded over the space axis
+    (shard_federated_data_global hybrid spec), and a real FedAvg round
+    agrees bit-for-bit on both controllers."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker_space.py"
+    script.write_text(_WORKER_SPACE)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd=repo_root, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"RANK{pid} OK" in out, out
+    l0 = outs[0].split("loss=")[1].split()[0]
+    l1 = outs[1].split("loss=")[1].split()[0]
+    assert l0 == l1, (l0, l1)
