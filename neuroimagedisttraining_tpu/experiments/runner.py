@@ -100,6 +100,13 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             "--model 3dcnn_s2d consumes phase-decomposed input; pair it "
             f"with --layout s2d (got --layout {layout})")
 
+    if getattr(args, "client_optimizer", "sgd") != "sgd":
+        # the reference's trainers implement only SGD (any other value
+        # crashes there with an undefined optimizer, my_model_trainer.py:45)
+        raise SystemExit(
+            f"--client_optimizer {args.client_optimizer!r}: only 'sgd' is "
+            "implemented (reference parity; the reference crashes on "
+            "anything else too)")
     if data is None:
         data = build_data(args)
     n_space = max(1, getattr(args, "mesh_space", 1))

@@ -388,3 +388,13 @@ def test_avg_inference_flops_per_client_masks(tmp_path):
     lo = client_count(0)
     hi = client_count(3)
     assert lo < avg < hi, (lo, avg, hi)
+
+
+def test_non_sgd_optimizer_rejected(tmp_path):
+    """--client_optimizer adam: the reference implements only SGD (anything
+    else crashes there with an undefined optimizer); fail with a message
+    instead of silently training with SGD."""
+    args = parse_args(_argv(tmp_path) + ["--client_optimizer", "adam"],
+                      algo="fedavg")
+    with pytest.raises(SystemExit):
+        run_experiment(args, "fedavg")
