@@ -69,7 +69,7 @@ def _sync_state(state):
     return float(leaves[0].sum())
 
 
-def _timed_rounds(algo, state, n_rounds=5):
+def _timed_rounds(algo, state, n_rounds=10):
     """Shared timing harness: one warmup/compile round, then n timed."""
     state, _ = algo.run_round(state, 0)
     _sync_state(state)
