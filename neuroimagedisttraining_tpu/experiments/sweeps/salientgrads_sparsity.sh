@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Sweep translation example: the reference's SLURM sparsity sweeps
+# (fedml_experiments/standalone/sailentgrads/Jobs/
+#  salientgradssparsitywith100iteration70sps.sh:40-53 and siblings —
+# dense_ratio x itersnip grids, one 3-day V100 job each) become a plain
+# loop over the flag-compatible CLI; each run gets its own identity-keyed
+# log, stat_info and checkpoint lineage automatically.
+#
+# Usage: bash salientgrads_sparsity.sh <cohort.h5> [comm_rounds]
+set -euo pipefail
+COHORT="${1:?usage: salientgrads_sparsity.sh <cohort.h5> [comm_rounds]}"
+ROUNDS="${2:-200}"
+
+for DENSE in 0.05 0.1 0.2 0.3 0.5; do      # Jobs/ sweep space (BASELINE.md)
+  for ITERSNIP in 1 20 50 100; do
+    python -m neuroimagedisttraining_tpu.experiments.main_sailentgrads \
+      --dataset abcd_rescale --data_dir "$COHORT" \
+      --model 3dcnn --layout s2d --compute_dtype bfloat16 \
+      --client_num_in_total 32 --frac 0.5 \
+      --batch_size 16 --epochs 2 --lr 1e-3 --lr_decay 0.998 \
+      --comm_round "$ROUNDS" \
+      --dense_ratio "$DENSE" --itersnip_iteration "$ITERSNIP" \
+      --checkpoint_dir ckpts --resume \
+      --frequency_of_the_test 5
+  done
+done
