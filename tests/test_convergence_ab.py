@@ -346,3 +346,147 @@ def test_salientgrads_convergence_matches_torch_reference():
     assert j_back > chance + 0.3, jax_accs
     assert abs(j_back - t_back) < 0.08, (t_back, j_back,
                                          torch_accs, jax_accs)
+
+
+# ---- 3D/BCE flagship-path A/B ---------------------------------------------
+
+class Torch3DCNN(torch.nn.Module):
+    """Torch twin of models/alexnet3d.py SmallCNN3D: conv3(k3,s2,p1) + GN +
+    relu + conv3(k3,s1,p1) + relu + global-avg-pool + dense — the CI-scale
+    stand-in for the AlexNet3D idiom, trained with BCE-with-logits like the
+    reference's ABCD path (my_model_trainer.py:191-206)."""
+
+    def __init__(self, width=8):
+        super().__init__()
+        self.c1 = torch.nn.Conv3d(1, width, 3, stride=2, padding=1)
+        # group_norm(width) picks min(32, width) groups dividing width
+        self.gn = torch.nn.GroupNorm(min(32, width), width)
+        self.c2 = torch.nn.Conv3d(width, width * 2, 3, stride=1, padding=1)
+        self.fc = torch.nn.Linear(width * 2, 1)
+
+    def forward(self, x):  # x: NCDHW
+        x = torch.relu(self.gn(self.c1(x)))
+        x = torch.relu(self.c2(x))
+        x = x.mean(dim=(2, 3, 4))
+        return self.fc(x)[:, 0]
+
+
+def _jax3d_to_torch(params, net):
+    sd = net.state_dict()
+
+    def k3(x):  # DHWIO -> OIDHW
+        return torch.from_numpy(
+            np.asarray(x).transpose(4, 3, 0, 1, 2).copy())
+
+    sd["c1.weight"] = k3(params["Conv3d_0"]["Conv_0"]["kernel"])
+    sd["c1.bias"] = torch.from_numpy(
+        np.asarray(params["Conv3d_0"]["Conv_0"]["bias"]))
+    sd["gn.weight"] = torch.from_numpy(
+        np.asarray(params["GroupNorm_0"]["scale"]))
+    sd["gn.bias"] = torch.from_numpy(
+        np.asarray(params["GroupNorm_0"]["bias"]))
+    sd["c2.weight"] = k3(params["Conv3d_1"]["Conv_0"]["kernel"])
+    sd["c2.bias"] = torch.from_numpy(
+        np.asarray(params["Conv3d_1"]["Conv_0"]["bias"]))
+    sd["fc.weight"] = torch.from_numpy(
+        np.asarray(params["Dense_0"]["kernel"]).T.copy())
+    sd["fc.bias"] = torch.from_numpy(
+        np.asarray(params["Dense_0"]["bias"]))
+    net.load_state_dict(sd)
+
+
+@pytest.mark.slow
+def test_fedavg_3d_bce_convergence_matches_torch_reference():
+    """Flagship-path A/B (3D conv + GroupNorm + BCE-with-logits): FedAvg on
+    volumetric data against the torch twin, same init/data/sampling."""
+    n_clients, samples, test_n, rounds = 4, 48, 24, 16
+    data_shape = (10, 10, 10, 1)
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+
+    data = make_synthetic_federated(
+        n_clients=n_clients, samples_per_client=samples,
+        test_per_client=test_n, sample_shape=data_shape,
+        loss_type="bce", class_num=2, seed=3)
+    xs_tr = [np.asarray(data.x_train[c])[: int(data.n_train[c])]
+             for c in range(n_clients)]
+    ys_tr = [np.asarray(data.y_train[c])[: int(data.n_train[c])]
+             for c in range(n_clients)]
+    x_te = np.concatenate([np.asarray(data.x_test[c])[: int(data.n_test[c])]
+                           for c in range(n_clients)])
+    y_te = np.concatenate([np.asarray(data.y_test[c])[: int(data.n_test[c])]
+                           for c in range(n_clients)])
+
+    model = create_model("small3dcnn", num_classes=1)
+    lr0 = 0.1
+    hp = HyperParams(lr=lr0, lr_decay=DECAY, momentum=MOMENTUM,
+                     weight_decay=0.0, grad_clip=10.0, local_epochs=1,
+                     steps_per_epoch=samples // BS, batch_size=BS)
+    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0)
+    state = algo.init_state(jax.random.PRNGKey(0))
+
+    net = Torch3DCNN()
+    _jax3d_to_torch(
+        jax.tree_util.tree_map(np.asarray, state.global_params), net)
+    # forward parity check before training (same weights, same input)
+    xb = torch.from_numpy(
+        x_te[:4].transpose(0, 4, 1, 2, 3).copy())
+    ref_logits = net(xb).detach().numpy()
+    from neuroimagedisttraining_tpu.models import make_apply_fn
+    jx_logits = np.asarray(make_apply_fn(model)(
+        state.global_params, jnp.asarray(x_te[:4]), train=False,
+        rng=None))[:, 0]
+    np.testing.assert_allclose(ref_logits, jx_logits, rtol=2e-4, atol=2e-4)
+
+    w_global = {k: v.clone() for k, v in net.state_dict().items()}
+    xt = [torch.from_numpy(x.transpose(0, 4, 1, 2, 3).copy())
+          for x in xs_tr]
+    yt = [torch.from_numpy(y.astype(np.float32)) for y in ys_tr]
+    x_tet = torch.from_numpy(x_te.transpose(0, 4, 1, 2, 3).copy())
+    y_tet = torch.from_numpy(y_te.astype(np.float32))
+    loss_fn = torch.nn.BCEWithLogitsLoss()
+    g = torch.Generator().manual_seed(0)
+    torch_accs = []
+    for r in range(rounds):
+        locals_, weights = [], []
+        lr = lr0 * (DECAY ** r)
+        for c in range(n_clients):
+            net.load_state_dict(w_global)
+            opt = torch.optim.SGD(net.parameters(), lr=lr,
+                                  momentum=MOMENTUM)
+            n = len(yt[c])
+            perm = torch.randperm(n, generator=g)
+            for s in range(0, n - BS + 1, BS):
+                idx = perm[s:s + BS]
+                opt.zero_grad()
+                loss = loss_fn(net(xt[c][idx]), yt[c][idx])
+                loss.backward()
+                torch.nn.utils.clip_grad_norm_(net.parameters(), 10.0)
+                opt.step()
+            locals_.append({k: v.clone() for k, v in
+                            net.state_dict().items()})
+            weights.append(n)
+        total = sum(weights)
+        w_global = {k: sum(w / total * loc[k] for w, loc in
+                           zip(weights, locals_)) for k in w_global}
+        net.load_state_dict(w_global)
+        with torch.no_grad():
+            torch_accs.append(((net(x_tet) >= 0).float() == y_tet)
+                              .float().mean().item())
+
+    jax_accs = []
+    for r in range(rounds):
+        state, _ = algo.run_round(state, r)
+        jax_accs.append(float(algo.evaluate(state)["global_acc"]))
+
+    back = rounds // 2
+    t_back = float(np.mean(torch_accs[back:]))
+    j_back = float(np.mean(jax_accs[back:]))
+    print(f"\n3d-bce back-half mean acc: torch {t_back:.3f}  "
+          f"jax {j_back:.3f}  gap {j_back - t_back:+.3f}")
+    assert t_back > 0.8, torch_accs
+    assert j_back > 0.8, jax_accs
+    # this easy task saturates torch at ~1.0 while batch-selection rng
+    # keeps the jax side a few points lower; forward parity above is the
+    # exact check, this bounds training-dynamics drift
+    assert abs(j_back - t_back) < 0.12, (t_back, j_back,
+                                         torch_accs, jax_accs)
