@@ -1,0 +1,114 @@
+"""Pallas im2col stem conv — experimental groundwork for the r3 mega-kernel.
+
+The phased stem conv ((B, D', H', 8, W') x (3,3,3,8,F), ops/s2d.py) as an
+explicit in-VMEM im2col + MXU dot: per (batch, d-strip) program, build the
+(216, 8x64) unfold tile for 8 output h-rows and contract against the
+(F, 216) remapped kernel.
+
+Status (measured on the v5e, RESULTS.md r2):
+  * EXACT vs lax.conv (max abs err 0.0 in bf16).
+  * Standalone it beats XLA's conv emitter (6.9 vs 7.8 ms incl dispatch).
+  * Swapped into the full training step it is NET SLOWER (19.5 vs 17.7
+    ms/step): XLA's conv fuses the GroupNorm statistics into its epilogue
+    and co-chooses layouts with the pool/backward consumers; a conv-only
+    kernel forfeits both.
+  * Every Mosaic capability the round-1 attempts lacked now works on this
+    toolchain (probed: mid-axis transposes, sublane-offset block writes,
+    unaligned lane reads, lane-offset-64 writes, sublane-splitting
+    reshape-max, bf16 dots/writes). The winning r3 shape is therefore a
+    FUSED forward kernel (conv + GN stats partials + 3x3x3 pool, so the
+    full-size conv output never round-trips HBM) and a fused backward
+    (pool-scatter + GN dense term + wgrad accumulation); estimated
+    step 13.7 -> ~10 ms. Not attempted this round — kept unwired.
+
+Not used by any product path; exercised by tests/test_pallas_stem.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax import lax
+
+R = 3       # remapped kernel extent per dim
+P8 = 8      # phases
+HG = 8      # h-rows per dot
+
+
+def _kernel(x_ref, w_ref, o_ref, u_scratch, *, SD, H, W):
+    wt = w_ref[:]
+    NHG = -(-H // HG)
+
+    def body(ld, _):
+        for g in range(NHG):
+            h0 = min(g * HG, H - HG)
+            for dz in range(R):
+                for dy in range(R):
+                    for dx in range(R):
+                        k0 = ((dz * R + dy) * R + dx) * P8
+                        for j in range(HG):
+                            blk = x_ref[0, pl.ds(ld + dz, 1),
+                                        h0 + j + dy, :, dx:dx + W]
+                            u_scratch[k0:k0 + 8, 64 * j:64 * j + W] = \
+                                blk.reshape(P8, W)
+            z = lax.dot_general(
+                wt, u_scratch[:], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            zt = z.T
+            for j in range(HG):
+                o_ref[0, pl.ds(ld, 1), h0 + j, :, :] = \
+                    zt[64 * j:64 * j + W, :].astype(o_ref.dtype).reshape(
+                        1, W, o_ref.shape[-1])
+        return 0
+
+    jax.lax.fori_loop(0, SD, body, 0)
+
+
+def stem_conv_pallas(x, wt):
+    """x: (B, D', H', 8, W') phased volume; wt: (F, 216) remapped kernel
+    (k = (dz*3+dy)*3+dx)*8 + p). Returns the VALID stride-1 conv
+    (B, D'-2, H'-2, W'-2, F), matching lax.conv on NDHCW/DHWIO."""
+    B, Dp, Hp, P, Wp = x.shape
+    F = wt.shape[0]
+    D, H, W = Dp - 2, Hp - 2, Wp - 2
+    # current tiling preconditions (violations would corrupt silently:
+    # negative h0 wraps static indices; W > 64 overlaps the 64-lane j-slots)
+    if P != P8:
+        raise ValueError(f"phase axis must be {P8}, got {P}")
+    if H < HG:
+        raise ValueError(
+            f"output height {H} < h-group {HG}; this experimental tiling "
+            "needs H' >= 10")
+    if W > 64:
+        raise ValueError(
+            f"output width {W} > 64 exceeds the 64-lane j-slot tiling "
+            "(canonical phased ABCD W' = 61 fits; the r3 fused kernel "
+            "generalizes this)")
+    # strip size: bound VMEM (in + 2x out blocks + scratch); f32 halves it
+    SD = 4 if x.dtype == jnp.bfloat16 else 2
+    SD = min(SD, D)
+    NSTRIP = -(-D // SD)
+    E = pl.Element
+
+    def start(b, s):
+        return (b, jnp.minimum(s * SD, D - SD), 0, 0, 0)
+
+    interpret = jax.default_backend() != "tpu"
+    kern = functools.partial(_kernel, SD=SD, H=H, W=W)
+    return pl.pallas_call(
+        kern,
+        grid=(B, NSTRIP),
+        in_specs=[
+            pl.BlockSpec((E(1), E(SD + 2), E(Hp), E(P), E(Wp)), start,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)), start,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, D, H, W, F), x.dtype),
+        scratch_shapes=[pltpu.VMEM((216, 64 * HG), x.dtype)],
+        interpret=interpret,
+    )(x, wt.astype(x.dtype))

@@ -135,24 +135,23 @@ def _jax_params_to_torch(params, net):
     net.load_state_dict(sd)
 
 
-def _torch_fedavg(xs_tr, ys_tr, x_test, y_test, init_params):
-    """Reference-semantics FedAvg, written from the documented behavior."""
-    net = TorchCNN(CLASSES)
-    _jax_params_to_torch(init_params, net)
+def _torch_fed_rounds(net, xt, yt, x_te, y_te, loss_fn, acc_fn,
+                      lr0=None, rounds=None, post_step=None):
+    """Reference-semantics FedAvg round loop (fedavg_api.py:40-117),
+    written from the documented behavior and shared by the 2D/3D/masked
+    A/B tests: full participation, shuffled-epoch local SGD with
+    lr0*DECAY**round + momentum + clip(10) (+ optional post-step hook,
+    e.g. SalientGrads re-masking), sample-weighted aggregation, global
+    eval per round."""
+    lr0 = LR if lr0 is None else lr0
+    rounds = ROUNDS if rounds is None else rounds
     w_global = {k: v.clone() for k, v in net.state_dict().items()}
-    xt = [torch.from_numpy(x.transpose(0, 3, 1, 2).copy()) for x in xs_tr]
-    yt = [torch.from_numpy(y.astype(np.int64)) for y in ys_tr]
-    x_te = torch.from_numpy(x_test.transpose(0, 3, 1, 2).copy())
-    y_te = torch.from_numpy(y_test.astype(np.int64))
-    loss_fn = torch.nn.CrossEntropyLoss()
-    accs = []
     g = torch.Generator().manual_seed(0)
-    for r in range(ROUNDS):
-        # the reference's seeded sampling contract (full participation here)
-        sel = np.arange(N_CLIENTS)
+    accs = []
+    for r in range(rounds):
         locals_, weights = [], []
-        lr = LR * (DECAY ** r)
-        for c in sel:
+        lr = lr0 * (DECAY ** r)
+        for c in range(len(yt)):
             net.load_state_dict(w_global)
             opt = torch.optim.SGD(net.parameters(), lr=lr,
                                   momentum=MOMENTUM)
@@ -162,25 +161,34 @@ def _torch_fedavg(xs_tr, ys_tr, x_test, y_test, init_params):
                 for s in range(0, n - BS + 1, BS):
                     idx = perm[s:s + BS]
                     opt.zero_grad()
-                    out = net(xt[c][idx])
-                    loss = loss_fn(out, yt[c][idx])
+                    loss = loss_fn(net(xt[c][idx]), yt[c][idx])
                     loss.backward()
                     torch.nn.utils.clip_grad_norm_(net.parameters(), 10.0)
                     opt.step()
+                    if post_step is not None:
+                        post_step(net)
             locals_.append({k: v.clone() for k, v in
                             net.state_dict().items()})
             weights.append(n)
         total = sum(weights)
-        w_global = {
-            k: sum(w_i / total * loc[k] for w_i, loc in
-                   zip(weights, locals_))
-            for k in w_global
-        }
+        w_global = {k: sum(w_i / total * loc[k] for w_i, loc in
+                           zip(weights, locals_)) for k in w_global}
         net.load_state_dict(w_global)
         with torch.no_grad():
-            acc = (net(x_te).argmax(1) == y_te).float().mean().item()
-        accs.append(acc)
+            accs.append(acc_fn(net, x_te, y_te))
     return accs
+
+
+def _torch_fedavg(xs_tr, ys_tr, x_test, y_test, init_params):
+    net = TorchCNN(CLASSES)
+    _jax_params_to_torch(init_params, net)
+    xt = [torch.from_numpy(x.transpose(0, 3, 1, 2).copy()) for x in xs_tr]
+    yt = [torch.from_numpy(y.astype(np.int64)) for y in ys_tr]
+    x_te = torch.from_numpy(x_test.transpose(0, 3, 1, 2).copy())
+    y_te = torch.from_numpy(y_test.astype(np.int64))
+    return _torch_fed_rounds(
+        net, xt, yt, x_te, y_te, torch.nn.CrossEntropyLoss(),
+        lambda n, x, y: (n(x).argmax(1) == y).float().mean().item())
 
 
 @pytest.mark.slow
@@ -292,44 +300,21 @@ def test_salientgrads_convergence_matches_torch_reference():
     _jax_params_to_torch(
         jax.tree_util.tree_map(np.asarray, state.global_params), net)
     mask = _torch_snip_mask(net, xs_tr, ys_tr, dense_ratio)
-    w_global = {k: v.clone() for k, v in net.state_dict().items()}
     xt = [torch.from_numpy(x.transpose(0, 3, 1, 2).copy()) for x in xs_tr]
     yt = [torch.from_numpy(y.astype(np.int64)) for y in ys_tr]
     x_tet = torch.from_numpy(x_te.transpose(0, 3, 1, 2).copy())
     y_tet = torch.from_numpy(y_te.astype(np.int64))
-    loss_fn = torch.nn.CrossEntropyLoss()
-    g = torch.Generator().manual_seed(0)
-    torch_accs = []
-    for r in range(ROUNDS):
-        locals_, weights = [], []
-        lr = LR * (DECAY ** r)
-        for c in range(N_CLIENTS):
-            net.load_state_dict(w_global)
-            opt = torch.optim.SGD(net.parameters(), lr=lr,
-                                  momentum=MOMENTUM)
-            n = len(yt[c])
-            perm = torch.randperm(n, generator=g)
-            for s in range(0, n - BS + 1, BS):
-                idx = perm[s:s + BS]
-                opt.zero_grad()
-                loss = loss_fn(net(xt[c][idx]), yt[c][idx])
-                loss.backward()
-                torch.nn.utils.clip_grad_norm_(net.parameters(), 10.0)
-                opt.step()
-                with torch.no_grad():  # post-step re-mask
-                    for k2, p in net.named_parameters():
-                        if k2 in mask:
-                            p.mul_(mask[k2])
-            locals_.append({k2: v.clone() for k2, v in
-                            net.state_dict().items()})
-            weights.append(n)
-        total = sum(weights)
-        w_global = {k2: sum(w_i / total * loc[k2] for w_i, loc in
-                            zip(weights, locals_)) for k2 in w_global}
-        net.load_state_dict(w_global)
+
+    def remask(n):  # post-step re-mask (my_model_trainer.py:213-216)
         with torch.no_grad():
-            torch_accs.append(
-                (net(x_tet).argmax(1) == y_tet).float().mean().item())
+            for k2, p2 in n.named_parameters():
+                if k2 in mask:
+                    p2.mul_(mask[k2])
+
+    torch_accs = _torch_fed_rounds(
+        net, xt, yt, x_tet, y_tet, torch.nn.CrossEntropyLoss(),
+        lambda n, x, y: (n(x).argmax(1) == y).float().mean().item(),
+        post_step=remask)
 
     jax_accs = []
     for r in range(ROUNDS):
@@ -406,7 +391,7 @@ def test_fedavg_3d_bce_convergence_matches_torch_reference():
     data = make_synthetic_federated(
         n_clients=n_clients, samples_per_client=samples,
         test_per_client=test_n, sample_shape=data_shape,
-        loss_type="bce", class_num=2, seed=3)
+        loss_type="bce", class_num=2, seed=3, uneven=False)
     xs_tr = [np.asarray(data.x_train[c])[: int(data.n_train[c])]
              for c in range(n_clients)]
     ys_tr = [np.asarray(data.y_train[c])[: int(data.n_train[c])]
@@ -437,41 +422,15 @@ def test_fedavg_3d_bce_convergence_matches_torch_reference():
         rng=None))[:, 0]
     np.testing.assert_allclose(ref_logits, jx_logits, rtol=2e-4, atol=2e-4)
 
-    w_global = {k: v.clone() for k, v in net.state_dict().items()}
     xt = [torch.from_numpy(x.transpose(0, 4, 1, 2, 3).copy())
           for x in xs_tr]
     yt = [torch.from_numpy(y.astype(np.float32)) for y in ys_tr]
     x_tet = torch.from_numpy(x_te.transpose(0, 4, 1, 2, 3).copy())
     y_tet = torch.from_numpy(y_te.astype(np.float32))
-    loss_fn = torch.nn.BCEWithLogitsLoss()
-    g = torch.Generator().manual_seed(0)
-    torch_accs = []
-    for r in range(rounds):
-        locals_, weights = [], []
-        lr = lr0 * (DECAY ** r)
-        for c in range(n_clients):
-            net.load_state_dict(w_global)
-            opt = torch.optim.SGD(net.parameters(), lr=lr,
-                                  momentum=MOMENTUM)
-            n = len(yt[c])
-            perm = torch.randperm(n, generator=g)
-            for s in range(0, n - BS + 1, BS):
-                idx = perm[s:s + BS]
-                opt.zero_grad()
-                loss = loss_fn(net(xt[c][idx]), yt[c][idx])
-                loss.backward()
-                torch.nn.utils.clip_grad_norm_(net.parameters(), 10.0)
-                opt.step()
-            locals_.append({k: v.clone() for k, v in
-                            net.state_dict().items()})
-            weights.append(n)
-        total = sum(weights)
-        w_global = {k: sum(w / total * loc[k] for w, loc in
-                           zip(weights, locals_)) for k in w_global}
-        net.load_state_dict(w_global)
-        with torch.no_grad():
-            torch_accs.append(((net(x_tet) >= 0).float() == y_tet)
-                              .float().mean().item())
+    torch_accs = _torch_fed_rounds(
+        net, xt, yt, x_tet, y_tet, torch.nn.BCEWithLogitsLoss(),
+        lambda n, x, y: ((n(x) >= 0).float() == y).float().mean().item(),
+        lr0=lr0, rounds=rounds)
 
     jax_accs = []
     for r in range(rounds):
@@ -485,8 +444,7 @@ def test_fedavg_3d_bce_convergence_matches_torch_reference():
           f"jax {j_back:.3f}  gap {j_back - t_back:+.3f}")
     assert t_back > 0.8, torch_accs
     assert j_back > 0.8, jax_accs
-    # this easy task saturates torch at ~1.0 while batch-selection rng
-    # keeps the jax side a few points lower; forward parity above is the
-    # exact check, this bounds training-dynamics drift
-    assert abs(j_back - t_back) < 0.12, (t_back, j_back,
-                                         torch_accs, jax_accs)
+    # even client sizes make the local-step counts symmetric; forward
+    # parity above is the exact check, this bounds training-dynamics drift
+    assert abs(j_back - t_back) < 0.1, (t_back, j_back,
+                                        torch_accs, jax_accs)

@@ -1,0 +1,33 @@
+"""Experimental pallas im2col stem conv: exactness vs lax.conv (interpret
+mode on CPU; the real-chip numbers are in ops/pallas_stem.py's docstring)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax import lax
+
+from neuroimagedisttraining_tpu.ops.pallas_stem import stem_conv_pallas
+
+
+def _ref_conv(x, w):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NDHCW", "DHWIO", "NDHWC"))
+    return lax.conv_general_dilated(x, w, (1, 1, 1), "VALID",
+                                    dimension_numbers=dn)
+
+
+@pytest.mark.parametrize("shape,feat", [
+    ((2, 12, 13, 8, 12), 16),
+    ((1, 8, 10, 8, 9), 8),
+])
+def test_pallas_stem_matches_lax_conv(shape, feat):
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, shape, jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1),
+                          (3, 3, 3, 8, feat), jnp.float32)
+    wt = jnp.transpose(w.reshape(27 * 8, feat))
+    got = stem_conv_pallas(x, wt)
+    want = _ref_conv(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
