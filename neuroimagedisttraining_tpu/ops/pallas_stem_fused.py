@@ -1,0 +1,204 @@
+"""Fused stem forward — conv + 3x3x3/s3 max-pool + GN stat partials in one
+Pallas pass (r3 mega-kernel starting material; NOT wired into any product
+path). Verification is the on-chip harness —
+``python -m neuroimagedisttraining_tpu.ops.pallas_stem_fused`` prints the
+error-vs-XLA table (full-size interpret mode on the 1-core CPU host takes
+~9 min, so there is deliberately no CPU test; the base im2col kernel IS
+CPU-tested in tests/test_pallas_stem.py).
+
+All three outputs are verified exact against the XLA reference on the
+canonical phased ABCD shape (zs and pooled bit-exact in bf16; stat
+partials to f32 accumulation order, ~1e-5 rel). Status on the v5e
+(RESULTS.md r2 close-out): ties the XLA conv+pool+stats trio within
+measurement noise — the in-VMEM unfold writes (~4 ms/step floor across
+all formulations tried) are the cost XLA's direct-conv emitter does not
+pay. The remaining r3 angle is eliminating the unfold: one-write-per-tap
+3D tiles with per-slice dots, or a direct-conv MAC formulation.
+
+Hard-won structural pieces captured here:
+  * strip/pool d-alignment: SD=3 strips aligned to pool d-groups, with
+    the ragged tail strip ordered FIRST so its misaligned pool store is
+    overwritten by the last aligned strip (TPU pallas grids execute
+    sequentially per core);
+  * static h-group schedule H0S covering 71 rows with pool-aligned
+    sub-rows and one overlap row, with the overlap statically excluded
+    from the stat sums (and the tail strip's re-counted d-plane excluded
+    via a program-id predicate);
+  * in-kernel w-pooling via transpose + sublane-splitting reshape-max.
+
+This module is fixed to the canonical phased ABCD extents
+(61x73x8x61 -> 59x71x59, pool 19x23x19).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax import lax
+
+B, Dp, Hp, P8, Wp = 8, 61, 73, 8, 61
+D, H, W = 59, 71, 59          # conv output extents
+PD, PH, PW = 19, 23, 19       # pooled extents
+F = 64
+SD = 3
+# strips: s=0 is the ragged tail at d0=56 (its misaligned pool store is
+# overwritten later), s=1..19 are the aligned strips at d0=3*(s-1)
+# covering d 0..56 — 20 programs total
+NSTRIP = 20
+HG = 9
+H0S = [0, 9, 18, 27, 36, 45, 54, 62]   # static h-group starts (cover 0..70)
+
+
+def kernel(x_ref, w_ref, ozs_ref, opool_ref, ostat_ref, u_ref, z3_ref):
+    s = pl.program_id(1)
+    wt = w_ref[:]
+    # lane validity masks for stats: slot lanes 64j..64j+58 valid
+    lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, 64 * HG), 1)
+    slot_pos = lane_ids % 64
+    lane_valid = (slot_pos < W).astype(jnp.float32)
+
+    ssum = jnp.zeros((1, F), jnp.float32)
+    ssq = jnp.zeros((1, F), jnp.float32)
+
+    for gi, h0 in enumerate(H0S):
+        nj = HG  # every group in H0S spans exactly HG rows
+        # build + dot for each of the 3 local d-planes
+        for ld in range(SD):
+            for dz in range(3):
+                for dy in range(3):
+                    for dx in range(3):
+                        k0 = ((dz * 3 + dy) * 3 + dx) * P8
+                        for j in range(nj):
+                            blk = x_ref[0, ld + dz, h0 + j + dy, :,
+                                        dx:dx + W]
+                            u_ref[k0:k0 + 8, 64 * j:64 * j + W] = blk
+            z = lax.dot_general(wt, u_ref[:], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            z3_ref[ld] = z
+            # zs rows out
+            zt = z.T
+            for j in range(nj):
+                ozs_ref[0, ld, h0 + j, :, :] = \
+                    zt[64 * j:64 * j + W, :].astype(ozs_ref.dtype)
+            # stats: skip overlap rows (group 7 end 62 vs group 8 start 62)
+            jskip = 1 if gi == len(H0S) - 1 else 0
+            row_valid = lane_valid * (lane_ids >= 64 * jskip).astype(
+                jnp.float32)
+            # tail strip (s==0, d0=56): row ld=0 (d=56) is re-counted by
+            # the last aligned strip -> zero its contribution
+            ld_w = jnp.where((s == 0) & (ld == 0), 0.0, 1.0)
+            zm = z * row_valid
+            ssum = ssum + ld_w * jnp.sum(zm, axis=1, keepdims=True).T
+            ssq = ssq + ld_w * jnp.sum(zm * z, axis=1, keepdims=True).T
+
+        # pooling for this h-group: d-max across the 3 planes
+        dmax = jnp.maximum(jnp.maximum(z3_ref[0], z3_ref[1]), z3_ref[2])
+        # pool-aligned local h rows: h0 % 3 == 0 -> offsets 0,3,6;
+        # group 7 (h0=62): aligned sub-rows start at local 1 (h=63,66)
+        off0 = (3 - (h0 % 3)) % 3
+        for a in range(3):
+            j0 = off0 + 3 * a
+            if j0 + 3 > nj or h0 + j0 + 2 > 68:
+                continue
+            ph = (h0 + j0) // 3
+            hmax = jnp.maximum(
+                jnp.maximum(dmax[:, 64 * j0:64 * j0 + W],
+                            dmax[:, 64 * (j0 + 1):64 * (j0 + 1) + W]),
+                dmax[:, 64 * (j0 + 2):64 * (j0 + 2) + W])   # (F, W)
+            mt = hmax.T[:57, :]                              # (57, F)
+            pw = jnp.max(mt.reshape(PW, 3, F), axis=1)       # (19, F)
+            opool_ref[0, 0, ph, :, :] = pw.astype(opool_ref.dtype)
+
+    ostat_ref[0, 0, 0, :] = ssum.reshape(F)
+    ostat_ref[0, 0, 1, :] = ssq.reshape(F)
+
+
+def _d0(s):
+    return jnp.where(s == 0, D - SD, 3 * (s - 1))
+
+
+def fused_stem_fwd(x, wt):
+    E = pl.Element
+    kern = kernel
+    zs, pooled, stats = pl.pallas_call(
+        kern,
+        grid=(B, NSTRIP),
+        in_specs=[
+            pl.BlockSpec((E(1), E(SD + 2), E(Hp), E(P8), E(Wp)),
+                         lambda b, s: (b, _d0(s), 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+                         lambda b, s: (b, _d0(s), 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
+                                       0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E(1), E(1), E(2), E(F)),
+                         lambda b, s: (b, s, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, D, H, W, F), x.dtype),
+            jax.ShapeDtypeStruct((B, PD, PH, PW, F), x.dtype),
+            jax.ShapeDtypeStruct((B, NSTRIP, 2, F), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((216, 64 * HG), x.dtype),
+            pltpu.VMEM((SD, F, 64 * HG), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(x, wt.astype(x.dtype))
+    return zs, pooled, stats
+
+
+def ref(x, w):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NDHCW", "DHWIO", "NDHWC"))
+    zs = lax.conv_general_dilated(x, w, (1, 1, 1), "VALID",
+                                  dimension_numbers=dn)
+    import flax.linen as nn
+    pooled = nn.max_pool(zs, (3, 3, 3), strides=(3, 3, 3))
+    zf = zs.astype(jnp.float32)
+    return zs, pooled, (jnp.sum(zf, axis=(1, 2, 3)),
+                        jnp.sum(zf * zf, axis=(1, 2, 3)))
+
+
+if __name__ == "__main__":  # on-chip check harness
+    import time
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (B, Dp, Hp, P8, Wp), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, P8, F),
+                          jnp.bfloat16)
+    wt = jnp.transpose(w.reshape(27 * 8, F))
+    def timeit(f, *args, n=20):
+        for _ in range(3):
+            out = f(*args)
+        float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(*args)
+        float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+        return (time.perf_counter() - t0) / n
+
+    jf = jax.jit(fused_stem_fwd)
+    jr = jax.jit(ref)
+    zs, m, st = jf(x, wt)
+    rzs, rm, (rs, rq) = jr(x, w)
+    print("zs err:", float(jnp.max(jnp.abs(zs.astype(jnp.float32)
+                                           - rzs.astype(jnp.float32)))))
+    print("pool err:", float(jnp.max(jnp.abs(m.astype(jnp.float32)
+                                             - rm.astype(jnp.float32)))))
+    ks = jnp.sum(st[:, :, 0, :], axis=1)
+    kq = jnp.sum(st[:, :, 1, :], axis=1)
+    print("sum relerr:", float(jnp.max(jnp.abs(ks - rs)
+                                       / (jnp.abs(rs) + 1e-3))))
+    print("sumsq relerr:", float(jnp.max(jnp.abs(kq - rq)
+                                         / (jnp.abs(rq) + 1e-3))))
+    print(f"fused: {timeit(jf, x, wt)*1e3:.2f} ms   "
+          f"ref(conv+pool+stats): {timeit(jr, x, w)*1e3:.2f} ms")
