@@ -27,7 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 
 N_CLIENTS = 8
-SAMPLES_PER_CLIENT = 16
+# 40 = STEPS*BATCH: under the default epoch batching (each client consumes
+# exactly ceil(n_i/batch) shuffled batches per epoch, core/trainer.py) the
+# round runs the same 5 full batches per client the r1/r2 benches timed
+SAMPLES_PER_CLIENT = 40
 VOLUME = (121, 145, 121)  # canonical ABCD volume (stored phase-decomposed)
 BATCH = 8
 STEPS = 5
@@ -89,10 +92,14 @@ def main():
         N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0)
     )
     model = create_model(MODEL_KEY, num_classes=1)
+    import os
     hp = HyperParams(
         lr=1e-3, lr_decay=0.998, momentum=0.9, weight_decay=5e-4,
         grad_clip=10.0, local_epochs=1, steps_per_epoch=STEPS,
         batch_size=BATCH,
+        # default: the product's reference-exact epoch batching;
+        # BENCH_BATCHING=replacement isolates its cost for A/B
+        batching=os.environ.get("BENCH_BATCHING", "epoch"),
     )
     # On fewer devices than clients, chunk client concurrency to fit HBM
     # (see FedAlgorithm._vmap_clients); a pod runs the full client vmap.
@@ -185,7 +192,7 @@ def tracked_config(name: str):
 
         MODEL_KEY = "small3dcnn"  # shallow CNN; channel-ful storage path
         n_clients = 64
-        data = _device_synth_data(n_clients, 16, (61, 73, 61),
+        data = _device_synth_data(n_clients, STEPS * BATCH, (61, 73, 61),
                                   jax.random.PRNGKey(0))
         model = create_model("small3dcnn", num_classes=1)
         hp = HyperParams(lr=1e-3, momentum=0.9, local_epochs=1,

@@ -92,6 +92,17 @@ class FedAlgorithm(abc.ABC):
         # injected channel axis
         self.init_sample_shape = tuple(data.sample_shape) + (
             (1,) if channel_inject else ())
+        if hp.batching == "epoch":
+            n_biggest = int(np.max(np.asarray(data.n_train)))
+            budget = hp.steps_per_epoch * hp.batch_size
+            if budget < n_biggest:
+                logger.warning(
+                    "epoch batching with steps_per_epoch*batch_size=%d < "
+                    "largest client shard (%d): epochs are truncated — each "
+                    "epoch trains on a fresh random %d-subset per client "
+                    "instead of the full shard (the runner sizes "
+                    "steps_per_epoch to ceil(max(n_i)/batch) and never "
+                    "hits this)", budget, n_biggest, budget)
         self.apply_fn = make_apply_fn(
             model, compute_dtype=self.compute_dtype,
             channel_inject=channel_inject)
@@ -139,18 +150,27 @@ class FedAlgorithm(abc.ABC):
         """(params, mask) of one representative client for the per-round
         FLOPs/comm accounting (``stat_info``'s ``sum_training_flops`` /
         ``sum_comm_params``, ``sailentgrads_api.py:137-138``). For stacked
-        personalized states, client 0's slice stands in for the cohort
-        (per-client densities differ only by mask evolution noise)."""
+        personalized states the representative is the client whose overall
+        mask density is closest to the cohort mean — client 0 would bias
+        the counters when densities differ systematically across clients
+        (DisPFL ``--diff_spa`` assigns client 0 the sparsest mask)."""
         params = getattr(state, "global_params", None)
         mask = getattr(state, "mask", None)
+        rep = 0
         if mask is None:
             masks = getattr(state, "masks", None)
             if masks is not None:
-                mask = jax.tree_util.tree_map(lambda m: m[0], masks)
+                nz = sum(
+                    jnp.count_nonzero(
+                        m, axis=tuple(range(1, m.ndim))).astype(jnp.float32)
+                    for m in jax.tree_util.tree_leaves(masks))
+                dens = nz / jnp.maximum(jnp.sum(nz), 1.0)  # relative is enough
+                rep = int(jnp.argmin(jnp.abs(dens - jnp.mean(dens))))
+                mask = jax.tree_util.tree_map(lambda m: m[rep], masks)
         if params is None:
             stacked = getattr(state, "personal_params", None)
             if stacked is not None:
-                params = jax.tree_util.tree_map(lambda p: p[0], stacked)
+                params = jax.tree_util.tree_map(lambda p: p[rep], stacked)
         return params, mask
 
     # -- shared helpers -------------------------------------------------------

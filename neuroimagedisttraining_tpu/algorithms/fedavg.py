@@ -36,16 +36,25 @@ from .base import FedAlgorithm, sample_client_indexes
 @struct.dataclass
 class FedAvgState:
     global_params: Any
-    personal_params: Any  # [C, ...] — w_per_mdls (fedavg_api.py:42-45)
+    # [C, ...] — w_per_mdls (fedavg_api.py:42-45), or None when personal
+    # tracking is off. NOTE the HBM scaling: the stack is one full model per
+    # client ON DEVICE (the reference keeps w_per_mdls in host RAM), so very
+    # large --client_num_in_total simulations should pass --track_personal 0
+    # unless they need per-client personal models/eval.
+    personal_params: Any
     rng: jax.Array
 
 
 class FedAvg(FedAlgorithm):
     name = "fedavg"
 
-    def __init__(self, *args, defense=None, **kwargs):
+    def __init__(self, *args, defense=None, track_personal: bool = True,
+                 **kwargs):
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
         self.defense = defense
+        # track_personal=False drops the on-device w_per_mdls stack (and the
+        # final fine-tune that exists to produce it) — O(C x model) HBM
+        self.track_personal = track_personal
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -64,8 +73,10 @@ class FedAvg(FedAlgorithm):
                 sel_idx, round_idx, round_key, x_train, y_train, n_train,
                 defense=self.defense,
             )
-            new_personal = tree_scatter_update(
-                state.personal_params, sel_idx, locals_)
+            new_personal = state.personal_params
+            if new_personal is not None:
+                new_personal = tree_scatter_update(
+                    new_personal, sel_idx, locals_)
             return (
                 FedAvgState(global_params=new_global,
                             personal_params=new_personal, rng=rng),
@@ -98,7 +109,8 @@ class FedAvg(FedAlgorithm):
         params = init_params(self.model, p_rng, self.init_sample_shape)
         return FedAvgState(
             global_params=params,
-            personal_params=broadcast_tree(params, self.num_clients),
+            personal_params=(broadcast_tree(params, self.num_clients)
+                             if self.track_personal else None),
             rng=s_rng,
         )
 
@@ -113,6 +125,10 @@ class FedAvg(FedAlgorithm):
         return state, {"train_loss": loss}
 
     def finalize(self, state: FedAvgState):
+        if not self.track_personal:
+            # the fine-tune pass exists to produce the personal models
+            # (fedavg_api.py:79-88); nothing to produce when untracked
+            return state, None
         state = self._finetune_jit(
             state, self.data.x_train, self.data.y_train, self.data.n_train)
         ev = self.evaluate(state)
@@ -126,10 +142,12 @@ class FedAvg(FedAlgorithm):
             state.global_params, self.data.x_test, self.data.y_test,
             self.data.n_test,
         )
-        evp = self._eval_personal(
-            state.personal_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
-        return {"global_acc": ev["acc"], "global_loss": ev["loss"],
-                "personal_acc": evp["acc"], "personal_loss": evp["loss"],
-                "acc_per_client": ev["acc_per_client"]}
+        out = {"global_acc": ev["acc"], "global_loss": ev["loss"],
+               "acc_per_client": ev["acc_per_client"]}
+        if state.personal_params is not None:
+            evp = self._eval_personal(
+                state.personal_params, self.data.x_test, self.data.y_test,
+                self.data.n_test,
+            )
+            out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
+        return out

@@ -25,9 +25,11 @@ def bce_with_logits_per_example(logits: jax.Array, labels: jax.Array) -> jax.Arr
     logits = _first_output(logits)
     logits = logits.reshape(logits.shape[0], -1)[:, 0]
     labels = labels.astype(logits.dtype)
-    # log(1+exp(-|x|)) formulation for numerical stability
-    return (jnp.maximum(logits, 0.0) - logits * labels
-            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    # x*(1-y) + softplus(-x): same value as the max/abs stable form but
+    # smooth, so the gradient is sigmoid(x)-y EVERYWHERE — the max/abs
+    # form's subgradient at x == 0 is -1 (not torch's analytic -0.5) from
+    # JAX's tie-splitting through maximum() and abs()
+    return logits * (1.0 - labels) + jax.nn.softplus(-logits)
 
 
 def softmax_ce_per_example(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -32,6 +32,13 @@ class HyperParams:
     local_epochs: int = struct.field(pytree_node=False, default=2)
     steps_per_epoch: int = struct.field(pytree_node=False, default=4)
     batch_size: int = struct.field(pytree_node=False, default=16)
+    # "epoch" (default): per-epoch shuffled batches, each client consuming
+    # exactly its own ceil(n_i/batch) batches per epoch with a partial final
+    # batch — the reference's DataLoader(shuffle=True, drop_last=False)
+    # semantics (my_model_trainer.py:194-216); steps beyond a client's own
+    # count are masked no-ops so shapes stay static under jit/vmap.
+    # "replacement": uniform with-replacement draws (round 1/2 behavior).
+    batching: str = struct.field(pytree_node=False, default="epoch")
 
     @property
     def local_steps(self) -> int:

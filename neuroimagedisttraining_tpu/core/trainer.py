@@ -9,10 +9,14 @@ host round-trips (the reference pays a GPU→CPU ``state_dict`` deepcopy per
 client per round, ``my_model_trainer.py:131-132``).
 
 Batching model: each client's local shard lives padded at ``[n_max, ...]``
-with a valid-count scalar; batches are drawn by uniform index sampling in
-``[0, n_valid)`` (with replacement — a documented deviation from the
-reference's shuffled epochs; both are unbiased stochastic gradients and this
-keeps shapes static under jit).
+with a valid-count scalar. The default ``hp.batching == "epoch"`` draws
+per-epoch shuffled batches — each client consumes exactly its own
+``ceil(n_i/batch)`` batches per epoch, the last one partial, matching the
+reference's ``DataLoader(shuffle=True, drop_last=False)`` iteration
+(``ABCD/data_loader.py:202``, ``my_model_trainer.py:194-216``); steps past a
+client's own count are masked no-ops so shapes stay static under jit/vmap.
+``hp.batching == "replacement"`` keeps the round-1/2 uniform
+with-replacement draws (also unbiased; marginally cheaper per step).
 """
 from __future__ import annotations
 
@@ -28,6 +32,31 @@ from .state import HyperParams
 
 
 ApplyFn = Callable[..., Any]  # apply_fn(params, x, train: bool, rng) -> logits
+
+
+def epoch_permutations(rng: jax.Array, n_valid: jax.Array, epochs: int,
+                       length: int, n_rows: int = 0) -> jax.Array:
+    """``[epochs, length]`` shuffles for epoch batching: per epoch, the first
+    ``min(n_valid, length)`` entries are a uniform draw without replacement
+    from ALL valid row indices ``[0, n_valid)`` — a full permutation when
+    ``length >= n_valid``; the remaining entries point at padded rows
+    (``>= n_valid``) and are masked out by the per-example batch weights.
+    Static-shape replacement for the reference's per-epoch DataLoader
+    shuffle (``ABCD/data_loader.py:202``).
+
+    ``n_rows`` is the padded shard size; the draw domain is
+    ``max(length, n_rows)`` so a caller-truncated epoch (``steps_per_epoch *
+    batch_size < n_i``) consumes a fresh random subset of the WHOLE shard
+    each epoch rather than a fixed prefix."""
+    domain = max(length, int(n_rows))
+    positions = jnp.arange(domain)
+
+    def one(key):
+        scores = jnp.where(positions < n_valid,
+                           jax.random.uniform(key, (domain,)), jnp.inf)
+        return jnp.argsort(scores)[:length].astype(jnp.int32)
+
+    return jax.vmap(one)(jax.random.split(rng, epochs))
 
 
 def make_client_update(
@@ -60,19 +89,94 @@ def make_client_update(
     is ignored (and DCE'd) unless ``prox_lambda > 0``.
     """
     loss_fn = make_loss_fn(loss_type)
+    per_example = PER_EXAMPLE_LOSSES[loss_type]
+    epoch_mode = hp.batching == "epoch"
 
-    def batch_loss(params, xb, yb, dropout_rng):
+    def batch_loss(params, xb, yb, wb, dropout_rng):
         logits = apply_fn(params, xb, train=True, rng=dropout_rng)
-        return loss_fn(logits, yb)
+        if wb is None:  # full batch — plain mean (replacement mode)
+            return loss_fn(logits, yb)
+        # partial final epoch batch: mean over the batch's own valid
+        # examples, exactly the reference's smaller-last-batch loss.mean()
+        w = wb.astype(jnp.float32)
+        per_ex = per_example(logits, yb).astype(jnp.float32)
+        return jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1.0)
 
     if remat:
         batch_loss = jax.checkpoint(
             batch_loss, policy=jax.checkpoint_policies.nothing_saveable)
     grad_fn = jax.value_and_grad(batch_loss)
 
+    def apply_update(params, momentum, grads, mask, prox_target, lr):
+        """One optimizer step: clip + (masked) SGD + prox pull + re-mask."""
+        grads = clip_by_global_norm(grads, hp.grad_clip)
+        if fused_kernels and not prox_lambda:
+            from ..ops.pallas_kernels import fused_masked_sgd_step
+
+            ones = mask if (mask_grads or mask_params_post_step) \
+                else jax.tree_util.tree_map(jnp.ones_like, params)
+            return fused_masked_sgd_step(
+                params, momentum, grads, ones, lr,
+                momentum=hp.momentum, wd=hp.weight_decay,
+                mask_grads=mask_grads)
+        if mask_grads:
+            grads = jax.tree_util.tree_map(lambda g, m: g * m, grads, mask)
+        params, momentum = sgd_momentum_step(
+            params, momentum, grads, lr, hp.momentum, hp.weight_decay
+        )
+        if prox_lambda:
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - lr.astype(p.dtype) * prox_lambda * (p - g),
+                params, prox_target,
+            )
+        if mask_params_post_step:
+            params = jax.tree_util.tree_map(lambda p, m: p * m, params, mask)
+        return params, momentum
+
     def client_update(params, momentum, mask, rng, x, y, n_valid, round_idx,
                       prox_target):
         lr = hp.lr * jnp.power(hp.lr_decay, round_idx.astype(jnp.float32))
+
+        if epoch_mode:
+            spe, bs = hp.steps_per_epoch, hp.batch_size
+            k_perm, k_steps = jax.random.split(rng)
+            # [E, spe*bs] per-epoch shuffles, flattened for dynamic slicing
+            flat_perms = epoch_permutations(
+                k_perm, n_valid, hp.local_epochs, spe * bs,
+                n_rows=x.shape[0]).reshape(-1)
+
+            def step(carry, s):
+                params, momentum = carry
+                k_drop = jax.random.fold_in(k_steps, s)
+                pos = s % spe
+                start = (s // spe) * (spe * bs) + pos * bs
+                idx = lax.dynamic_slice(flat_perms, (start,), (bs,))
+                # perm slots past n_valid point past the padded shard when
+                # spe*bs > n_rows; clamp (their loss terms are masked by wb
+                # anyway, but jnp.take's default OOB fill is NaN)
+                idx = jnp.minimum(idx, x.shape[0] - 1)
+                # validity of this batch's slots within the client's epoch
+                offs = pos * bs + jnp.arange(bs)
+                wb = offs < n_valid
+                xb = jnp.take(x, idx, axis=0)
+                yb = jnp.take(y, idx, axis=0)
+                loss, grads = grad_fn(params, xb, yb, wb, k_drop)
+                new_params, new_momentum = apply_update(
+                    params, momentum, grads, mask, prox_target, lr)
+                # steps past this client's own ceil(n_i/bs) are no-ops
+                active = (pos * bs) < n_valid
+                params = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(active, a, b), new_params, params)
+                momentum = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(active, a, b), new_momentum,
+                    momentum)
+                return (params, momentum), (loss, active)
+
+            (params, momentum), (losses, actives) = lax.scan(
+                step, (params, momentum), jnp.arange(hp.local_steps))
+            act = actives.astype(jnp.float32)
+            mean_loss = jnp.sum(losses * act) / jnp.maximum(jnp.sum(act), 1.0)
+            return params, momentum, mean_loss
 
         def step(carry, key):
             params, momentum = carry
@@ -81,30 +185,9 @@ def make_client_update(
                                      jnp.maximum(n_valid, 1))
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
-            loss, grads = grad_fn(params, xb, yb, k_drop)
-            grads = clip_by_global_norm(grads, hp.grad_clip)
-            if fused_kernels and not prox_lambda:
-                from ..ops.pallas_kernels import fused_masked_sgd_step
-
-                ones = mask if (mask_grads or mask_params_post_step) \
-                    else jax.tree_util.tree_map(jnp.ones_like, params)
-                params, momentum = fused_masked_sgd_step(
-                    params, momentum, grads, ones, lr,
-                    momentum=hp.momentum, wd=hp.weight_decay,
-                    mask_grads=mask_grads)
-                return (params, momentum), loss
-            if mask_grads:
-                grads = jax.tree_util.tree_map(lambda g, m: g * m, grads, mask)
-            params, momentum = sgd_momentum_step(
-                params, momentum, grads, lr, hp.momentum, hp.weight_decay
-            )
-            if prox_lambda:
-                params = jax.tree_util.tree_map(
-                    lambda p, g: p - lr.astype(p.dtype) * prox_lambda * (p - g),
-                    params, prox_target,
-                )
-            if mask_params_post_step:
-                params = jax.tree_util.tree_map(lambda p, m: p * m, params, mask)
+            loss, grads = grad_fn(params, xb, yb, None, k_drop)
+            params, momentum = apply_update(
+                params, momentum, grads, mask, prox_target, lr)
             return (params, momentum), loss
 
         keys = jax.random.split(rng, hp.local_steps)

@@ -83,6 +83,13 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="run the algorithm's end-of-training pass (FedAvg's "
                         "final per-client fine-tune, fedavg_api.py:79-88); "
                         "0 skips it")
+    p.add_argument("--track_personal", type=int, default=1,
+                   help="fedavg: keep per-client personal models "
+                        "(w_per_mdls, fedavg_api.py:42-45) on device for "
+                        "personal eval + final fine-tune. The stack is one "
+                        "full model per client in HBM; pass 0 for very "
+                        "large --client_num_in_total simulations that "
+                        "don't need personal models")
 
     # -- robust aggregation (fedml_core/robustness/robust_aggregation.py;
     # dead code in the reference — no caller — wired end-to-end here)
@@ -111,6 +118,13 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="store volumes in this dtype on device (bfloat16 "
                         "halves HBM for data and skips the per-step "
                         "convert when paired with --compute_dtype bfloat16)")
+    p.add_argument("--batching", type=str, default="epoch",
+                   choices=["epoch", "replacement"],
+                   help="local batch draw: epoch = per-epoch shuffles, each "
+                        "client consuming its own ceil(n_i/batch) batches "
+                        "(reference DataLoader semantics, the default); "
+                        "replacement = uniform with-replacement draws with "
+                        "a uniform mean-derived step count (legacy)")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
     p.add_argument("--eval_clients", type=int, default=0,
@@ -295,8 +309,20 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         parts.append(f"nb{args.norm_bound:g}")
         if args.defense_type == "weak_dp":
             parts.append(f"sd{args.stddev:g}")
+    if getattr(args, "batching", "epoch") != "epoch":
+        parts.append("wr")  # with-replacement draws train differently
+    if getattr(args, "eval_clients", 0):
+        # sampled-eval changes the metric protocol — runs must not share
+        # stat_info/log lineage with full-cohort evals
+        parts.append(f"evK{args.eval_clients}")
+    if getattr(args, "data_dtype", ""):
+        parts.append(f"dt{args.data_dtype}")  # volumes stored in this dtype
     if not getattr(args, "final_finetune", 1):
         parts.append("noft")
+    if algo == "fedavg" and not getattr(args, "track_personal", 1):
+        # only fedavg consumes the flag; other algorithms' lineage must not
+        # split on a no-op
+        parts.append("nopers")
     if getattr(args, "global_test", False):
         parts.append("g")  # main_dispfl.py:198-199
     if args.tag:

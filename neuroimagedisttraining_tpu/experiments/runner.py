@@ -136,13 +136,22 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
     num_outputs = 1 if loss_type == "bce" else data.class_num
     model = create_model(model_key, num_classes=num_outputs)
 
-    n_mean = int(np.mean(np.asarray(data.n_train)))
-    steps_per_epoch = max(1, n_mean // args.batch_size)
+    batching = getattr(args, "batching", "epoch")
+    if batching == "epoch":
+        # reference semantics: each client iterates its own loader —
+        # ceil(n_i/batch) shuffled batches per epoch (my_model_trainer.py:
+        # 194-216). The static scan bound is the largest client's count;
+        # smaller clients' excess steps are masked no-ops (core/trainer.py).
+        n_bound = int(np.max(np.asarray(data.n_train)))
+        steps_per_epoch = max(1, -(-n_bound // args.batch_size))
+    else:  # legacy with-replacement draws: uniform mean-derived step count
+        n_mean = int(np.mean(np.asarray(data.n_train)))
+        steps_per_epoch = max(1, n_mean // args.batch_size)
     hp = HyperParams(
         lr=args.lr, lr_decay=args.lr_decay, momentum=args.momentum,
         weight_decay=args.wd, grad_clip=args.grad_clip,
         local_epochs=args.epochs, steps_per_epoch=steps_per_epoch,
-        batch_size=args.batch_size,
+        batch_size=args.batch_size, batching=batching,
     )
 
     common = dict(
@@ -176,7 +185,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                          getattr(args, "stratified_sampling", 0)),
                      fused_kernels=bool(getattr(args, "fused_kernels", 0)))
     elif algo_name == "fedavg":
-        extra = dict(defense=defense)
+        extra = dict(defense=defense,
+                     track_personal=bool(
+                         getattr(args, "track_personal", 1)))
     elif algo_name == "dispfl":
         extra = dict(dense_ratio=args.dense_ratio,
                      anneal_factor=args.anneal_factor,
@@ -408,19 +419,51 @@ def run_experiment(args: argparse.Namespace,
         cost = CostTracker(model=algo.model,
                            sample_shape=algo.init_sample_shape)
         samples_per_client = algo.hp.local_steps * algo.hp.batch_size
+        if getattr(args, "batching", "epoch") == "epoch":
+            # epoch batching: each client consumes its own n_i samples per
+            # epoch (the reference's epochs*samples approximation,
+            # sailentgrads/client.py:70-76); cohort mean is the per-client
+            # stand-in for the sampled subset
+            samples_per_client = algo.hp.local_epochs * int(
+                np.mean(np.asarray(data.n_train)))
         if start_round > 0:
-            # resumed run: seed the cumulative counters with the rounds
-            # that ran before the checkpoint, from the restored state's
-            # snapshot (exact for static masks; for evolving-mask
-            # algorithms this uses the current density as the estimate)
-            cost_params, cost_mask = algo.cost_snapshot(state)
-            if cost_params is not None:
-                cost.record_round(
-                    cost_params, cost_mask,
-                    n_clients=algo.cost_trained_clients_per_round(),
-                    samples_per_client=samples_per_client)
-                for _ in range(start_round - 1):
-                    cost.record_repeat()
+            meta = (ckpt_mgr.load_metadata(start_round)
+                    if ckpt_mgr is not None else None)
+            batching = getattr(args, "batching", "epoch")
+            ck_batching = (meta or {}).get("batching")
+            if ck_batching is not None and ck_batching != batching:
+                # the default flipped to epoch batching in round 3; a
+                # lineage checkpointed under the other mode must not be
+                # silently continued with different training semantics
+                raise SystemExit(
+                    f"checkpoint at round {start_round} was trained with "
+                    f"--batching {ck_batching}, but this run uses "
+                    f"--batching {batching}. Pass --batching {ck_batching} "
+                    "to continue the lineage, or start a fresh one "
+                    "(different --checkpoint_dir or --tag).")
+            if ck_batching is None:
+                logger.warning(
+                    "checkpoint has no recorded batching mode (pre-round-3 "
+                    "lineage, with-replacement semantics); continuing with "
+                    "--batching %s — pass --batching replacement if the "
+                    "original semantics must be preserved", batching)
+            if meta and "cost" in meta:
+                # exact totals persisted at save time (required for
+                # evolving-mask algorithms whose replayed rounds had
+                # different densities than the restored state)
+                cost.restore_totals(meta["cost"])
+            else:
+                # legacy checkpoint without a sidecar: estimate the
+                # pre-checkpoint rounds from the restored state's snapshot
+                # (exact for static masks)
+                cost_params, cost_mask = algo.cost_snapshot(state)
+                if cost_params is not None:
+                    cost.record_round(
+                        cost_params, cost_mask,
+                        n_clients=algo.cost_trained_clients_per_round(),
+                        samples_per_client=samples_per_client)
+                    for _ in range(start_round - 1):
+                        cost.record_repeat()
 
         history = []
         final_eval = None
@@ -453,7 +496,10 @@ def run_experiment(args: argparse.Namespace,
             history.append(record)
             logger.info("%s round %d: %s", algo_name, r, record)
             if ckpt_mgr is not None:
-                ckpt_mgr.save(r + 1, state)
+                ckpt_mgr.save(r + 1, state,
+                              metadata={"cost": cost.snapshot_totals(),
+                                        "batching": getattr(
+                                            args, "batching", "epoch")})
 
         fin_rec = None
         # checkpoints are saved inside the round loop (pre-finalize), so a

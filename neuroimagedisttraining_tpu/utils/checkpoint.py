@@ -38,14 +38,62 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, round_idx: int, state: Any, force: bool = False) -> bool:
-        """Save ``state`` under step ``round_idx`` (respects save_every)."""
+    def save(self, round_idx: int, state: Any, force: bool = False,
+             metadata: Optional[dict] = None) -> bool:
+        """Save ``state`` under step ``round_idx`` (respects save_every).
+
+        ``metadata``: small JSON-serializable sidecar saved next to the
+        step (e.g. cumulative cost counters — for evolving-mask algorithms
+        the replayed rounds had different densities, so a resumed run must
+        restore the exact totals rather than re-estimate them from the
+        final density)."""
         if not force and round_idx % self.save_every:
             return False
         self.mgr.save(
             round_idx, args=self._ocp.args.StandardSave(state))
         self.mgr.wait_until_finished()
+        if metadata is not None:
+            import json
+            import os
+
+            path = os.path.join(self.directory, f"meta_{round_idx}.json")
+            tmp = path + ".tmp"
+            # atomic publish: a SIGKILL mid-write (the SLURM-preemption case
+            # this checkpointing exists for) must not leave a truncated
+            # sidecar that breaks every subsequent --resume
+            with open(tmp, "w") as f:
+                json.dump(metadata, f)
+            os.replace(tmp, path)
+            # prune sidecars whose orbax step was garbage-collected
+            # (max_to_keep), so a long run doesn't accumulate thousands of
+            # orphaned meta files
+            alive = set(self.mgr.all_steps())
+            import glob as _glob
+            import re as _re
+
+            for p in _glob.glob(os.path.join(self.directory, "meta_*.json")):
+                m = _re.match(r"meta_(\d+)\.json$", os.path.basename(p))
+                if m and int(m.group(1)) not in alive:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
         return True
+
+    def load_metadata(self, round_idx: int) -> Optional[dict]:
+        import json
+        import os
+
+        path = os.path.join(self.directory, f"meta_{round_idx}.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (ValueError, OSError):
+            logger.warning("unreadable checkpoint metadata %s; falling back "
+                           "to estimated cost counters", path)
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self.mgr.latest_step()

@@ -242,6 +242,30 @@ class CostTracker:
         self.per_round.append(rec)
         return rec
 
+    def snapshot_totals(self) -> Dict[str, float]:
+        """JSON-serializable totals for the checkpoint metadata sidecar."""
+        last = self.per_round[-1] if self.per_round else None
+        return {
+            "sum_training_flops": self.sum_training_flops,
+            "sum_comm_params": self.sum_comm_params,
+            "last_training_flops": last["training_flops"] if last else 0.0,
+            "last_comm_params": last["comm_params"] if last else 0,
+        }
+
+    def restore_totals(self, meta: Dict[str, float]) -> None:
+        """Seed the counters from a checkpoint sidecar — exact for
+        evolving-mask algorithms, where re-estimating the pre-checkpoint
+        rounds from the restored state's current density would diverge
+        from the uninterrupted run's totals."""
+        self.sum_training_flops = float(meta["sum_training_flops"])
+        self.sum_comm_params = int(meta["sum_comm_params"])
+        self.per_round = [{
+            "training_flops": float(meta["last_training_flops"]),
+            "comm_params": int(meta["last_comm_params"]),
+            "sum_training_flops": self.sum_training_flops,
+            "sum_comm_params": self.sum_comm_params,
+        }]
+
     def record_repeat(self) -> Dict[str, float]:
         """Accumulate another round identical to the last recorded one —
         avoids the device→host param pull when masks are static (dense

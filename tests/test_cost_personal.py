@@ -1,0 +1,168 @@
+"""Cost-accounting representative selection, checkpoint cost sidecar, and
+FedAvg --track_personal 0 (advisor round-2 findings)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms.fedavg import FedAvg
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data.types import FederatedData
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.utils.flops import CostTracker
+
+
+def _tiny_data(n_clients=4, n=24, d=32, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n_clients, n, d, d, 3).astype(np.float32)
+    y = rng.randint(0, classes, size=(n_clients, n))
+    counts = np.full((n_clients,), n, np.int32)
+    return FederatedData(
+        x_train=jnp.asarray(x), y_train=jnp.asarray(y),
+        n_train=jnp.asarray(counts),
+        x_test=jnp.asarray(x[:, :8]), y_test=jnp.asarray(y[:, :8]),
+        n_test=jnp.asarray(np.full((n_clients,), 8, np.int32)),
+        class_num=classes,
+    )
+
+
+class _StackedMaskState:
+    """Duck-typed state: stacked per-client masks with systematically
+    different densities (the DisPFL --diff_spa shape)."""
+
+    def __init__(self, densities, rng=np.random.RandomState(0)):
+        c = len(densities)
+        leaves = []
+        for size in (400, 600):
+            m = np.zeros((c, size), np.float32)
+            for i, d in enumerate(densities):
+                k = int(round(d * size))
+                m[i, rng.choice(size, k, replace=False)] = 1.0
+            leaves.append(jnp.asarray(m))
+        self.masks = {"a": leaves[0], "b": leaves[1]}
+        self.personal_params = {"a": jnp.arange(c, dtype=jnp.float32)[:, None]
+                                * jnp.ones((1, 400)),
+                                "b": jnp.ones((c, 600))}
+
+
+def test_cost_snapshot_picks_mean_density_client():
+    # client 0 is the sparsest; the cohort-mean-density client is #2
+    densities = [0.2, 0.4, 0.6, 0.8, 1.0]
+
+    class Algo:
+        cost_snapshot = FedAvg.cost_snapshot
+
+    state = _StackedMaskState(densities)
+    params, mask = Algo().cost_snapshot(state)
+    got_density = float(
+        sum(jnp.sum(m) for m in jax.tree_util.tree_leaves(mask))) / 1000.0
+    # representative density must be the closest to the cohort mean (0.6),
+    # not client 0's 0.2
+    assert abs(got_density - 0.6) < 0.05
+    # params slice must come from the same client
+    assert float(params["a"][0]) == pytest.approx(2.0)
+
+
+def test_cost_tracker_totals_roundtrip():
+    t = CostTracker()  # model-less: flops zero, comm counted
+    t.record_round({"w": np.ones((4, 4))}, n_clients=3)
+    t.record_round({"w": np.ones((4, 4))}, n_clients=2)
+    meta = t.snapshot_totals()
+
+    fresh = CostTracker()
+    fresh.restore_totals(meta)
+    assert fresh.sum_comm_params == t.sum_comm_params
+    assert fresh.sum_training_flops == t.sum_training_flops
+    # record_repeat must extend from the restored last-round record
+    before = fresh.sum_comm_params
+    rec = fresh.record_repeat()
+    assert rec["comm_params"] == 2 * 16
+    assert fresh.sum_comm_params == before + 2 * 16
+
+
+def test_checkpoint_metadata_sidecar(tmp_path):
+    from neuroimagedisttraining_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "run")
+    state = {"w": jnp.ones((3,))}
+    mgr.save(2, state, metadata={"cost": {"sum_training_flops": 7.5,
+                                          "sum_comm_params": 11,
+                                          "last_training_flops": 2.5,
+                                          "last_comm_params": 4}})
+    meta = mgr.load_metadata(2)
+    assert meta["cost"]["sum_comm_params"] == 11
+    assert mgr.load_metadata(1) is None
+    mgr.close()
+
+
+def test_checkpoint_sidecar_pruned_with_steps(tmp_path):
+    from neuroimagedisttraining_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "run", max_to_keep=2)
+    state = {"w": jnp.ones((3,))}
+    for step in (1, 2, 3, 4):
+        mgr.save(step, state, metadata={"cost": {}, "batching": "epoch"})
+    import glob
+    import os
+
+    names = sorted(os.path.basename(p) for p in
+                   glob.glob(str(tmp_path / "run" / "meta_*.json")))
+    # orbax keeps the last 2 steps; orphaned sidecars must be pruned
+    assert names == ["meta_3.json", "meta_4.json"]
+    mgr.close()
+
+
+def test_resume_batching_mismatch_refused(tmp_path):
+    from neuroimagedisttraining_tpu.experiments.runner import run_experiment
+
+    common = ["--algo", "local", "--model", "small3dcnn",
+              "--dataset", "synthetic", "--client_num_in_total", "2",
+              "--frac", "1.0", "--epochs", "1", "--batch_size", "4",
+              "--comm_round", "1", "--frequency_of_the_test", "0",
+              "--checkpoint_dir", str(tmp_path / "ck"),
+              "--results_dir", "", "--log_dir", str(tmp_path / "log")]
+    from neuroimagedisttraining_tpu.experiments.config import parse_args
+
+    run_experiment(parse_args(common))
+    # resuming the epoch-batching lineage under replacement semantics (the
+    # identity gains no 'wr' part on --resume lookups of... actually the
+    # 'wr' tag splits the lineage; simulate the unmarked case by forcing
+    # the same checkpoint dir) must be refused, not silently continued
+    args2 = parse_args(common + ["--comm_round", "2", "--resume",
+                                 "--batching", "replacement"])
+    # same identity dir is required to reach the guard ('wr' would split
+    # the lineage): point the runner's identity at the epoch lineage
+    # (runner.py binds run_identity at import, so patch its module global)
+    from neuroimagedisttraining_tpu.experiments import runner as runner_mod
+
+    orig = runner_mod.run_identity
+
+    def same_identity(a, algo=None, for_checkpoint=False):
+        a2 = type(a)(**{**vars(a), "batching": "epoch"})
+        return orig(a2, algo, for_checkpoint)
+
+    runner_mod.run_identity = same_identity
+    try:
+        import pytest as _pytest
+
+        with _pytest.raises(SystemExit, match="batching"):
+            run_experiment(args2)
+    finally:
+        runner_mod.run_identity = orig
+
+
+def test_fedavg_track_personal_off():
+    data = _tiny_data()
+    hp = HyperParams(lr=0.05, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = FedAvg(create_model("cnn_cifar10", num_classes=2), data, hp,
+                  loss_type="ce", frac=1.0, track_personal=False)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    assert state.personal_params is None
+    state, rec = algo.run_round(state, 0)
+    assert np.isfinite(float(rec["train_loss"]))
+    ev = algo.evaluate(state)
+    assert "global_acc" in ev and "personal_acc" not in ev
+    # finalize (the fine-tune that exists to build personal models) no-ops
+    state2, final = algo.finalize(state)
+    assert final is None

@@ -1,0 +1,171 @@
+"""Per-client epoch batching semantics (reference parity).
+
+The reference iterates each client's own ``DataLoader(shuffle=True)`` —
+``ceil(n_i/batch)`` batches per epoch, the last one partial, loss averaged
+over the batch's own size (``my_model_trainer.py:194-216``,
+``ABCD/data_loader.py:202``). These tests pin the TPU rebuild's static-shape
+implementation (``core/trainer.py`` epoch mode) to those semantics exactly:
+
+* every valid sample is consumed exactly once per epoch (permutation test +
+  one-hot visit test);
+* each client runs exactly its own ``ceil(n_i/batch)`` optimizer steps per
+  epoch regardless of the cohort-wide scan bound (scalar-bias model whose
+  gradient is independent of batch composition, so the step count is
+  recoverable to float precision — including frozen momentum on no-op steps);
+* the partial final batch averages over its own ``n_i mod batch`` examples
+  (exact numpy replication using the extracted permutations).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.core.trainer import (
+    epoch_permutations,
+    make_client_update,
+)
+
+
+def test_epoch_permutations_cover_each_sample_once():
+    n_valid, epochs, length = 13, 4, 24
+    perms = np.asarray(epoch_permutations(
+        jax.random.PRNGKey(3), jnp.int32(n_valid), epochs, length))
+    assert perms.shape == (epochs, length)
+    for e in range(epochs):
+        # first n_valid slots: a permutation of the valid row indices
+        assert sorted(perms[e, :n_valid].tolist()) == list(range(n_valid))
+        # the rest point at padded rows and get masked by batch weights
+        assert (perms[e, n_valid:] >= n_valid).all()
+    # epochs are shuffled independently
+    assert not np.array_equal(perms[0, :n_valid], perms[1, :n_valid])
+
+
+def test_truncated_epoch_samples_whole_shard():
+    # steps_per_epoch*batch smaller than the shard: each epoch must draw a
+    # fresh random subset of ALL valid rows, not a fixed index prefix
+    n_valid, n_rows, length, epochs = 200, 220, 64, 8
+    perms = np.asarray(epoch_permutations(
+        jax.random.PRNGKey(0), jnp.int32(n_valid), epochs, length,
+        n_rows=n_rows))
+    assert perms.shape == (epochs, length)
+    seen = set()
+    for e in range(epochs):
+        sub = perms[e]
+        assert (sub < n_valid).all()  # valid rows only (length < n_valid)
+        assert len(set(sub.tolist())) == length  # without replacement
+        seen.update(sub.tolist())
+    # across a few epochs the union covers far more than one prefix
+    assert len(seen) > 150
+
+
+def _bias_apply(params, x, train, rng):
+    # one logit per example, equal to the scalar bias — the BCE gradient
+    # d/db mean(sigmoid(b) - y) is independent of WHICH examples are in the
+    # batch, so parameter trajectories depend only on the number of active
+    # optimizer steps.
+    del train, rng
+    return jnp.broadcast_to(params["b"], (x.shape[0], 1))
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_per_client_step_counts_match_reference(momentum):
+    # unequal sites: ceil(10/8)=2, ceil(50/8)=7, ceil(56/8)=7 steps/epoch
+    n_clients = [10, 50, 56]
+    bs, epochs = 8, 2
+    n_max = max(n_clients)
+    spe = -(-n_max // bs)
+    hp = HyperParams(lr=0.3, lr_decay=1.0, momentum=momentum,
+                     weight_decay=0.0, grad_clip=1e9, local_epochs=epochs,
+                     steps_per_epoch=spe, batch_size=bs, batching="epoch")
+    update = make_client_update(_bias_apply, "bce", hp)
+
+    x = jnp.zeros((n_max, 1))
+    y = jnp.ones((n_max,))
+    for n_i in n_clients:
+        params = {"b": jnp.zeros(())}
+        mom = {"b": jnp.zeros(())}
+        out_params, out_mom, _ = jax.jit(update)(
+            params, mom, {"b": jnp.ones(())}, jax.random.PRNGKey(0),
+            x, y, jnp.int32(n_i), jnp.int32(0), params)
+        # numpy replication of exactly ceil(n_i/bs) steps per epoch
+        b, m = 0.0, 0.0
+        ref_steps = epochs * (-(-n_i // bs))
+        for _ in range(ref_steps):
+            g = 1.0 / (1.0 + np.exp(-b)) - 1.0  # d BCE/d logit, labels=1
+            m = momentum * m + g
+            b = b - hp.lr * m
+        np.testing.assert_allclose(float(out_params["b"]), b, rtol=1e-5)
+        # momentum must be FROZEN on masked no-op steps, not decayed
+        np.testing.assert_allclose(float(out_mom["b"]), m, rtol=1e-5)
+
+
+def test_every_sample_visited_padded_rows_untouched():
+    # one-hot inputs: pred_i = w[sample_id]; a sample's weight moves iff the
+    # sample was drawn. After one epoch every valid id must have moved and
+    # every padded id must be bit-identical.
+    n_valid, n_max, bs = 11, 16, 4
+    spe = -(-n_valid // bs)  # 3 (runner uses the cohort max; equal here)
+    hp = HyperParams(lr=0.1, lr_decay=1.0, momentum=0.0, weight_decay=0.0,
+                     grad_clip=1e9, local_epochs=1, steps_per_epoch=spe,
+                     batch_size=bs, batching="epoch")
+
+    def apply_fn(params, xb, train, rng):
+        del train, rng
+        return xb @ params["w"]  # [k] predictions
+
+    update = make_client_update(apply_fn, "mse", hp,
+                                mask_params_post_step=False)
+    w0 = jnp.arange(1.0, n_max + 1.0)
+    x = jnp.eye(n_max)
+    y = jnp.zeros((n_max,))
+    out, _, _ = jax.jit(update)(
+        {"w": w0}, {"w": jnp.zeros(n_max)}, {"w": jnp.ones(n_max)},
+        jax.random.PRNGKey(7), x, y, jnp.int32(n_valid), jnp.int32(0),
+        {"w": w0})
+    moved = np.asarray(out["w"]) != np.asarray(w0)
+    assert moved[:n_valid].all(), "every valid sample trains once per epoch"
+    assert not moved[n_valid:].any(), "padded rows must never train"
+
+
+def test_partial_batch_mean_exact_numpy_replication():
+    # full white-box replication: extract the epoch permutations with the
+    # same key derivation as client_update and simulate the reference's
+    # loop (partial last batch averaged over its own size) in numpy.
+    n_valid, n_max, bs, epochs = 10, 12, 8, 2
+    spe = -(-n_valid // bs)  # 2: one full batch + one 2-example batch
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.0, weight_decay=0.0,
+                     grad_clip=1e9, local_epochs=epochs, steps_per_epoch=spe,
+                     batch_size=bs, batching="epoch")
+
+    def apply_fn(params, xb, train, rng):
+        del train, rng
+        return xb @ params["w"]
+
+    update = make_client_update(apply_fn, "mse", hp,
+                                mask_params_post_step=False)
+    rng = jax.random.PRNGKey(11)
+    w0 = np.linspace(-1.0, 1.0, n_max).astype(np.float32)
+    x = jnp.eye(n_max)
+    y = jnp.zeros((n_max,))
+    out, _, mean_loss = jax.jit(update)(
+        {"w": jnp.asarray(w0)}, {"w": jnp.zeros(n_max)},
+        {"w": jnp.ones(n_max)}, rng, x, y, jnp.int32(n_valid),
+        jnp.int32(0), {"w": jnp.asarray(w0)})
+
+    k_perm, _ = jax.random.split(rng)
+    perms = np.asarray(epoch_permutations(
+        k_perm, jnp.int32(n_valid), epochs, spe * bs))
+    w = w0.copy()
+    losses = []
+    for e in range(epochs):
+        order = perms[e, :n_valid]
+        for b0 in range(0, n_valid, bs):
+            ids = order[b0:b0 + bs]
+            per_ex = w[ids] ** 2  # mse vs target 0
+            losses.append(per_ex.mean())
+            grad = np.zeros_like(w)
+            grad[ids] = 2.0 * w[ids] / len(ids)  # mean over the batch's OWN size
+            w = w - hp.lr * grad
+    np.testing.assert_allclose(np.asarray(out["w"]), w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(mean_loss), np.mean(losses), rtol=1e-5)
