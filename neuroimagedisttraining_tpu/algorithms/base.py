@@ -93,7 +93,9 @@ class FedAlgorithm(abc.ABC):
         self.init_sample_shape = tuple(data.sample_shape) + (
             (1,) if channel_inject else ())
         if hp.batching == "epoch":
-            n_biggest = int(np.max(np.asarray(data.n_train)))
+            from ..parallel.multihost import host_client_counts
+
+            n_biggest = int(np.max(host_client_counts(data.n_train)))
             budget = hp.steps_per_epoch * hp.batch_size
             if budget < n_biggest:
                 logger.warning(
@@ -174,6 +176,19 @@ class FedAlgorithm(abc.ABC):
         return params, mask
 
     # -- shared helpers -------------------------------------------------------
+    def _full_batches(self, hp: Optional[HyperParams] = None) -> bool:
+        """Static guarantee for core.trainer's epoch fast path: every
+        client's shard covers steps_per_epoch*batch_size samples, so all
+        batches are full and all steps active (checked host-side on the
+        concrete counts at build time; bit-identical semantics)."""
+        hp = hp or self.hp
+        if hp.batching != "epoch":
+            return False
+        from ..parallel.multihost import host_client_counts
+
+        n = host_client_counts(self.data.n_train)
+        return bool((n >= hp.steps_per_epoch * hp.batch_size).all())
+
     def _vmap_clients(self, fn, in_axes):
         """vmap ``fn`` over the leading client axis, optionally chunked.
 

@@ -70,6 +70,7 @@ class DisPFL(FedAlgorithm):
                  sparsity_distribution: str = "erk",
                  different_initial: bool = False, diff_spa: bool = False,
                  dis_gradient_check: bool = False,
+                 record_local_tests: bool = True,
                  **kwargs):
         """Mask-init variants (``dispfl_api.py:48-71``):
         ``sparsity_distribution``: "erk" (default) or "uniform"
@@ -97,13 +98,19 @@ class DisPFL(FedAlgorithm):
         # weights instead of by |grad| (and skip the screening batch) —
         # DisPFL/client.py:54,91-98
         self.dis_gradient_check = dis_gradient_check
+        # record_local_tests: the reference tests every client locally
+        # around local training EVERY round (dispfl_api.py:150-155) — kept
+        # as the default; disable to drop the two per-round full-cohort
+        # test passes when eval cost matters (the runner turns it off at
+        # --frequency_of_the_test 0)
+        self.record_local_tests = record_local_tests
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=True, mask_params_post_step=True,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(),
         )
         loss_fn = make_loss_fn(self.loss_type)
 
@@ -121,8 +128,20 @@ class DisPFL(FedAlgorithm):
                                                 rng=k_drop), yb)
             )(params)
 
+        eval_client = self.eval_client
+
+        def local_test_means(params_stack, x_test, y_test, n_test):
+            """Per-client local test, reported as the reference's means:
+            acc = mean_c(correct_c/total_c), loss = mean_c(loss_c/total_c)
+            (dispfl_api.py:242-301)."""
+            correct, loss_sum, total = jax.vmap(eval_client)(
+                params_stack, x_test, y_test, n_test)
+            totals = jnp.maximum(total, 1).astype(jnp.float32)
+            return (jnp.mean(correct.astype(jnp.float32) / totals),
+                    jnp.mean(loss_sum / totals))
+
         def round_fn(state: DisPFLState, adjacency, active_vec, round_idx,
-                     x_train, y_train, n_train):
+                     x_train, y_train, n_train, x_test, y_test, n_test):
             rng, k_train, k_screen = jax.random.split(state.rng, 3)
             params, masks = state.personal_params, state.masks
 
@@ -151,11 +170,27 @@ class DisPFL(FedAlgorithm):
 
             w_local = pick_active(w_agg, params)
 
+            # per-round local test of the aggregated model BEFORE local
+            # training ("new mask" series, dispfl_api.py:150-151,271-301)
+            nanv = jnp.float32(jnp.nan)
+            pre_acc = pre_loss = nanv
+            if self.record_local_tests:
+                pre_acc, pre_loss = local_test_means(
+                    w_local, x_test, y_test, n_test)
+
             # --- masked local SGD ----------------------------------------
             trained, _, losses = self._train_stacked(
                 self.client_update, w_local, masks, round_idx, k_train,
                 x_train, y_train, n_train,
             )
+
+            # per-round local test AFTER local training, before mask
+            # evolution — the tst_results each client.train returns
+            # ("old mask" series, dispfl_api.py:154-155,242-269)
+            post_acc = post_loss = nanv
+            if self.record_local_tests:
+                post_acc, post_loss = local_test_means(
+                    trained, x_test, y_test, n_test)
 
             # --- mask evolution (fire/regrow, client.py:55-99) -----------
             if self.static_masks:
@@ -201,6 +236,7 @@ class DisPFL(FedAlgorithm):
                 DisPFLState(personal_params=trained, masks=new_masks,
                             rng=rng),
                 jnp.mean(losses), ham,
+                (pre_acc, pre_loss, post_acc, post_loss),
             )
 
         self._round_jit = jax.jit(round_fn)
@@ -254,12 +290,24 @@ class DisPFL(FedAlgorithm):
             round_idx, self.num_clients, self.clients_per_round,
             mode=self.neighbor_mode, active=active_vec,
         )
-        state, loss, ham = self._round_jit(
+        state, loss, ham, local_tests = self._round_jit(
             state, jnp.asarray(adj), jnp.asarray(active_vec),
             jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
+            self.data.x_test, self.data.y_test, self.data.n_test,
         )
-        return state, {"train_loss": loss, "mask_change": ham}
+        pre_acc, pre_loss, post_acc, post_loss = local_tests
+        rec = {"train_loss": loss, "mask_change": ham}
+        if self.record_local_tests:
+            # reference stat_info key names (dispfl_api.py:269,301):
+            # "old_mask" = after local training, "new_mask" = the
+            # aggregated model under the refreshed shared mask, before
+            # local training
+            rec.update(new_mask_test_acc=pre_acc,
+                       new_mask_test_loss=pre_loss,
+                       old_mask_test_acc=post_acc,
+                       old_mask_test_loss=post_loss)
+        return state, rec
 
     def evaluate(self, state: DisPFLState) -> Dict[str, Any]:
         ev = self._eval_personal(

@@ -52,13 +52,14 @@ class Ditto(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(),
         )
         self.personal_update = make_client_update(
             self.apply_fn, self.loss_type, self._personal_hp or self.hp,
             mask_grads=False, mask_params_post_step=False,
             prox_lambda=self.lamda,
             remat=self.remat_local,
+            full_batches=self._full_batches(self._personal_hp or self.hp),
         )
 
         def round_fn(state: DittoState, sel_idx, round_idx,

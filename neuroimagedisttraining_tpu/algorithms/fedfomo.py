@@ -59,7 +59,7 @@ class FedFomo(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(),
         )
         self._n_nei = min(self.clients_per_round, self.num_clients - 1)
 

@@ -30,7 +30,7 @@ class LocalOnly(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(),
         )
 
         def round_fn(state: LocalOnlyState, sel_idx, round_idx,

@@ -63,6 +63,7 @@ class SalientGrads(FedAlgorithm):
             mask_grads=False, mask_params_post_step=True,
             remat=self.remat_local,
             fused_kernels=self.fused_kernels,
+            full_batches=self._full_batches(),
         )
         self.snip_scores = make_snip_score_fn(
             self.apply_fn, self.loss_type, self.hp.batch_size,

@@ -66,13 +66,14 @@ class SubAvg(FedAlgorithm):
         self._update_first = make_client_update(
             self.apply_fn, self.loss_type, hp_first,
             mask_grads=True, mask_params_post_step=False,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(hp_first),
         )
         self._update_rest = (
             make_client_update(
                 self.apply_fn, self.loss_type, hp_rest,
                 mask_grads=True, mask_params_post_step=False,
                 remat=self.remat_local,
+                full_batches=self._full_batches(hp_rest),
             )
             if hp_rest.local_epochs > 0 else None
         )

@@ -51,7 +51,7 @@ class TurboAggregate(FedAlgorithm):
         self.client_update = make_client_update(
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
-            remat=self.remat_local,
+            remat=self.remat_local, full_batches=self._full_batches(),
         )
 
         def local_fn(global_params, sel_idx, round_idx, round_key,

@@ -68,6 +68,7 @@ def make_client_update(
     prox_lambda: float = 0.0,
     remat: bool = False,
     fused_kernels: bool = False,
+    full_batches: bool = False,
 ):
     """Build the per-client local-training function.
 
@@ -82,6 +83,11 @@ def make_client_update(
     concurrently under the vmap (``client_chunk`` can rise).
     ``fused_kernels``: route the optimizer update through the Pallas fused
     masked-SGD kernel (ops/pallas_kernels.py) instead of the XLA chain.
+    ``full_batches``: caller-asserted static guarantee that EVERY client's
+    ``n_valid >= steps_per_epoch * batch_size`` (checkable host-side from
+    the concrete shard counts). Epoch mode then skips the provably-no-op
+    machinery — per-example batch weights, active-step selects — with
+    bit-identical semantics (every batch is full, every step active).
 
     Returns ``client_update(params, momentum, mask, rng, x, y, n_valid,
     round_idx, prox_target) -> (params, momentum, mean_loss)``; vmap over a
@@ -155,11 +161,18 @@ def make_client_update(
                 # spe*bs > n_rows; clamp (their loss terms are masked by wb
                 # anyway, but jnp.take's default OOB fill is NaN)
                 idx = jnp.minimum(idx, x.shape[0] - 1)
+                xb = jnp.take(x, idx, axis=0)
+                yb = jnp.take(y, idx, axis=0)
+                if full_batches:
+                    # statically guaranteed: every batch full, every step
+                    # active — same math without the masking machinery
+                    loss, grads = grad_fn(params, xb, yb, None, k_drop)
+                    params, momentum = apply_update(
+                        params, momentum, grads, mask, prox_target, lr)
+                    return (params, momentum), (loss, jnp.bool_(True))
                 # validity of this batch's slots within the client's epoch
                 offs = pos * bs + jnp.arange(bs)
                 wb = offs < n_valid
-                xb = jnp.take(x, idx, axis=0)
-                yb = jnp.take(y, idx, axis=0)
                 loss, grads = grad_fn(params, xb, yb, wb, k_drop)
                 new_params, new_momentum = apply_update(
                     params, momentum, grads, mask, prox_target, lr)
@@ -209,21 +222,25 @@ def make_eval_fn(apply_fn: ApplyFn, loss_type: str, eval_batch: int = 32):
 
     def eval_client(params, x, y, n_valid):
         m_max = x.shape[0]
-        pad = (-m_max) % eval_batch
+        # never batch wider than the shard: tiny test shards (small ABCD
+        # sites) would otherwise be padded up to eval_batch and burn a
+        # full-width forward on padding rows
+        eb = min(eval_batch, m_max)
+        pad = (-m_max) % eb
         if pad:  # static — pad the shard so chunking is exact
             x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
             y = jnp.pad(y, [(0, pad)])
             m_max += pad
-        nb = m_max // eval_batch
+        nb = m_max // eb
 
         def body(carry, i):
             correct, loss_sum = carry
-            start = i * eval_batch
-            xb = lax.dynamic_slice_in_dim(x, start, eval_batch, axis=0)
-            yb = lax.dynamic_slice_in_dim(y, start, eval_batch, axis=0)
+            start = i * eb
+            xb = lax.dynamic_slice_in_dim(x, start, eb, axis=0)
+            yb = lax.dynamic_slice_in_dim(y, start, eb, axis=0)
             logits = apply_fn(params, xb, train=False, rng=None)
             preds = predictions(logits, loss_type)
-            valid = (start + jnp.arange(eval_batch)) < n_valid
+            valid = (start + jnp.arange(eb)) < n_valid
             correct += jnp.sum((preds == yb.astype(jnp.int32)) & valid)
             # per-example loss, masked by validity
             per_ex = PER_EXAMPLE_LOSSES[loss_type](logits, yb)

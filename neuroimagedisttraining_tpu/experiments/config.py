@@ -309,14 +309,19 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         parts.append(f"nb{args.norm_bound:g}")
         if args.defense_type == "weak_dp":
             parts.append(f"sd{args.stddev:g}")
-    if getattr(args, "batching", "epoch") != "epoch":
-        parts.append("wr")  # with-replacement draws train differently
-    if getattr(args, "eval_clients", 0):
-        # sampled-eval changes the metric protocol — runs must not share
-        # stat_info/log lineage with full-cohort evals
-        parts.append(f"evK{args.eval_clients}")
-    if getattr(args, "data_dtype", ""):
-        parts.append(f"dt{args.data_dtype}")  # volumes stored in this dtype
+    if not for_checkpoint:
+        # these knobs change the metric protocol / training draw, so log
+        # and stat_info lineages must split — but the checkpointed STATE
+        # (f32 master params + rng) is interchangeable across them, so the
+        # checkpoint identity excludes them (like r{comm_round}): legacy
+        # lineages stay resumable, and a cross-mode --batching resume is
+        # caught by the checkpoint metadata guard in the runner instead
+        if getattr(args, "batching", "epoch") != "epoch":
+            parts.append("wr")  # with-replacement draws train differently
+        if getattr(args, "eval_clients", 0):
+            parts.append(f"evK{args.eval_clients}")
+        if getattr(args, "data_dtype", ""):
+            parts.append(f"dt{args.data_dtype}")
     if not getattr(args, "final_finetune", 1):
         parts.append("noft")
     if algo == "fedavg" and not getattr(args, "track_personal", 1):

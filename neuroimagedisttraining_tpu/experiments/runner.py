@@ -136,17 +136,19 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
     num_outputs = 1 if loss_type == "bce" else data.class_num
     model = create_model(model_key, num_classes=num_outputs)
 
+    from ..parallel.multihost import host_client_counts
+
+    counts = host_client_counts(data.n_train)  # multi-host-safe fetch
     batching = getattr(args, "batching", "epoch")
     if batching == "epoch":
         # reference semantics: each client iterates its own loader —
         # ceil(n_i/batch) shuffled batches per epoch (my_model_trainer.py:
         # 194-216). The static scan bound is the largest client's count;
         # smaller clients' excess steps are masked no-ops (core/trainer.py).
-        n_bound = int(np.max(np.asarray(data.n_train)))
+        n_bound = int(np.max(counts))
         steps_per_epoch = max(1, -(-n_bound // args.batch_size))
     else:  # legacy with-replacement draws: uniform mean-derived step count
-        n_mean = int(np.mean(np.asarray(data.n_train)))
-        steps_per_epoch = max(1, n_mean // args.batch_size)
+        steps_per_epoch = max(1, int(np.mean(counts)) // args.batch_size)
     hp = HyperParams(
         lr=args.lr, lr_decay=args.lr_decay, momentum=args.momentum,
         weight_decay=args.wd, grad_clip=args.grad_clip,
@@ -202,7 +204,11 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                                                False),
                      diff_spa=getattr(args, "diff_spa", False),
                      dis_gradient_check=getattr(args, "dis_gradient_check",
-                                                False))
+                                                False),
+                     # frequency_of_the_test=0 disables ALL eval cost,
+                     # including the reference's per-round local tests
+                     record_local_tests=bool(
+                         getattr(args, "frequency_of_the_test", 1)))
     elif algo_name == "dpsgd":
         extra = dict(neighbor_mode=args.cs)
     elif algo_name == "subavg":
@@ -326,6 +332,12 @@ def save_stat_info(args: argparse.Namespace, identity: str,
                             if "global_acc" in h],
         "person_test_acc": [h.get("personal_acc") for h in history
                             if "personal_acc" in h],
+        # DisPFL per-round local-test series around local training
+        # (dispfl_api.py:150-155,269,301)
+        "old_mask_test_acc": [h["old_mask_test_acc"] for h in history
+                              if "old_mask_test_acc" in h],
+        "new_mask_test_acc": [h["new_mask_test_acc"] for h in history
+                              if "new_mask_test_acc" in h],
         # stat_info cost counters (sailentgrads_api.py:334-346)
         "sum_training_flops": getattr(cost, "sum_training_flops", 0.0),
         "sum_comm_params": getattr(cost, "sum_comm_params", 0),
@@ -424,8 +436,10 @@ def run_experiment(args: argparse.Namespace,
             # epoch (the reference's epochs*samples approximation,
             # sailentgrads/client.py:70-76); cohort mean is the per-client
             # stand-in for the sampled subset
+            from ..parallel.multihost import host_client_counts
+
             samples_per_client = algo.hp.local_epochs * int(
-                np.mean(np.asarray(data.n_train)))
+                np.mean(host_client_counts(data.n_train)))
         if start_round > 0:
             meta = (ckpt_mgr.load_metadata(start_round)
                     if ckpt_mgr is not None else None)
@@ -445,13 +459,16 @@ def run_experiment(args: argparse.Namespace,
                 logger.warning(
                     "checkpoint has no recorded batching mode (pre-round-3 "
                     "lineage, with-replacement semantics); continuing with "
-                    "--batching %s — pass --batching replacement if the "
-                    "original semantics must be preserved", batching)
-            if meta and "cost" in meta:
+                    "--batching %s — rerun with --batching replacement to "
+                    "preserve the original semantics (same checkpoint "
+                    "lineage; logs/stat_info split under the 'wr' tag)",
+                    batching)
+            cost_meta = (meta or {}).get("cost") or {}
+            if "sum_training_flops" in cost_meta:
                 # exact totals persisted at save time (required for
                 # evolving-mask algorithms whose replayed rounds had
                 # different densities than the restored state)
-                cost.restore_totals(meta["cost"])
+                cost.restore_totals(cost_meta)
             else:
                 # legacy checkpoint without a sidecar: estimate the
                 # pre-checkpoint rounds from the restored state's snapshot

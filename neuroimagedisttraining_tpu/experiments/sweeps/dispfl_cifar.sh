@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# DisPFL CIFAR/tiny grids — translation of the reference's
+# fedml_experiments/standalone/DisPFL/Jobs-style scripts:
+#   dispflsparsitywithoutiteration{70,80,90,95}sps.sh (cifar10),
+#   CIFAR100dispflsparsitywithoutiteration{70,80,90,95}sps.sh,
+#   cifar10.sh / cifar100.sh / tiny.sh  (canonical dense_ratio 0.3 /
+#   dir alpha 0.3 (cifar100: 0.2) / bs 16 / lr 0.1 / 5 epochs /
+#   100 clients frac 0.1 / 500 rounds / seed 2022).
+#
+# Usage: bash dispfl_cifar.sh [cifar10|cifar100|tiny_imagenet] [rounds]
+set -euo pipefail
+DATASET="${1:-cifar10}"
+ROUNDS="${2:-500}"
+ALPHA=0.3
+[ "$DATASET" = cifar100 ] && ALPHA=0.2
+
+for DENSE in 0.05 0.1 0.2 0.3 0.5; do          # 95/90/80/70sps + default
+  python -m neuroimagedisttraining_tpu.experiments.main_dispfl \
+    --model resnet18 --dataset "$DATASET" \
+    --partition_method dir --partition_alpha "$ALPHA" \
+    --batch_size 16 --lr 0.1 --lr_decay 0.998 --epochs 5 \
+    --dense_ratio "$DENSE" --cs random \
+    --client_num_in_total 100 --frac 0.1 \
+    --comm_round "$ROUNDS" --seed 2022 \
+    --compute_dtype bfloat16 --checkpoint_dir ckpts --resume
+done

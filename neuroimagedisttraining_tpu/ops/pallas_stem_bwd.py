@@ -1,0 +1,241 @@
+"""Fused stem-stage backward: dzs from pool-scatter + stat terms, one pass.
+
+The pool-first stem stage (``models/alexnet3d.py::S2DStemStage``) consumes
+the full-size conv output ``zs`` through exactly three reductions: the
+3x3x3/s3 max-pool and the GroupNorm statistics sums S1 = sum(zs),
+S2 = sum(zs^2) (per sample x channel). Under XLA the backward of that trio
+costs three full-size passes — SelectAndScatter (~2.2 ms/step on the v5e),
+the GN sum backward (~1.3 ms) — because each re-reads the 253 MB tensor.
+
+``pool_sum_sumsq`` exposes the trio as ONE custom-vjp op whose backward is
+a single Pallas pass: read zs once, emit
+    dzs = gS1_c + 2 * gS2_c * zs + equal_mask * gm / tie_count
+directly. The pool argmax is recovered by comparing zs to the pooled
+forward value (saved residual); bf16 ties inside a window split the
+cotangent evenly (torch/XLA scatter to the first max instead — an
+equivalent subgradient; measurably different only at exact-tie positions,
+which the equivalence test handles by masking ties).
+
+Forward stays XLA (its conv+pool+stats fusion already runs at the
+bandwidth wall — RESULTS.md r2/r3: every Pallas forward formulation tried,
+including the r3 staged-unfold family, only ties it).
+
+MEASURED r3 STATUS (v5e, in-graph fori-loop timings, RESULTS.md r3):
+gradient EXACT vs XLA's VJP on every non-tied window (max abs diff 0.0;
+~10% of bf16 windows contain ties, where the even-split cotangent differs
+from XLA's scatter-to-first — both valid subgradients, total mass
+conserved to 1.5e-5) — but the kernel LOSES decisively: fused fwd+bwd
+17.1 ms vs XLA's 8.2 ms. The per-(plane,row) (59,64) VPU slice ops
+(masks, tie counts, partial-row stores) are overhead-bound where XLA's
+fused SelectAndScatter + reduction codegen vectorizes across rows. Ships
+UNWIRED as the measured negative result closing the "fused backward"
+branch of the r2 roadmap; the remaining credible path to >2 rounds/sec
+single-chip is an XLA-level conv emitter improvement or a second chip.
+
+Shapes are the canonical phased-ABCD stem extents: zs (B, 59, 71, 59, 64),
+pool (B, 19, 23, 19, 64). ``supported_shape`` gates wiring.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+D, H, W, F = 59, 71, 59, 64
+PD, PH, PW = 19, 23, 19
+SD = 3          # one pool d-group per program
+NSTRIP = PD + 1  # s=0 is the d=56..58 tail (dense-only; 56 rewritten later)
+
+
+def supported_shape(zs_shape) -> bool:
+    return tuple(zs_shape[1:]) == (D, H, W, F)
+
+
+def _d0(s):
+    return jnp.where(s == 0, D - SD, SD * (s - 1))
+
+
+def _bwd_kernel(zs_ref, m_ref, gm_ref, gs_ref, out_ref):
+    s = pl.program_id(1)
+    # per-channel scalars for this batch row: dzs_dense = gS1 + 2*gS2*zs
+    a = gs_ref[0, 0, :].reshape(1, F)          # gS1_c
+    b2 = (2.0 * gs_ref[0, 1, :]).reshape(1, F)  # 2*gS2_c
+    # the tail strip (s == 0, planes 56..58) is dense-only: 57/58 are
+    # unpooled, and plane 56's windows belong to pool group 18 whose m/gm
+    # this program does not hold — the later aligned strip (s == 19)
+    # rewrites plane 56 with the correct scatter (sequential grid order).
+    scatter_on = (s != 0).astype(jnp.float32)
+
+    for ph in range(PH):
+        h0 = 3 * ph
+        mrow = m_ref[0, 0, ph, :, :].astype(jnp.float32)       # (PW, F)
+        m3 = jnp.broadcast_to(mrow.reshape(PW, 1, F),
+                              (PW, 3, F)).reshape(3 * PW, F)    # (57, F)
+        gmrow = gm_ref[0, 0, ph, :, :].astype(jnp.float32)
+
+        # equality masks per (plane, row) and the window-global tie count
+        count = jnp.zeros((PW, F), jnp.float32)
+        masks = {}
+        zrows = {}
+        for ld in range(SD):
+            for r in range(3):
+                zrow = zs_ref[0, ld, h0 + r, :, :].astype(jnp.float32)
+                zrows[(ld, r)] = zrow
+                mk = (zrow[:3 * PW, :] == m3).astype(jnp.float32)
+                masks[(ld, r)] = mk
+                count = count + jnp.sum(mk.reshape(PW, 3, F), axis=1)
+        val = scatter_on * gmrow / jnp.maximum(count, 1.0)      # (PW, F)
+        val3 = jnp.broadcast_to(val.reshape(PW, 1, F),
+                                (PW, 3, F)).reshape(3 * PW, F)
+
+        for ld in range(SD):
+            for r in range(3):
+                zrow = zrows[(ld, r)]
+                out_ref[0, ld, h0 + r, :3 * PW, :] = (
+                    a + b2 * zrow[:3 * PW, :] + masks[(ld, r)] * val3
+                ).astype(out_ref.dtype)
+                out_ref[0, ld, h0 + r, 3 * PW:, :] = (
+                    a + b2 * zrow[3 * PW:, :]).astype(out_ref.dtype)
+
+    # rows beyond the pooled region (h = 69, 70): dense term only
+    for ld in range(SD):
+        for h in (3 * PH, 3 * PH + 1):
+            zrow = zs_ref[0, ld, h, :, :].astype(jnp.float32)
+            out_ref[0, ld, h, :, :] = (a + b2 * zrow).astype(out_ref.dtype)
+
+
+def _pool_sum_sumsq_fwd_impl(zs):
+    import flax.linen as nn
+
+    m = nn.max_pool(zs, (3, 3, 3), strides=(3, 3, 3))
+    zf = zs.astype(jnp.float32)
+    return m, jnp.sum(zf, axis=(1, 2, 3)), jnp.sum(zf * zf, axis=(1, 2, 3))
+
+
+@jax.custom_vjp
+def pool_sum_sumsq(zs):
+    """(maxpool3_s3(zs), sum(zs), sum(zs^2)) with a fused one-pass
+    backward. Forward is plain XLA."""
+    return _pool_sum_sumsq_fwd_impl(zs)
+
+
+def _fwd(zs):
+    out = _pool_sum_sumsq_fwd_impl(zs)
+    return out, (zs, out[0])
+
+
+def _bwd(res, cts):
+    zs, m = res
+    gm, gs1, gs2 = cts
+    gm = jnp.zeros_like(m) if isinstance(gm, jax.interpreters.ad.Zero) \
+        else gm
+    B = zs.shape[0]
+    zero = jnp.zeros((B, F), jnp.float32)
+    gs1 = zero if isinstance(gs1, jax.interpreters.ad.Zero) \
+        else gs1.astype(jnp.float32)
+    gs2 = zero if isinstance(gs2, jax.interpreters.ad.Zero) \
+        else gs2.astype(jnp.float32)
+    gs = jnp.stack([gs1, gs2], axis=1)  # (B, 2, F)
+    E = pl.Element
+    dzs = pl.pallas_call(
+        _bwd_kernel,
+        grid=(B, NSTRIP),
+        in_specs=[
+            pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+                         lambda b, s: (b, _d0(s), 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
+                                       0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
+                                       0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((E(1), E(2), E(F)), lambda b, s: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+                               lambda b, s: (b, _d0(s), 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(zs.shape, zs.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(zs, m, gm.astype(m.dtype), gs)
+    return (dzs,)
+
+
+pool_sum_sumsq.defvjp(_fwd, _bwd)
+
+
+if __name__ == "__main__":  # on-chip check harness (see docstring)
+    import time
+
+    import numpy as np
+
+    B = 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, D, H, W, F), jnp.bfloat16)
+
+    def loss_fused(zs, gm, g1, g2):
+        m, s1, s2 = pool_sum_sumsq(zs)
+        return (jnp.sum(m.astype(jnp.float32) * gm)
+                + jnp.sum(s1 * g1) + jnp.sum(s2 * g2))
+
+    def loss_ref(zs, gm, g1, g2):
+        m, s1, s2 = _pool_sum_sumsq_fwd_impl(zs)
+        return (jnp.sum(m.astype(jnp.float32) * gm)
+                + jnp.sum(s1 * g1) + jnp.sum(s2 * g2))
+
+    k = jax.random.split(jax.random.PRNGKey(1), 3)
+    gm = jax.random.normal(k[0], (B, 19, 23, 19, F), jnp.float32)
+    g1 = jax.random.normal(k[1], (B, F), jnp.float32)
+    g2 = jax.random.normal(k[2], (B, F), jnp.float32) * 1e-3
+
+    dz_f = jax.jit(jax.grad(loss_fused))(x, gm, g1, g2)
+    dz_r = jax.jit(jax.grad(loss_ref))(x, gm, g1, g2)
+    dzf = np.asarray(dz_f, np.float32); dzr = np.asarray(dz_r, np.float32)
+
+    # identify tie windows: where count of (zs == m) in window > 1
+    import flax.linen as nn
+    m, _, _ = _pool_sum_sumsq_fwd_impl(x)
+    mrep = jnp.repeat(jnp.repeat(jnp.repeat(m, 3, 1), 3, 2), 3, 3)
+    eq = (x[:, :57, :69, :57, :] == mrep).astype(jnp.float32)
+    cnt = nn.avg_pool(eq, (3,3,3), strides=(3,3,3)) * 27
+    tied = np.asarray(jnp.repeat(jnp.repeat(jnp.repeat(cnt > 1.5, 3, 1), 3, 2), 3, 3))
+    print("tie fraction:", tied.mean())
+    mask = np.zeros(dzf.shape, bool); mask[:, :57, :69, :57, :] = tied
+    diff = np.abs(dzf - dzr); diff[mask] = 0
+    print("max diff (non-tied):", diff.max())
+    # conservation: total scatter mass equal even at ties
+    print("sum diff:", abs(dzf.sum() - dzr.sum()) / abs(dzr.sum()))
+
+    def timeit(f, *args, n=20):
+        for _ in range(3): out = f(*args)
+        float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(n): out = f(*args)
+        float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        return (time.perf_counter() - t0) / n
+
+    def loop_time(gf, args, iters=20):
+        @jax.jit
+        def f(c0, xx, gm, g1, g2):
+            def body(i, carry):
+                out = gf(xx + carry.astype(jnp.bfloat16) * 0, gm, g1, g2)
+                return carry + 1e-12 * out.astype(jnp.float32)[0, 0, 0, 0, 0]
+            return jax.lax.fori_loop(0, iters, body, c0)
+        c0 = jnp.zeros((), jnp.float32)
+        float(f(c0, *args))
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter(); float(f(c0, *args)); best = min(best, (time.perf_counter()-t0)/iters)
+        return best
+
+    gf = jax.jit(jax.grad(loss_fused)); gr = jax.jit(jax.grad(loss_ref))
+    print(f"fused fwd+bwd: {timeit(gf, x, gm, g1, g2)*1e3:.2f} ms  "
+          f"xla fwd+bwd: {timeit(gr, x, gm, g1, g2)*1e3:.2f} ms")
+    print(f"in-graph fused: {loop_time(gf, (x, gm, g1, g2))*1e3:.2f} ms  "
+          f"in-graph xla: {loop_time(gr, (x, gm, g1, g2))*1e3:.2f} ms")

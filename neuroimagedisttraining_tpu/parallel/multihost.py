@@ -178,6 +178,30 @@ def make_global_client_array(local_rows: np.ndarray, global_shape: tuple,
         sharding, np.ascontiguousarray(local_rows), global_shape)
 
 
+def host_client_counts(n) -> np.ndarray:
+    """Per-client sample counts as a host ndarray, safe for multi-host
+    global arrays.
+
+    ``n_train`` is client-sharded on a multi-host mesh, so a plain
+    ``np.asarray`` raises (non-addressable shards). Every process then
+    needs the SAME answer — derived hyperparameters like
+    ``steps_per_epoch`` and the epoch fast-path flag feed jitted program
+    construction, and divergent values would desync the SPMD programs —
+    so the local shards are allgathered (clients are contiguous per
+    process, ``local_client_indices``)."""
+    try:
+        return np.asarray(n)
+    except RuntimeError:
+        pass
+    from jax.experimental import multihost_utils
+
+    shards = sorted(n.addressable_shards,
+                    key=lambda s: (s.index[0].start or 0))
+    local = np.concatenate([np.asarray(s.data).ravel() for s in shards])
+    gathered = multihost_utils.process_allgather(local)
+    return np.asarray(gathered).ravel()
+
+
 def shard_federated_data_global(local_data: Any, num_clients: int,
                                 mesh: Mesh) -> Any:
     """Lift a process-local FederatedData (holding only this process's

@@ -22,10 +22,33 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite is compile-bound, and xdist
 # workers / repeat runs re-trace identical programs. Harmless if the dir
 # can't be created (jax falls back silently).
+#
+# The directory is keyed by a CPU-feature fingerprint: sandbox hosts
+# rotate, and XLA:CPU AOT artifacts cached on a host with a larger
+# feature set (e.g. AMX/AVX-512 extensions) SIGILL when executed on a
+# smaller one — observed as "Fatal Python error" interpreter crashes in
+# the full-size-volume tests. A host change now starts a fresh cache
+# instead of loading poisoned kernels.
 try:
+    import hashlib
+
+    def _cpu_fingerprint() -> str:
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        return hashlib.sha1(
+                            line.encode()).hexdigest()[:12]
+        except OSError:
+            pass
+        import platform
+
+        return hashlib.sha1(
+            platform.processor().encode()).hexdigest()[:12]
+
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(os.path.expanduser("~"), ".cache",
-                                   "nidt_jax_cache"))
+                                   f"nidt_jax_cache_{_cpu_fingerprint()}"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 except Exception:
     pass

@@ -95,6 +95,14 @@ def test_dispfl_sparse_personal_learning():
     assert any(h["mask_change"] > 0 for h in hist)
     m = algo.mask_distance_matrix(state)
     assert m.shape == (8, 8) and np.allclose(np.diag(m), 0)
+    # per-round local-test series around local training
+    # (dispfl_api.py:150-155: "new mask" before / "old mask" after train)
+    for h in hist:
+        for k in ("new_mask_test_acc", "old_mask_test_acc",
+                  "new_mask_test_loss", "old_mask_test_loss"):
+            assert np.isfinite(h[k]), (k, h)
+    # by the back half the post-train personal models beat chance locally
+    assert np.mean([h["old_mask_test_acc"] for h in hist[8:]]) > 0.6
 
 
 def test_dispfl_client_dropout_skips_only_aggregation():

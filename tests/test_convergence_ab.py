@@ -10,10 +10,19 @@ IDENTICAL Dirichlet partition and per-round client subsets (the reference's
 
 The torch side is written fresh from the reference's documented behavior
 (sample-weighted aggregation fedavg_api.py:102-117; local SGD with
-lr*0.998**round, my_model_trainer.py:185-216) — NOT copied. The one known
-semantic difference is batch selection inside local training (torch:
-shuffled epochs; jax: uniform-with-replacement, core/trainer.py docstring),
-so the assertion is a curve tolerance, not bit equality.
+lr*0.998**round, my_model_trainer.py:185-216) — NOT copied. Since round 3
+BOTH sides run the same batching semantics: shuffled epochs with
+ceil(n_i/batch) batches per epoch, partial last batch kept
+(DataLoader(shuffle=True, drop_last=False) == core/trainer.py epoch mode).
+
+Two tiers of assertion:
+  * ``test_fedavg_round_exact_equivalence_same_schedule`` — torch replays
+    the jax side's exact batch schedule; full federated rounds agree to
+    float32 round-off (~1e-7). This is the semantic-parity gate.
+  * The statistical curves use independent RNG streams; their tolerance is
+    calibrated against measured SAME-side seed spread (see the in-test
+    comments), because batch-order chaos on the tiny planted cohort far
+    exceeds float-level semantics.
 """
 import numpy as np
 import pytest
@@ -158,7 +167,9 @@ def _torch_fed_rounds(net, xt, yt, x_te, y_te, loss_fn, acc_fn,
             n = len(yt[c])
             for _ in range(EPOCHS):
                 perm = torch.randperm(n, generator=g)
-                for s in range(0, n - BS + 1, BS):
+                # ceil(n/BS) batches, partial last one kept — the torch
+                # DataLoader(shuffle=True, drop_last=False) iteration
+                for s in range(0, n, BS):
                     idx = perm[s:s + BS]
                     opt.zero_grad()
                     loss = loss_fn(net(xt[c][idx]), yt[c][idx])
@@ -204,11 +215,11 @@ def test_fedavg_convergence_matches_torch_reference():
     y_te = np.concatenate([np.asarray(data.y_test[c])[: int(data.n_test[c])]
                            for c in range(N_CLIENTS)])
     model = create_model("cnn_cifar10", num_classes=CLASSES)
-    n_mean = int(np.mean([len(y) for y in ys_tr]))
+    n_max = max(len(y) for y in ys_tr)
     hp = HyperParams(lr=LR, lr_decay=DECAY, momentum=MOMENTUM,
                      weight_decay=0.0, grad_clip=10.0,
                      local_epochs=EPOCHS,
-                     steps_per_epoch=max(1, n_mean // BS), batch_size=BS)
+                     steps_per_epoch=max(1, -(-n_max // BS)), batch_size=BS)
     algo = FedAvg(model, data, hp, loss_type="ce", frac=1.0, seed=0)
     state = algo.init_state(jax.random.PRNGKey(0))
 
@@ -235,10 +246,16 @@ def test_fedavg_convergence_matches_torch_reference():
     # both sides learn well above chance
     assert t_back > chance + 0.3, torch_accs
     assert j_back > chance + 0.3, jax_accs
-    # converged accuracy agrees at the level of means (individual rounds
-    # oscillate under SGD noise on both sides; batch-selection semantics
-    # differ — see module docstring)
-    assert abs(j_back - t_back) < 0.08, (t_back, j_back,
+    # Noise-calibrated tolerance (r3): same-side seed spreads on this
+    # planted cohort DWARF any cross-side gap — measured back-half means
+    # over 5 training-RNG seeds each: jax 0.719-0.871 (spread 0.15), torch
+    # 0.851-0.921 (momentum 0.9); with momentum 0 torch alone spans
+    # 0.64-0.88. This seed pair measures gap -0.086; a single-seed
+    # assertion tighter than the seed spread would gate on SGD chaos, not
+    # semantics — the semantic gate is
+    # test_fedavg_round_exact_equivalence_same_schedule (float32
+    # round-off, ~1e-7, same batch schedule both sides).
+    assert abs(j_back - t_back) < 0.12, (t_back, j_back,
                                          torch_accs, jax_accs)
 
 
@@ -285,11 +302,11 @@ def test_salientgrads_convergence_matches_torch_reference():
                            for c in range(N_CLIENTS)])
 
     model = create_model("cnn_cifar10", num_classes=CLASSES)
-    n_mean = int(np.mean([len(y) for y in ys_tr]))
+    n_max = max(len(y) for y in ys_tr)
     hp = HyperParams(lr=LR, lr_decay=DECAY, momentum=MOMENTUM,
                      weight_decay=0.0, grad_clip=10.0,
                      local_epochs=EPOCHS,
-                     steps_per_epoch=max(1, n_mean // BS), batch_size=BS)
+                     steps_per_epoch=max(1, -(-n_max // BS)), batch_size=BS)
     dense_ratio = 0.5
     algo = SalientGrads(model, data, hp, loss_type="ce", frac=1.0, seed=0,
                         dense_ratio=dense_ratio, itersnip_iterations=1)
@@ -329,8 +346,95 @@ def test_salientgrads_convergence_matches_torch_reference():
     chance = 1.0 / CLASSES
     assert t_back > chance + 0.3, torch_accs
     assert j_back > chance + 0.3, jax_accs
-    assert abs(j_back - t_back) < 0.08, (t_back, j_back,
+    # measured gap -0.026 (r3, epoch batching both sides); margin covers
+    # the same-side seed chaos documented in the fedavg test above
+    assert abs(j_back - t_back) < 0.06, (t_back, j_back,
                                          torch_accs, jax_accs)
+
+
+def test_fedavg_round_exact_equivalence_same_schedule():
+    """Pinned root-cause check for the statistical A/B's residual gap: when
+    torch replays the EXACT batch schedule the jax side draws (white-box
+    reconstruction of the round_key -> client key -> epoch permutation
+    chain), two full federated rounds — local SGD with momentum + clip(10)
+    + CE, sample-weighted aggregation, lr decay — agree to float32
+    round-off (~1e-7). Any back-half accuracy gap in the statistical tests
+    above is therefore batch-order SGD chaos, not a semantic deviation."""
+    from neuroimagedisttraining_tpu.core.trainer import epoch_permutations
+
+    data = _make_dataset(seed=5)
+    xs_tr = [np.asarray(data.x_train[c]) for c in range(N_CLIENTS)]  # padded
+    ys_tr = [np.asarray(data.y_train[c]) for c in range(N_CLIENTS)]
+    nvals = [int(data.n_train[c]) for c in range(N_CLIENTS)]
+    model = create_model("cnn_cifar10", num_classes=CLASSES)
+    n_max = max(nvals)
+    spe = -(-n_max // BS)
+    hp = HyperParams(lr=LR, lr_decay=DECAY, momentum=MOMENTUM,
+                     weight_decay=0.0, grad_clip=10.0, local_epochs=1,
+                     steps_per_epoch=spe, batch_size=BS)
+    algo = FedAvg(model, data, hp, loss_type="ce", frac=1.0, seed=0)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    init0 = jax.tree_util.tree_map(np.asarray, state.global_params)
+
+    net = TorchCNN(CLASSES)
+    _jax_params_to_torch(init0, net)
+    w_global = {k: v.clone() for k, v in net.state_dict().items()}
+    xt = [torch.from_numpy(x.transpose(0, 3, 1, 2).copy()) for x in xs_tr]
+    yt = [torch.from_numpy(y.astype(np.int64)) for y in ys_tr]
+
+    rng = jnp.asarray(np.asarray(state.rng))
+    rounds = 2
+    for r in range(rounds):
+        state, _ = algo.run_round(state, r)
+        # replay the jax key chain: round_fn splits state.rng, then
+        # _train_selected_weighted splits round_key per client, then
+        # client_update splits off the permutation key
+        rng, round_key = jax.random.split(rng)
+        keys = jax.random.split(round_key, N_CLIENTS + 1)
+        lr = LR * (DECAY ** r)
+        locals_, weights = [], []
+        for c in range(N_CLIENTS):
+            k_perm, _ = jax.random.split(keys[c])
+            perm = np.asarray(epoch_permutations(
+                k_perm, jnp.int32(nvals[c]), 1, spe * BS,
+                n_rows=xs_tr[c].shape[0]))[0]
+            net.load_state_dict(w_global)
+            opt = torch.optim.SGD(net.parameters(), lr=lr,
+                                  momentum=MOMENTUM)
+            n = nvals[c]
+            for pos in range(spe):
+                g0 = pos * BS
+                if g0 >= n:
+                    break
+                idx = perm[g0:g0 + BS]
+                idx = idx[(g0 + np.arange(len(idx))) < n]  # valid slots
+                opt.zero_grad()
+                loss = torch.nn.CrossEntropyLoss()(net(xt[c][idx]),
+                                                   yt[c][idx])
+                loss.backward()
+                torch.nn.utils.clip_grad_norm_(net.parameters(), 10.0)
+                opt.step()
+            locals_.append({k: v.clone()
+                            for k, v in net.state_dict().items()})
+            weights.append(n)
+        total = sum(weights)
+        w_global = {k: sum(w / total * loc[k] for w, loc in
+                           zip(weights, locals_)) for k in w_global}
+
+    j = jax.tree_util.tree_map(np.asarray, state.global_params)
+    pairs = [
+        (w_global["c1.weight"].numpy().transpose(2, 3, 1, 0),
+         j["Conv_0"]["kernel"]),
+        (w_global["c1.bias"].numpy(), j["Conv_0"]["bias"]),
+        (w_global["c2.weight"].numpy().transpose(2, 3, 1, 0),
+         j["Conv_1"]["kernel"]),
+        (w_global["f1.weight"].numpy().T, j["Dense_0"]["kernel"]),
+        (w_global["f2.weight"].numpy().T, j["Dense_1"]["kernel"]),
+        (w_global["f3.weight"].numpy().T, j["Dense_2"]["kernel"]),
+        (w_global["f3.bias"].numpy(), j["Dense_2"]["bias"]),
+    ]
+    for a, b in pairs:
+        np.testing.assert_allclose(a, b, atol=5e-6, rtol=2e-5)
 
 
 # ---- 3D/BCE flagship-path A/B ---------------------------------------------
@@ -444,7 +548,7 @@ def test_fedavg_3d_bce_convergence_matches_torch_reference():
           f"jax {j_back:.3f}  gap {j_back - t_back:+.3f}")
     assert t_back > 0.8, torch_accs
     assert j_back > 0.8, jax_accs
-    # even client sizes make the local-step counts symmetric; forward
-    # parity above is the exact check, this bounds training-dynamics drift
-    assert abs(j_back - t_back) < 0.1, (t_back, j_back,
-                                        torch_accs, jax_accs)
+    # forward parity above is the exact check; with identical epoch
+    # semantics on both sides this bounds training-dynamics drift to noise
+    assert abs(j_back - t_back) < 0.03, (t_back, j_back,
+                                         torch_accs, jax_accs)
