@@ -94,14 +94,16 @@ def make_client_update(
     leading client axis on everything except ``round_idx``. ``prox_target``
     is ignored (and DCE'd) unless ``prox_lambda > 0``.
     """
-    loss_fn = make_loss_fn(loss_type)
     per_example = PER_EXAMPLE_LOSSES[loss_type]
     epoch_mode = hp.batching == "epoch"
 
     def batch_loss(params, xb, yb, wb, dropout_rng):
         logits = apply_fn(params, xb, train=True, rng=dropout_rng)
-        if wb is None:  # full batch — plain mean (replacement mode)
-            return loss_fn(logits, yb)
+        if wb is None:
+            # full batch: plain mean, reduced in f32 like the masked
+            # branch so the full_batches fast path and the masked path
+            # keep identical reduction precision under bf16 compute
+            return jnp.mean(per_example(logits, yb).astype(jnp.float32))
         # partial final epoch batch: mean over the batch's own valid
         # examples, exactly the reference's smaller-last-batch loss.mean()
         w = wb.astype(jnp.float32)
@@ -224,8 +226,9 @@ def make_eval_fn(apply_fn: ApplyFn, loss_type: str, eval_batch: int = 32):
         m_max = x.shape[0]
         # never batch wider than the shard: tiny test shards (small ABCD
         # sites) would otherwise be padded up to eval_batch and burn a
-        # full-width forward on padding rows
-        eb = min(eval_batch, m_max)
+        # full-width forward on padding rows (floor 1 keeps the zero-row
+        # shard edge well-defined: nb = 0, empty scan, zero totals)
+        eb = max(1, min(eval_batch, m_max))
         pad = (-m_max) % eb
         if pad:  # static — pad the shard so chunking is exact
             x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))

@@ -415,6 +415,24 @@ def run_experiment(args: argparse.Namespace,
                 if restored is not None:
                     state, start_round = restored
                     logger.info("resumed from round %d", start_round)
+            else:
+                # fresh run into a dir holding a DIFFERENT-semantics
+                # lineage (metric-protocol tags share checkpoint
+                # identities, config.run_identity): refuse before
+                # overwriting it round by round
+                last = ckpt_mgr.latest_step()
+                if last is not None:
+                    prev_meta = ckpt_mgr.load_metadata(last) or {}
+                    pb = prev_meta.get("batching")
+                    here = getattr(args, "batching", "epoch")
+                    if pb is not None and pb != here:
+                        raise SystemExit(
+                            f"checkpoint dir {ckpt_mgr.directory} holds a "
+                            f"--batching {pb} lineage up to round {last}; "
+                            f"running --batching {here} over it would mix "
+                            "training semantics. Resume it with --batching "
+                            f"{pb}, or start a fresh lineage (--tag or a "
+                            "different --checkpoint_dir).")
 
         if state is None:
             state = algo.init_state(jax.random.PRNGKey(args.seed))
