@@ -112,7 +112,11 @@ def test_checkpoint_sidecar_pruned_with_steps(tmp_path):
     mgr.close()
 
 
-def test_resume_batching_mismatch_refused(tmp_path):
+def test_batching_mismatch_refused_on_resume_and_fresh_run(tmp_path):
+    """Metric-protocol tags share checkpoint identities (config.py), so the
+    metadata sidecar is the semantics gate: a --batching mismatch is
+    refused both when resuming an existing lineage and when a fresh run
+    would overwrite one round by round."""
     from neuroimagedisttraining_tpu.experiments.runner import run_experiment
 
     common = ["--algo", "local", "--model", "small3dcnn",
@@ -122,33 +126,22 @@ def test_resume_batching_mismatch_refused(tmp_path):
               "--checkpoint_dir", str(tmp_path / "ck"),
               "--results_dir", "", "--log_dir", str(tmp_path / "log")]
     from neuroimagedisttraining_tpu.experiments.config import parse_args
+    import pytest as _pytest
 
-    run_experiment(parse_args(common))
-    # resuming the epoch-batching lineage under replacement semantics (the
-    # identity gains no 'wr' part on --resume lookups of... actually the
-    # 'wr' tag splits the lineage; simulate the unmarked case by forcing
-    # the same checkpoint dir) must be refused, not silently continued
-    args2 = parse_args(common + ["--comm_round", "2", "--resume",
-                                 "--batching", "replacement"])
-    # same identity dir is required to reach the guard ('wr' would split
-    # the lineage): point the runner's identity at the epoch lineage
-    # (runner.py binds run_identity at import, so patch its module global)
-    from neuroimagedisttraining_tpu.experiments import runner as runner_mod
-
-    orig = runner_mod.run_identity
-
-    def same_identity(a, algo=None, for_checkpoint=False):
-        a2 = type(a)(**{**vars(a), "batching": "epoch"})
-        return orig(a2, algo, for_checkpoint)
-
-    runner_mod.run_identity = same_identity
-    try:
-        import pytest as _pytest
-
-        with _pytest.raises(SystemExit, match="batching"):
-            run_experiment(args2)
-    finally:
-        runner_mod.run_identity = orig
+    run_experiment(parse_args(common))  # epoch-batching lineage, round 1
+    # (a) resuming it under replacement semantics is refused
+    with _pytest.raises(SystemExit, match="batching"):
+        run_experiment(parse_args(
+            common + ["--comm_round", "2", "--resume",
+                      "--batching", "replacement"]))
+    # (b) a FRESH replacement run into the same dir (no --resume) must
+    # also be refused before it overwrites the lineage round by round
+    with _pytest.raises(SystemExit, match="batching"):
+        run_experiment(parse_args(common + ["--batching", "replacement"]))
+    # (c) same-mode runs are unaffected
+    out = run_experiment(parse_args(
+        common + ["--comm_round", "2", "--resume"]))
+    assert [h["round"] for h in out["history"]] == [1]
 
 
 def test_fedavg_track_personal_off():
