@@ -59,6 +59,7 @@ class FedAlgorithm(abc.ABC):
         channel_inject: bool = False,
         remat_local: bool = False,
         eval_clients: int = 0,
+        augment="auto",
     ):
         self.model = model
         self.data = data
@@ -105,6 +106,25 @@ class FedAlgorithm(abc.ABC):
                     "instead of the full shard (the runner sizes "
                     "steps_per_epoch to ceil(max(n_i)/batch) and never "
                     "hits this)", budget, n_biggest, budget)
+        # Training-time augmentation (reference parity: every CIFAR/tiny
+        # batch goes through RandomCrop(H,4)+flip, cifar10/data_loader.py:
+        # 46-50 — there is no off switch in the reference). "auto" turns it
+        # on exactly when the loader declared the dataset augmentable
+        # (data.aug_pad_value set); False disables; a callable is used as
+        # the (rng, xb) -> xb augmentation directly.
+        if callable(augment):
+            self.augment_fn = augment
+        elif augment in ("auto", True, 1) and \
+                getattr(data, "aug_pad_value", None) is not None:
+            import functools
+
+            from ..data.cifar import random_crop_flip
+
+            self.augment_fn = functools.partial(
+                random_crop_flip, padding=4,
+                pad_value=np.asarray(data.aug_pad_value, np.float32))
+        else:
+            self.augment_fn = None
         self.apply_fn = make_apply_fn(
             model, compute_dtype=self.compute_dtype,
             channel_inject=channel_inject)

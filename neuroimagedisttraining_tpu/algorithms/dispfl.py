@@ -111,18 +111,23 @@ class DisPFL(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=True, mask_params_post_step=True,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
         loss_fn = make_loss_fn(self.loss_type)
 
         def screen_gradients(params, x, y, n_valid, rng):
             """One dense-batch gradient for regrow scoring
-            (DisPFL/my_model_trainer.py:128-144)."""
+            (DisPFL/my_model_trainer.py:128-144); the reference feeds it
+            train-loader batches, so augmentation applies like training."""
             k_idx, k_drop = jax.random.split(rng)
             idx = jax.random.randint(
                 k_idx, (self.hp.batch_size,), 0, jnp.maximum(n_valid, 1)
             )
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
+            if self.augment_fn is not None:
+                k_aug, k_drop = jax.random.split(k_drop)
+                xb = self.augment_fn(k_aug, xb)
             return jax.grad(
                 lambda p: loss_fn(self.apply_fn(p, xb, train=True,
                                                 rng=k_drop), yb)
@@ -133,8 +138,11 @@ class DisPFL(FedAlgorithm):
         def local_test_means(params_stack, x_test, y_test, n_test):
             """Per-client local test, reported as the reference's means:
             acc = mean_c(correct_c/total_c), loss = mean_c(loss_c/total_c)
-            (dispfl_api.py:242-301)."""
-            correct, loss_sum, total = jax.vmap(eval_client)(
+            (dispfl_api.py:242-301). Chunked like the training vmap so the
+            two default-on eval passes respect the same --client_chunk HBM
+            bound as training (ADVICE r3)."""
+            correct, loss_sum, total = self._vmap_clients(
+                eval_client, in_axes=(0, 0, 0, 0))(
                 params_stack, x_test, y_test, n_test)
             totals = jnp.maximum(total, 1).astype(jnp.float32)
             return (jnp.mean(correct.astype(jnp.float32) / totals),

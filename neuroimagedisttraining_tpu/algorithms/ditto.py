@@ -53,6 +53,7 @@ class Ditto(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
         self.personal_update = make_client_update(
             self.apply_fn, self.loss_type, self._personal_hp or self.hp,
@@ -60,6 +61,7 @@ class Ditto(FedAlgorithm):
             prox_lambda=self.lamda,
             remat=self.remat_local,
             full_batches=self._full_batches(self._personal_hp or self.hp),
+            augment_fn=self.augment_fn,
         )
 
         def round_fn(state: DittoState, sel_idx, round_idx,

@@ -49,6 +49,7 @@ class DPSGD(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
 
         def round_fn(state: DPSGDState, adjacency, round_idx,

@@ -62,6 +62,7 @@ class FedAvg(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
 
         def round_fn(state: FedAvgState, sel_idx, round_idx,

@@ -60,6 +60,7 @@ class FedFomo(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
         self._n_nei = min(self.clients_per_round, self.num_clients - 1)
 

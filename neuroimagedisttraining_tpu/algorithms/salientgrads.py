@@ -64,11 +64,13 @@ class SalientGrads(FedAlgorithm):
             remat=self.remat_local,
             fused_kernels=self.fused_kernels,
             full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
         self.snip_scores = make_snip_score_fn(
             self.apply_fn, self.loss_type, self.hp.batch_size,
             stratified=self.stratified_sampling,
             num_classes=self.data.class_num,
+            augment_fn=self.augment_fn,
         )
 
         def global_mask_fn(params, x_train, y_train, n_train, rng):

@@ -67,6 +67,7 @@ class SubAvg(FedAlgorithm):
             self.apply_fn, self.loss_type, hp_first,
             mask_grads=True, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(hp_first),
+            augment_fn=self.augment_fn,
         )
         self._update_rest = (
             make_client_update(
@@ -74,6 +75,7 @@ class SubAvg(FedAlgorithm):
                 mask_grads=True, mask_params_post_step=False,
                 remat=self.remat_local,
                 full_batches=self._full_batches(hp_rest),
+                augment_fn=self.augment_fn,
             )
             if hp_rest.local_epochs > 0 else None
         )

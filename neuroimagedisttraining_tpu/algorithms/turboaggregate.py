@@ -52,6 +52,7 @@ class TurboAggregate(FedAlgorithm):
             self.apply_fn, self.loss_type, self.hp,
             mask_grads=False, mask_params_post_step=False,
             remat=self.remat_local, full_batches=self._full_batches(),
+            augment_fn=self.augment_fn,
         )
 
         def local_fn(global_params, sel_idx, round_idx, round_key,

@@ -69,6 +69,7 @@ def make_client_update(
     remat: bool = False,
     fused_kernels: bool = False,
     full_batches: bool = False,
+    augment_fn: Callable = None,
 ):
     """Build the per-client local-training function.
 
@@ -83,6 +84,11 @@ def make_client_update(
     concurrently under the vmap (``client_chunk`` can rise).
     ``fused_kernels``: route the optimizer update through the Pallas fused
     masked-SGD kernel (ops/pallas_kernels.py) instead of the XLA chain.
+    ``augment_fn``: jittable ``(rng, xb) -> xb`` training-time augmentation
+    (e.g. :func:`data.cifar.random_crop_flip`), applied to every gathered
+    training batch inside the scanned step — the device-side equivalent of
+    the reference's torchvision train transform running in the DataLoader
+    (``cifar10/data_loader.py:46-50``). Eval paths never see it.
     ``full_batches``: caller-asserted static guarantee that EVERY client's
     ``n_valid >= steps_per_epoch * batch_size`` (checkable host-side from
     the concrete shard counts). Epoch mode then skips the provably-no-op
@@ -165,6 +171,9 @@ def make_client_update(
                 idx = jnp.minimum(idx, x.shape[0] - 1)
                 xb = jnp.take(x, idx, axis=0)
                 yb = jnp.take(y, idx, axis=0)
+                if augment_fn is not None:
+                    k_aug, k_drop = jax.random.split(k_drop)
+                    xb = augment_fn(k_aug, xb)
                 if full_batches:
                     # statically guaranteed: every batch full, every step
                     # active — same math without the masking machinery
@@ -200,6 +209,9 @@ def make_client_update(
                                      jnp.maximum(n_valid, 1))
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
+            if augment_fn is not None:
+                k_aug, k_drop = jax.random.split(k_drop)
+                xb = augment_fn(k_aug, xb)
             loss, grads = grad_fn(params, xb, yb, None, k_drop)
             params, momentum = apply_update(
                 params, momentum, grads, mask, prox_target, lr)

@@ -20,6 +20,19 @@ from .cifar import (
     random_crop_flip,
 )
 
+# Dataset names (as dispatched below) whose loaders declare the reference's
+# RandomCrop+flip train transform by setting FederatedData.aug_pad_value —
+# the ONE source of truth for "is this dataset augmentable", used both by
+# FedAlgorithm's auto-wiring input (via the loaded data's aug_pad_value)
+# and by the runner's pre-load checkpoint-lineage guard. Keep in sync with
+# the dispatch cases below.
+AUGMENTABLE_DATASETS = (
+    "cifar10", "cifar100", "tiny_imagenet", "tiny-imagenet-200", "tiny")
+
+
+def dataset_is_augmentable(dataset: str) -> bool:
+    return dataset.lower() in AUGMENTABLE_DATASETS
+
 
 def load_federated_data(
     dataset: str,

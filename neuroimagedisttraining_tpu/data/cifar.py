@@ -80,6 +80,15 @@ def _normalize(x: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
     return ((x.astype(np.float32) / 255.0) - mean) / std
 
 
+def black_pad_value(mean: np.ndarray, std: np.ndarray) -> tuple:
+    """Per-channel value of a BLACK padding pixel in normalized space:
+    torchvision's RandomCrop pads the raw image with 0 BEFORE
+    ToTensor+Normalize (``cifar10/data_loader.py:46-50``), so the padded
+    ring lands at (0 - mean) / std. The loaders stamp this on
+    ``FederatedData.aug_pad_value``."""
+    return tuple(((0.0 - np.asarray(mean)) / np.asarray(std)).tolist())
+
+
 def load_partition_data_cifar(
     data_dir: str,
     dataset: str = "cifar10",
@@ -107,16 +116,23 @@ def load_partition_data_cifar(
         _normalize(X_test, mean, std), y_test,
         n_classes, client_number, partition_method, partition_alpha,
         val_fraction, seed,
+        aug_pad_value=black_pad_value(mean, std),
     )
 
 
-def random_crop_flip(rng, batch, padding: int = 4):
+def random_crop_flip(rng, batch, padding: int = 4, pad_value=None):
     """Jittable batched random crop (pad-and-slice) + horizontal flip.
 
     Device-side replacement for the reference's torchvision
     ``RandomCrop(32, padding=4) + RandomHorizontalFlip``
-    (``cifar10/data_loader.py:39-43``): one fused op over the whole batch,
+    (``cifar10/data_loader.py:46-50``): one fused op over the whole batch,
     traced inside the training step, so augmentation costs no host round-trip.
+
+    ``pad_value``: per-channel constant for the padded ring. torchvision
+    pads the RAW image with black (0) *before* ToTensor+Normalize, so in
+    normalized space the ring is ``(0 - mean) / std`` — pass the dataset's
+    :attr:`FederatedData.aug_pad_value` to reproduce that exactly. ``None``
+    pads with 0 (the mean pixel in normalized space).
     """
     import jax
     import jax.numpy as jnp
@@ -125,6 +141,15 @@ def random_crop_flip(rng, batch, padding: int = 4):
     k1, k2, k3 = jax.random.split(rng, 3)
     padded = jnp.pad(
         batch, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    if pad_value is not None:
+        pv = jnp.asarray(pad_value, batch.dtype)
+        ih = (jnp.arange(h + 2 * padding) >= padding) \
+            & (jnp.arange(h + 2 * padding) < padding + h)
+        iw = (jnp.arange(w + 2 * padding) >= padding) \
+            & (jnp.arange(w + 2 * padding) < padding + w)
+        interior = ih[:, None] & iw[None, :]
+        # interior pixels pass through bit-exactly; only the ring is set
+        padded = jnp.where(interior[None, :, :, None], padded, pv)
     dy = jax.random.randint(k1, (b,), 0, 2 * padding + 1)
     dx = jax.random.randint(k2, (b,), 0, 2 * padding + 1)
 

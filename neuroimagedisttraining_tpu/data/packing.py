@@ -31,6 +31,7 @@ def partition_and_pack(
     partition_alpha: float = 0.3,
     val_fraction: float = 0.0,
     seed: Optional[int] = None,
+    aug_pad_value: Optional[tuple] = None,
 ) -> FederatedData:
     mapping = class_prior_partition(
         y_train, client_number, n_classes, partition_method,
@@ -72,5 +73,5 @@ def partition_and_pack(
     return FederatedData(
         x_train=x_train, y_train=y_tr, n_train=n_train,
         x_test=x_test, y_test=y_te, n_test=n_test,
-        class_num=n_classes, **kwargs,
+        class_num=n_classes, aug_pad_value=aug_pad_value, **kwargs,
     )

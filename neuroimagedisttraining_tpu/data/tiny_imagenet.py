@@ -98,8 +98,13 @@ def load_partition_data_tiny_imagenet(
     # class count from the wnid table, not max observed label — a partial
     # checkout missing the last classes' images must not shrink the head
     n_classes = len(_wnid_index(data_dir))
+    # RandomCrop(64, padding=4) + flip pipeline, same as CIFAR
+    # (tiny_imagenet/data_loader.py:51-56)
+    from .cifar import black_pad_value
+
     return partition_and_pack(
         _normalize(X_train), y_train, _normalize(X_test), y_test,
         n_classes, client_number, partition_method, partition_alpha,
         val_fraction, seed,
+        aug_pad_value=black_pad_value(TIN_MEAN, TIN_STD),
     )

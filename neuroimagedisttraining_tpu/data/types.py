@@ -39,6 +39,15 @@ class FederatedData:
     x_val: Optional[jax.Array] = None
     y_val: Optional[jax.Array] = None
     n_val: Optional[jax.Array] = None
+    # Training-time augmentation contract (the 2D image loaders set this):
+    # per-channel value of a BLACK padding pixel in this dataset's
+    # normalized space, i.e. (0 - mean) / std. Non-None marks the dataset
+    # as crop+flip-augmentable with the reference's RandomCrop(H, padding=4)
+    # + RandomHorizontalFlip pipeline (cifar10/data_loader.py:46-50, where
+    # torchvision pads the RAW image with 0 BEFORE ToTensor+Normalize —
+    # so the padded ring is -mean/std after normalization, not 0).
+    aug_pad_value: Optional[tuple] = struct.field(
+        pytree_node=False, default=None)
 
     @property
     def num_clients(self) -> int:

@@ -118,13 +118,25 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="store volumes in this dtype on device (bfloat16 "
                         "halves HBM for data and skips the per-step "
                         "convert when paired with --compute_dtype bfloat16)")
-    p.add_argument("--batching", type=str, default="epoch",
+    p.add_argument("--batching", type=str, default=None,
                    choices=["epoch", "replacement"],
                    help="local batch draw: epoch = per-epoch shuffles, each "
                         "client consuming its own ceil(n_i/batch) batches "
                         "(reference DataLoader semantics, the default); "
                         "replacement = uniform with-replacement draws with "
-                        "a uniform mean-derived step count (legacy)")
+                        "a uniform mean-derived step count (legacy). The "
+                        "None sentinel lets the runner distinguish an "
+                        "explicit choice from the default when continuing "
+                        "a pre-round-3 checkpoint lineage")
+    p.add_argument("--augment", type=int, default=None,
+                   help="training-time RandomCrop(H,4)+flip on augmentable "
+                        "datasets (cifar10/100, tiny) inside the jitted "
+                        "step — the reference's torchvision train pipeline "
+                        "(cifar10/data_loader.py:46-50), always on there "
+                        "(and on by default here); 0 disables for "
+                        "ablations. The None sentinel lets the runner "
+                        "distinguish an explicit choice from the default "
+                        "when continuing a pre-round-4 lineage")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
     p.add_argument("--eval_clients", type=int, default=0,
@@ -259,6 +271,15 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         1, int(round(args.client_num_in_total * args.frac)))
     if getattr(args, "ci", 0):
         args.comm_round = min(args.comm_round, 2)
+    # resolve the explicit-vs-default sentinels (the runner's checkpoint
+    # lineage guards need to know whether the user CHOSE the semantics or
+    # inherited a flipped default — ADVICE r3)
+    args.batching_explicit = getattr(args, "batching", None) is not None
+    if getattr(args, "batching", None) is None:
+        args.batching = "epoch"
+    args.augment_explicit = getattr(args, "augment", None) is not None
+    if getattr(args, "augment", None) is None:
+        args.augment = 1
     return args
 
 
@@ -318,6 +339,8 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         # caught by the checkpoint metadata guard in the runner instead
         if getattr(args, "batching", "epoch") != "epoch":
             parts.append("wr")  # with-replacement draws train differently
+        if not getattr(args, "augment", 1):
+            parts.append("noaug")  # un-augmented CIFAR/tiny ablation
         if getattr(args, "eval_clients", 0):
             parts.append(f"evK{args.eval_clients}")
         if getattr(args, "data_dtype", ""):

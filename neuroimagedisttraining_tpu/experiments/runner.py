@@ -60,6 +60,95 @@ def _is_abcd_h5(dataset: str) -> bool:
     return dataset.lower() in ("abcd", "abcd_site", "abcd_rescale")
 
 
+def _dataset_augmentable(dataset: str) -> bool:
+    """Whether this dataset's loader declares the reference's
+    RandomCrop+flip train transform — delegated to the data package's
+    single source of truth (the lineage guard needs the answer BEFORE the
+    data loads; ``_check_augment_consistency`` re-verifies it against the
+    actually-built algorithm after the load)."""
+    from ..data import dataset_is_augmentable
+
+    return dataset_is_augmentable(dataset)
+
+
+def _check_augment_consistency(args, algo) -> None:
+    """Post-build safety net for the pre-load guess above: if the guard's
+    dataset->augmentable mapping ever drifts from what the loader actually
+    declared (aug_pad_value) and the algorithm wired, fail loudly instead
+    of letting checkpoint metadata contradict the guard's model."""
+    expected = bool(getattr(args, "augment", 1)) \
+        and _dataset_augmentable(args.dataset)
+    actual = algo.augment_fn is not None
+    if expected != actual and args.checkpoint_dir:
+        raise SystemExit(
+            f"augmentability mapping drift: the lineage guard assumed "
+            f"augment={int(expected)} for dataset {args.dataset!r} but the "
+            f"built algorithm has augment={int(actual)} — update "
+            "data.AUGMENTABLE_DATASETS to match the loader")
+
+
+def _resolve_lineage_semantics(args, meta: dict, last: int,
+                               directory: str) -> None:
+    """Reconcile this run's training semantics (batching mode, CIFAR
+    augmentation) with an existing checkpoint lineage BEFORE the algorithm
+    is built — both knobs are baked into the jitted kernels at build time.
+
+    A sidecar value of None means the lineage predates the knob's sidecar
+    entry, which pins its semantics: pre-round-3 lineages trained with
+    with-replacement draws, pre-round-4 CIFAR lineages trained without
+    augmentation. Continuing a lineage under a different (since-flipped)
+    default would silently mix semantics mid-lineage (ADVICE r3), so: on
+    resume, a DEFAULTED knob adapts to the lineage's semantics (with a
+    warning) — whether the lineage recorded them or is sidecar-less-pinned
+    — so the same defaulted resume command keeps working after checkpoints
+    start recording the adapted value; an explicit mismatch, or any fresh
+    run that would overwrite the lineage round by round, is refused.
+    """
+    def _refuse(knob, lineage_val, here_val, fix):
+        action = ("resuming it" if args.resume
+                  else "a fresh run overwriting it round by round")
+        raise SystemExit(
+            f"checkpoint dir {directory} holds a {knob}={lineage_val} "
+            f"lineage up to round {last}; {action} with {knob}={here_val} "
+            f"would mix training semantics. {fix}")
+
+    here_b = getattr(args, "batching", "epoch")
+    lineage_b = meta.get("batching") or "replacement"  # None = pre-round-3
+    if lineage_b != here_b:
+        if args.resume and not getattr(args, "batching_explicit", True):
+            logger.warning(
+                "lineage trained with --batching %s (%s); continuing with "
+                "those semantics instead of the current default",
+                lineage_b,
+                "recorded" if meta.get("batching") else
+                "pre-round-3 sidecar-less, the only semantics it can have")
+            args.batching = lineage_b
+        else:
+            _refuse("batching", lineage_b, here_b,
+                    f"Pass --batching {lineage_b} to continue it, or start "
+                    "a fresh lineage (--tag or a different "
+                    "--checkpoint_dir).")
+
+    here_a = bool(getattr(args, "augment", 1)) \
+        and _dataset_augmentable(args.dataset)
+    pa = meta.get("augment")
+    lineage_a = bool(pa)  # None = pre-round-4 lineage: un-augmented
+    if lineage_a != here_a:
+        if args.resume and not getattr(args, "augment_explicit", True):
+            logger.warning(
+                "lineage trained with augment=%d (%s); continuing with "
+                "those semantics instead of the current default",
+                int(lineage_a),
+                "recorded" if pa is not None else
+                "pre-round-4 sidecar-less, the only semantics it can have")
+            args.augment = int(lineage_a)
+        else:
+            _refuse("augment", int(lineage_a), int(here_a),
+                    f"Pass --augment {int(lineage_a)} to continue it, or "
+                    "start a fresh lineage (--tag or a different "
+                    "--checkpoint_dir).")
+
+
 def infer_loss_type(args: argparse.Namespace, class_num: int) -> str:
     """ABCD/3D path uses BCE-with-logits (my_model_trainer.py:191-206);
     CIFAR path uses CE (fedavg/my_model_trainer.py:38-67)."""
@@ -163,6 +252,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         channel_inject=(layout == "flat" and _is_abcd_h5(args.dataset)),
         remat_local=bool(getattr(args, "remat", 0)),
         eval_clients=getattr(args, "eval_clients", 0),
+        # "auto" applies only to datasets whose loader set aug_pad_value
+        # (cifar10/100, tiny) — the reference's always-on train transform
+        augment="auto" if getattr(args, "augment", 1) else False,
     )
     defense = None
     if getattr(args, "defense_type", "none") != "none":
@@ -363,12 +455,30 @@ def run_experiment(args: argparse.Namespace,
     import jax
 
     algo_name = algo_name or getattr(args, "algo", "fedavg")
-    identity = run_identity(args, algo_name)
-    configure_console()
-    log_handler = add_run_file_logger(
-        args.log_dir, getattr(args, "logfile", "") or identity)
     ckpt_mgr = None
+    log_handler = None
     try:
+        # Reconcile batching/augment semantics with any existing checkpoint
+        # lineage FIRST: an adapted knob (e.g. a defaulted resume flipping
+        # to --batching replacement / --augment 0) must flow into the run
+        # identity below, so the adapted run's logs and stat_info land
+        # under the matching 'wr'/'noaug'-tagged lineage, not the default
+        # one.
+        if args.checkpoint_dir:
+            from ..utils.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(
+                args.checkpoint_dir,
+                run_identity(args, algo_name, for_checkpoint=True))
+            last = ckpt_mgr.latest_step()
+            if last is not None:
+                _resolve_lineage_semantics(
+                    args, ckpt_mgr.load_metadata(last) or {}, last,
+                    ckpt_mgr.directory)
+        identity = run_identity(args, algo_name)
+        configure_console()
+        log_handler = add_run_file_logger(
+            args.log_dir, getattr(args, "logfile", "") or identity)
         logger.info("run identity: %s", identity)
         seed_everything(args.seed)
 
@@ -400,39 +510,16 @@ def run_experiment(args: argparse.Namespace,
             mesh = maybe_shard(algo, args)
         if mesh is not None:
             logger.info("sharding clients over mesh %s", dict(mesh.shape))
+        _check_augment_consistency(args, algo)
 
         state = None
         start_round = 0
-        if args.checkpoint_dir:
-            from ..utils.checkpoint import CheckpointManager
-
-            ckpt_mgr = CheckpointManager(
-                args.checkpoint_dir,
-                run_identity(args, algo_name, for_checkpoint=True))
-            if args.resume:
-                restored = ckpt_mgr.restore_latest(
-                    algo.init_state(jax.random.PRNGKey(args.seed)))
-                if restored is not None:
-                    state, start_round = restored
-                    logger.info("resumed from round %d", start_round)
-            else:
-                # fresh run into a dir holding a DIFFERENT-semantics
-                # lineage (metric-protocol tags share checkpoint
-                # identities, config.run_identity): refuse before
-                # overwriting it round by round
-                last = ckpt_mgr.latest_step()
-                if last is not None:
-                    prev_meta = ckpt_mgr.load_metadata(last) or {}
-                    pb = prev_meta.get("batching")
-                    here = getattr(args, "batching", "epoch")
-                    if pb is not None and pb != here:
-                        raise SystemExit(
-                            f"checkpoint dir {ckpt_mgr.directory} holds a "
-                            f"--batching {pb} lineage up to round {last}; "
-                            f"running --batching {here} over it would mix "
-                            "training semantics. Resume it with --batching "
-                            f"{pb}, or start a fresh lineage (--tag or a "
-                            "different --checkpoint_dir).")
+        if ckpt_mgr is not None and args.resume:
+            restored = ckpt_mgr.restore_latest(
+                algo.init_state(jax.random.PRNGKey(args.seed)))
+            if restored is not None:
+                state, start_round = restored
+                logger.info("resumed from round %d", start_round)
 
         if state is None:
             state = algo.init_state(jax.random.PRNGKey(args.seed))
@@ -459,28 +546,10 @@ def run_experiment(args: argparse.Namespace,
             samples_per_client = algo.hp.local_epochs * int(
                 np.mean(host_client_counts(data.n_train)))
         if start_round > 0:
+            # semantics reconciliation already ran pre-build
+            # (_resolve_lineage_semantics); only the cost sidecar is left
             meta = (ckpt_mgr.load_metadata(start_round)
                     if ckpt_mgr is not None else None)
-            batching = getattr(args, "batching", "epoch")
-            ck_batching = (meta or {}).get("batching")
-            if ck_batching is not None and ck_batching != batching:
-                # the default flipped to epoch batching in round 3; a
-                # lineage checkpointed under the other mode must not be
-                # silently continued with different training semantics
-                raise SystemExit(
-                    f"checkpoint at round {start_round} was trained with "
-                    f"--batching {ck_batching}, but this run uses "
-                    f"--batching {batching}. Pass --batching {ck_batching} "
-                    "to continue the lineage, or start a fresh one "
-                    "(different --checkpoint_dir or --tag).")
-            if ck_batching is None:
-                logger.warning(
-                    "checkpoint has no recorded batching mode (pre-round-3 "
-                    "lineage, with-replacement semantics); continuing with "
-                    "--batching %s — rerun with --batching replacement to "
-                    "preserve the original semantics (same checkpoint "
-                    "lineage; logs/stat_info split under the 'wr' tag)",
-                    batching)
             cost_meta = (meta or {}).get("cost") or {}
             if "sum_training_flops" in cost_meta:
                 # exact totals persisted at save time (required for
@@ -534,7 +603,9 @@ def run_experiment(args: argparse.Namespace,
                 ckpt_mgr.save(r + 1, state,
                               metadata={"cost": cost.snapshot_totals(),
                                         "batching": getattr(
-                                            args, "batching", "epoch")})
+                                            args, "batching", "epoch"),
+                                        "augment": algo.augment_fn
+                                        is not None})
 
         fin_rec = None
         # checkpoints are saved inside the round loop (pre-finalize), so a

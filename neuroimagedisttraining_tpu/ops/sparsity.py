@@ -73,7 +73,8 @@ def mask_density(mask: Any) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int,
-                       stratified: bool = False, num_classes: int = 2):
+                       stratified: bool = False, num_classes: int = 2,
+                       augment_fn=None):
     """Build the per-client SNIP scoring function.
 
     ``snip_scores(params, x, y, n_valid, rng, n_iters)`` samples
@@ -88,6 +89,11 @@ def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int,
     sampling each scoring batch with per-example probability
     ∝ 1/count(class) so every class contributes equally to the saliency
     mean (documented deviation: balanced draws instead of exact folds).
+
+    ``augment_fn``: the same jittable training-time augmentation the local
+    SGD steps apply — the reference's SNIP batches come from the
+    transform-bearing train DataLoader (``client.py:45``), so on CIFAR the
+    mask is selected from saliency over AUGMENTED images.
     """
     loss_fn = make_loss_fn(loss_type)
 
@@ -127,9 +133,12 @@ def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int,
                 idx = jax.random.randint(
                     k_idx, (batch_size,), 0, jnp.maximum(n_valid, 1)
                 )
+            xb = jnp.take(x, idx, axis=0)
+            if augment_fn is not None:
+                k_aug, k_drop = jax.random.split(k_drop)
+                xb = augment_fn(k_aug, xb)
             s = batch_scores(
-                params, jnp.take(x, idx, axis=0), jnp.take(y, idx, axis=0),
-                k_drop,
+                params, xb, jnp.take(y, idx, axis=0), k_drop,
             )
             return jax.tree_util.tree_map(jnp.add, carry, s), None
 

@@ -1,0 +1,280 @@
+"""Training-time CIFAR augmentation wiring (VERDICT r3 missing #1).
+
+The reference trains EVERY CIFAR/tiny batch through
+RandomCrop(H, padding=4) + RandomHorizontalFlip
+(``cifar10/data_loader.py:46-50`` — the transform lives in the train
+DataLoader, there is no off switch). Here the same pipeline is a jittable
+op (:func:`data.cifar.random_crop_flip`) applied to every gathered batch
+inside the scanned local step (``core/trainer.py``), auto-enabled when the
+loader declares the dataset augmentable (``FederatedData.aug_pad_value``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.algorithms import FedAvg
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.core.trainer import make_client_update
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.data.cifar import random_crop_flip
+from neuroimagedisttraining_tpu.models import create_model, make_apply_fn
+
+
+# -- the op itself -----------------------------------------------------------
+
+def test_random_crop_flip_pad_value_ring():
+    """torchvision pads the RAW image with black before Normalize, so the
+    ring must be (0-mean)/std — for a constant image every output pixel is
+    either the constant or the per-channel pad value, and with offsets
+    forced to the corner the ring is visible."""
+    pv = np.array([-1.5, 0.5, 2.0], np.float32)
+    x = np.full((64, 8, 8, 3), 7.0, np.float32)
+    out = np.asarray(random_crop_flip(
+        jax.random.PRNGKey(3), x, padding=4, pad_value=pv))
+    assert out.shape == x.shape
+    for c in range(3):
+        vals = np.unique(out[..., c])
+        assert set(np.round(vals, 5)) <= {7.0, np.round(pv[c], 5)}, vals
+    # over 64 images with offsets in [0,8], some crop hits the ring
+    assert (out != 7.0).any()
+
+
+def test_random_crop_flip_preserves_interior_pixels_bitexact():
+    """Un-padded pixels must pass through bit-exactly (the ring is set via
+    select, not arithmetic that would perturb the interior)."""
+    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype(np.float32)
+    out = np.asarray(random_crop_flip(
+        jax.random.PRNGKey(0), x, padding=4,
+        pad_value=np.array([9.0, 9.0, 9.0], np.float32)))
+    interior = out[out != 9.0]
+    pool = set(x.ravel().tolist())
+    assert all(v in pool for v in interior.ravel().tolist()[:200])
+
+
+# -- trainer wiring ----------------------------------------------------------
+
+def _tiny_update(augment_fn):
+    model = create_model("cnn_cifar10", num_classes=4)
+    apply_fn = make_apply_fn(model)
+    hp = HyperParams(lr=0.05, momentum=0.9, local_epochs=1,
+                     steps_per_epoch=2, batch_size=4)
+    upd = make_client_update(apply_fn, "ce", hp, augment_fn=augment_fn)
+    from neuroimagedisttraining_tpu.models import init_params
+
+    params = init_params(model, jax.random.PRNGKey(0), (16, 16, 3))
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    mask = jax.tree_util.tree_map(jnp.ones_like, params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+    y = jnp.arange(8) % 4
+    out, _, loss = jax.jit(upd)(
+        params, mom, mask, jax.random.PRNGKey(2), x, y, jnp.int32(8),
+        jnp.float32(0), params)
+    return out, float(loss)
+
+
+def test_augment_fn_applied_inside_step():
+    """A zeroing augment_fn must change training (conv kernels get zero
+    gradients), while an identity augment_fn reproduces the un-augmented
+    run on a dropout-free model — proof the hook sits on the training
+    batch path and nowhere else."""
+    base, base_loss = _tiny_update(None)
+    ident, ident_loss = _tiny_update(lambda k, xb: xb)
+    zeros, _ = _tiny_update(lambda k, xb: jnp.zeros_like(xb))
+    np.testing.assert_array_equal(
+        np.asarray(base["Conv_0"]["kernel"]),
+        np.asarray(ident["Conv_0"]["kernel"]))
+    assert base_loss == ident_loss
+    assert not np.allclose(np.asarray(base["Conv_0"]["kernel"]),
+                           np.asarray(zeros["Conv_0"]["kernel"]))
+
+
+def test_augment_auto_wiring_from_dataset_metadata():
+    """augment="auto" (the default) turns on exactly when the loader set
+    aug_pad_value; False disables; plain synthetic data gets none."""
+    data = make_synthetic_federated(
+        n_clients=2, samples_per_client=8, test_per_client=4,
+        sample_shape=(16, 16, 3), loss_type="ce", class_num=4, seed=0)
+    model = create_model("cnn_cifar10", num_classes=4)
+    hp = HyperParams(local_epochs=1, steps_per_epoch=1, batch_size=4)
+    assert FedAvg(model, data, hp, loss_type="ce").augment_fn is None
+
+    aug_data = data.replace(aug_pad_value=(-1.9, -2.0, -1.7))
+    algo = FedAvg(model, aug_data, hp, loss_type="ce")
+    assert algo.augment_fn is not None
+    np.testing.assert_allclose(
+        algo.augment_fn.keywords["pad_value"], [-1.9, -2.0, -1.7])
+    assert FedAvg(model, aug_data, hp, loss_type="ce",
+                  augment=False).augment_fn is None
+
+
+def test_cifar_loader_declares_aug_pad_value(tmp_path):
+    """The CIFAR loaders must declare the reference's augmentation contract
+    with the black-pixel pad value in normalized space."""
+    from neuroimagedisttraining_tpu.data.cifar import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+        load_partition_data_cifar,
+    )
+    import pickle
+
+    base = tmp_path / "cifar-10-batches-py"
+    base.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(1, 6):
+        with open(base / f"data_batch_{i}", "wb") as f:
+            pickle.dump({"data": rng.randint(0, 255, (20, 3072), np.uint8),
+                         "labels": rng.randint(0, 10, 20).tolist()}, f)
+    with open(base / "test_batch", "wb") as f:
+        pickle.dump({"data": rng.randint(0, 255, (20, 3072), np.uint8),
+                     "labels": rng.randint(0, 10, 20).tolist()}, f)
+    data = load_partition_data_cifar(str(tmp_path), "cifar10",
+                                     client_number=2, seed=0)
+    np.testing.assert_allclose(
+        data.aug_pad_value, (0.0 - CIFAR10_MEAN) / CIFAR10_STD, rtol=1e-6)
+
+
+def test_fedavg_learns_2d_with_augmentation_on():
+    """End-to-end: the augmented CIFAR-shaped path still learns well above
+    chance — augmentation regularizes, it must not break training."""
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=24, test_per_client=12,
+        sample_shape=(16, 16, 3), loss_type="ce", class_num=4, seed=1)
+    data = data.replace(aug_pad_value=(0.0, 0.0, 0.0))
+    model = create_model("cnn_cifar10", num_classes=4)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9, weight_decay=0.0,
+                     grad_clip=10.0, local_epochs=1, steps_per_epoch=3,
+                     batch_size=8)
+    algo = FedAvg(model, data, hp, loss_type="ce", frac=1.0, seed=0)
+    assert algo.augment_fn is not None
+    state, _ = algo.run(comm_rounds=10, eval_every=0, finalize=False)
+    ev = algo.evaluate(state)
+    assert ev["global_acc"] > 0.5, float(ev["global_acc"])  # chance = 0.25
+
+
+# -- checkpoint lineage guards (ADVICE r3) -----------------------------------
+
+def _args(dataset="cifar10", resume=False, **kw):
+    import argparse
+
+    ns = argparse.Namespace(
+        dataset=dataset, resume=resume, batching="epoch",
+        batching_explicit=False, augment=1, augment_explicit=False)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def _resolve(args, meta):
+    from neuroimagedisttraining_tpu.experiments.runner import (
+        _resolve_lineage_semantics,
+    )
+
+    return _resolve_lineage_semantics(args, meta, 3, "<dir>")
+
+
+def test_sidecarless_resume_defaults_to_replacement():
+    """A pre-round-3 lineage (no batching sidecar) can only hold
+    with-replacement semantics: a resume under the since-flipped default
+    must continue THOSE semantics, not warn and mix (ADVICE r3 medium)."""
+    args = _args(dataset="synthetic", resume=True)
+    _resolve(args, {})
+    assert args.batching == "replacement"
+
+
+def test_sidecarless_resume_explicit_epoch_refused():
+    args = _args(dataset="synthetic", resume=True, batching_explicit=True)
+    with pytest.raises(SystemExit, match="batching"):
+        _resolve(args, {})
+
+
+def test_sidecarless_fresh_run_refused():
+    """The fresh-run overwrite guard must also treat a sidecar-less lineage
+    as replacement semantics (ADVICE r3 low #2)."""
+    args = _args(dataset="synthetic", resume=False)
+    with pytest.raises(SystemExit, match="batching"):
+        _resolve(args, {})
+
+
+def test_preaugment_lineage_resume_defaults_to_noaugment():
+    """A pre-round-4 CIFAR lineage trained without augmentation; resuming
+    under the new augmented default must continue un-augmented."""
+    args = _args(dataset="cifar10", resume=True)
+    _resolve(args, {"batching": "epoch"})
+    assert args.augment == 0
+
+
+def test_preaugment_lineage_resume_explicit_augment_refused():
+    args = _args(dataset="cifar10", resume=True, augment_explicit=True)
+    with pytest.raises(SystemExit, match="augment"):
+        _resolve(args, {"batching": "epoch"})
+
+
+def test_augment_mismatch_fresh_run_refused():
+    args = _args(dataset="cifar10", resume=False, augment=0,
+                 augment_explicit=True)
+    with pytest.raises(SystemExit, match="augment"):
+        _resolve(args, {"batching": "epoch", "augment": True})
+
+
+def test_matching_lineage_passes():
+    args = _args(dataset="cifar10", resume=True)
+    _resolve(args, {"batching": "epoch", "augment": True})
+    assert args.batching == "epoch" and args.augment == 1
+    args = _args(dataset="synthetic", resume=False)
+    _resolve(args, {"batching": "epoch", "augment": False})
+
+
+def test_adapted_resume_lands_under_adapted_identity(tmp_path):
+    """When a sidecar-less (pre-round-3) lineage adapts the defaulted
+    --batching to replacement on resume, the run identity must carry the
+    'wr' tag so the adapted run's logs/stat_info split from the
+    epoch-semantics lineage (code-review r4 finding)."""
+    import jax
+
+    from neuroimagedisttraining_tpu.experiments.config import (
+        parse_args,
+        run_identity,
+    )
+    from neuroimagedisttraining_tpu.experiments.runner import (
+        build_algorithm,
+        run_experiment,
+    )
+    from neuroimagedisttraining_tpu.utils.checkpoint import CheckpointManager
+
+    common = ["--algo", "local", "--model", "small3dcnn",
+              "--dataset", "synthetic", "--client_num_in_total", "2",
+              "--frac", "1.0", "--epochs", "1", "--batch_size", "4",
+              "--comm_round", "2", "--frequency_of_the_test", "0",
+              "--mesh_devices", "1",  # fabricated state is single-device
+              "--checkpoint_dir", str(tmp_path / "ck"),
+              "--results_dir", "", "--log_dir", ""]
+    # fabricate a legacy lineage: round-1 state, NO batching sidecar —
+    # the state template must match, so build with replacement semantics
+    args0 = parse_args(common + ["--batching", "replacement"])
+    algo, _ = build_algorithm(args0, "local")
+    mgr = CheckpointManager(str(tmp_path / "ck"),
+                            run_identity(args0, "local",
+                                         for_checkpoint=True))
+    mgr.save(1, algo.init_state(jax.random.PRNGKey(args0.seed)),
+             metadata={"cost": {}})
+    mgr.close()
+
+    out = run_experiment(parse_args(common + ["--resume"]))
+    assert "wr" in out["identity"].split("-"), out["identity"]
+    assert [h["round"] for h in out["history"]] == [1]
+
+
+def test_recorded_lineage_defaulted_resume_adapts():
+    """Once an adapted lineage starts RECORDING its semantics
+    (batching=replacement / augment=0 sidecars), the same defaulted resume
+    command must keep working — defaulted knobs adapt to the recorded
+    lineage on resume instead of refusing (code-review r4)."""
+    args = _args(dataset="synthetic", resume=True)
+    _resolve(args, {"batching": "replacement", "augment": False})
+    assert args.batching == "replacement"
+
+    args = _args(dataset="cifar10", resume=True)
+    _resolve(args, {"batching": "epoch", "augment": False})
+    assert args.augment == 0
