@@ -38,8 +38,13 @@ TARGET_ROUNDS_PER_SEC = 10.0  # BASELINE.json north star (v4-32)
 MODEL_KEY = "3dcnn_s2d"  # tests override with a CI-scale model
 
 
-def _device_synth_data(n_clients, n, shape, key):
-    """Generate the federated dataset directly on device (HBM-resident)."""
+def _device_synth_data(n_clients, n, shape, key, uneven=False):
+    """Generate the federated dataset directly on device (HBM-resident).
+
+    ``uneven=True`` draws per-client counts in [n/2, n] (deterministic) so
+    ``_full_batches()`` is False and the masked-epoch machinery — per-
+    example batch weights + no-op step selects, what real uneven ABCD
+    cohorts exercise — is actually priced (ADVICE r3)."""
     from neuroimagedisttraining_tpu.data.types import FederatedData
 
     from neuroimagedisttraining_tpu.ops.s2d import phased_sample_shape
@@ -54,7 +59,12 @@ def _device_synth_data(n_clients, n, shape, key):
     y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
     # plant a mean-shift signal so losses stay in a realistic regime
     x = x + 0.75 * (y[..., None, None, None, None].astype(x.dtype) * 2 - 1)
-    counts = jnp.full((n_clients,), n, jnp.int32)
+    if uneven:
+        counts = jnp.asarray(
+            np.random.RandomState(0).randint(n // 2, n + 1, n_clients),
+            jnp.int32)
+    else:
+        counts = jnp.full((n_clients,), n, jnp.int32)
     m = max(4, n // 4)
     return FederatedData(
         x_train=x, y_train=y, n_train=counts,
@@ -93,13 +103,14 @@ def _timed_rounds(algo, state, n_rounds=10, eval_every_round=False):
     return n_rounds / (time.perf_counter() - t0)
 
 
-def main():
+def main(uneven: bool = False):
     from neuroimagedisttraining_tpu.algorithms import SalientGrads
     from neuroimagedisttraining_tpu.core.state import HyperParams
     from neuroimagedisttraining_tpu.models import create_model
 
     data = _device_synth_data(
-        N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0)
+        N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0),
+        uneven=uneven,
     )
     model = create_model(MODEL_KEY, num_classes=1)
     import os
@@ -167,7 +178,8 @@ def main():
     result = {
         "metric": ("salientgrads_rounds_per_sec_abcd_alexnet3d_8clients"
                    if MODEL_KEY == "3dcnn_s2d" else
-                   f"salientgrads_rounds_per_sec_abcd_{MODEL_KEY}_8clients"),
+                   f"salientgrads_rounds_per_sec_abcd_{MODEL_KEY}_8clients")
+        + ("_uneven" if uneven else ""),
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),
@@ -216,11 +228,20 @@ def tracked_config(name: str):
                               jnp.bfloat16)
         y = jax.random.randint(ky, (n_clients, n_per), 0, 10)
         m = 100  # proportional test resample scale (10k/100)
+        from neuroimagedisttraining_tpu.data.cifar import (
+            CIFAR10_MEAN,
+            CIFAR10_STD,
+            black_pad_value,
+        )
+
         data = FederatedData(
             x_train=x, y_train=y,
             n_train=jnp.full((n_clients,), n_per, jnp.int32),
             x_test=x[:, :m], y_test=y[:, :m],
-            n_test=jnp.full((n_clients,), m, jnp.int32), class_num=10)
+            n_test=jnp.full((n_clients,), m, jnp.int32), class_num=10,
+            # the reference augments every CIFAR training batch
+            # (cifar10/data_loader.py:46-50) — price it here too (r4)
+            aug_pad_value=black_pad_value(CIFAR10_MEAN, CIFAR10_STD))
         model = create_model("resnet18", num_classes=10)
         hp = HyperParams(lr=0.1, lr_decay=0.998, momentum=0.9,
                          weight_decay=5e-4, grad_clip=10.0,
@@ -251,6 +272,40 @@ def tracked_config(name: str):
         # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort")
         MODEL_KEY, VOLUME = "3dresnet", (121, 145, 121)
         return main()
+    if name == "agg":
+        # the aggregation term at REAL parameter scale on the REAL chip
+        # (VERDICT r3 item 2): per weighted-sum of the 2.58M-param
+        # AlexNet3D tree over 32 stacked client models. On one chip there
+        # is no ICI hop — this is the HBM-bound contraction floor; the
+        # cross-chip all-reduce adds ~0.2 ms at v4 ICI (BASELINE.md),
+        # and the CPU-mesh dryrun measures GSPMD-vs-shard_map parity.
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _agg_realparams_probe
+
+        from neuroimagedisttraining_tpu.parallel import make_mesh
+
+        # largest mesh <= 8 devices that divides the 32-client axis
+        # (shard_map needs exact divisibility)
+        n_dev = max(d for d in (8, 4, 2, 1) if d <= len(jax.devices()))
+        d = _agg_realparams_probe(make_mesh(n_dev), n_dev, raw=True)
+        result = {
+            "metric": "weighted_sum_aggregation_ms_alexnet3d_32clients",
+            "value": round(d["gspmd_ms"], 3),
+            "unit": "ms/aggregation",
+            "vs_baseline": 0.0,  # term measurement, not a rate
+            "extra": {k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in d.items()},
+        }
+        print(json.dumps(result))
+        return result
+    if name == "uneven":
+        # primary workload with uneven shards ([20,40] samples/client): the
+        # masked epoch path — per-example weights, no-op step selects —
+        # priced instead of assumed (ADVICE r3; the primary cell's equal
+        # 40-sample shards take the full_batches fast path)
+        return main(uneven=True)
     if name == "byzantine":
         # Byzantine-robust 64-client FedAvg with weak-DP defense
         from neuroimagedisttraining_tpu.algorithms import FedAvg
