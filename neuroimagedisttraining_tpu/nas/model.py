@@ -1,8 +1,11 @@
 """Discrete DARTS network built from a Genotype (final-training model).
 
-Rebuild of ``fedml_api/model/cv/darts/model.py`` (Cell from genotype,
-NetworkCIFAR) minus the auxiliary head (aux towers exist for ImageNet-scale
-training; add when needed).
+Rebuild of ``fedml_api/model/cv/darts/model.py``: Cell from genotype,
+NetworkCIFAR, and (since r4) the auxiliary tower — an extra classifier fed
+from the 2/3-depth cell's output at training time whose loss is folded in
+at ``auxiliary_weight`` (``model.py:63-83,148-158``, ``train.py:159-163``).
+Norm layers are GroupNorm(1) instead of BatchNorm, the repo-wide
+substitution for federated/jit friendliness (see models/resnet_gn.py).
 """
 from __future__ import annotations
 
@@ -49,8 +52,9 @@ class GenotypeCell(nn.Module):
                 # isinstance(op, Identity); a reduce-cell skip_connect is a
                 # FactorizedReduce and IS dropped)
                 is_identity = name == "skip_connect" and stride == 1
-                if train and drop_path_prob > 0 and not is_identity \
-                        and drop_path_rng is not None:
+                # gate on rng presence (static), not on the prob — the
+                # caller passes a traced prob for epoch-scheduled drop path
+                if train and not is_identity and drop_path_rng is not None:
                     keep = 1.0 - drop_path_prob
                     key = jax.random.fold_in(drop_path_rng, i * 2 + k)
                     mask = jax.random.bernoulli(
@@ -61,8 +65,37 @@ class GenotypeCell(nn.Module):
         return jnp.concatenate([states[i] for i in concat], axis=-1)
 
 
+class AuxiliaryHeadCIFAR(nn.Module):
+    """The CIFAR auxiliary classifier (``model.py:63-83``): relu →
+    avgpool(5, stride 3, no padding — VALID pooling makes torch's
+    ``count_include_pad=False`` moot) → 1x1 conv to 128 → norm → relu →
+    2x2 conv to 768 → norm → relu → linear. Fed the 2/3-depth cell output
+    (8x8 at CIFAR scale → 2x2 after the pool)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = nn.Conv(128, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=1)(x)
+        x = nn.relu(x)
+        x = nn.Conv(768, (2, 2), use_bias=False, padding="VALID")(x)
+        x = nn.GroupNorm(num_groups=1)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x.reshape(x.shape[0], -1))
+
+
 class NetworkFromGenotype(nn.Module):
-    """NetworkCIFAR equivalent: stem + genotype cells + GAP + classifier."""
+    """NetworkCIFAR equivalent: stem + genotype cells + GAP + classifier.
+
+    ``auxiliary=True`` adds the 2/3-depth auxiliary tower and makes
+    ``__call__`` return ``(logits, logits_aux)`` — ``logits_aux`` is None
+    unless ``train`` (the reference computes it only in training mode,
+    ``model.py:153-156``). ``drop_path_prob`` may be overridden per call
+    with a traced scalar so the reference's epoch-linear schedule
+    (``train.py:127``) doesn't retrace per epoch."""
 
     genotype: Genotype
     C: int = 36
@@ -70,15 +103,23 @@ class NetworkFromGenotype(nn.Module):
     layers: int = 20
     stem_multiplier: int = 3
     drop_path_prob: float = 0.0
+    auxiliary: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 drop_path_prob=None):
+        dpp = (self.drop_path_prob if drop_path_prob is None
+               else drop_path_prob)
+        # static gate: drop-path machinery traces only when the module was
+        # configured with a non-zero max prob (or an override is passed)
+        dp_on = (self.drop_path_prob > 0 or drop_path_prob is not None)
         C_curr = self.stem_multiplier * self.C
         s = nn.Conv(C_curr, (3, 3), use_bias=False)(x)
         s = nn.GroupNorm(num_groups=1)(s)
         s0 = s1 = s
 
+        logits_aux = None
         C_curr = self.C
         reduction_prev = False
         for i in range(self.layers):
@@ -90,11 +131,22 @@ class NetworkFromGenotype(nn.Module):
                 reduction=reduction, reduction_prev=reduction_prev,
             )
             cell_rng = (jax.random.fold_in(rng, i)
-                        if rng is not None else None)
+                        if rng is not None and dp_on else None)
             s0, s1 = s1, cell(
                 s0, s1, train=train,
-                drop_path_rng=cell_rng, drop_path_prob=self.drop_path_prob)
+                drop_path_rng=cell_rng, drop_path_prob=dpp)
             reduction_prev = reduction
+            if self.auxiliary and i == 2 * self.layers // 3:
+                # reference model.py:153-156 — aux tower on the 2/3-depth
+                # cell's output. Always traced so init creates its params;
+                # in eval mode the output is unused (None) and XLA DCEs
+                # the whole head, matching the reference's training-only
+                # compute
+                aux = AuxiliaryHeadCIFAR(num_classes=self.num_classes)(s1)
+                logits_aux = aux if train else None
 
         out = jnp.mean(s1, axis=(1, 2))
-        return nn.Dense(self.num_classes)(out)
+        logits = nn.Dense(self.num_classes)(out)
+        if self.auxiliary:
+            return logits, logits_aux
+        return logits

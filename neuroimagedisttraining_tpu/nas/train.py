@@ -103,13 +103,20 @@ def train_genotype(
     epochs: int = 5, steps_per_epoch: int = 20, batch_size: int = 32,
     lr: float = 0.025, momentum: float = 0.9, weight_decay: float = 3e-4,
     drop_path_prob: float = 0.0, seed: int = 0,
+    auxiliary: bool = False, auxiliary_weight: float = 0.4,
 ):
-    """Final training of the derived architecture (darts/train.py:58-214)."""
+    """Final training of the derived architecture (darts/train.py:58-214).
+
+    ``auxiliary`` adds the 2/3-depth tower and folds its CE loss in at
+    ``auxiliary_weight`` (``train.py:159-163``: ``loss += 0.4*loss_aux``).
+    A non-zero ``drop_path_prob`` follows the reference's epoch-linear
+    schedule ``prob * epoch / epochs`` (``train.py:127``), passed as a
+    traced scalar so the step never retraces."""
     from .model import NetworkFromGenotype
 
     net = NetworkFromGenotype(
         genotype=genotype, C=C, num_classes=num_classes, layers=layers,
-        drop_path_prob=drop_path_prob)
+        drop_path_prob=drop_path_prob, auxiliary=auxiliary)
     key = jax.random.PRNGKey(seed)
     k_init, key = jax.random.split(key)
     x0 = jnp.zeros((1,) + tuple(x_train.shape[1:]), jnp.float32)
@@ -121,25 +128,35 @@ def train_genotype(
     )
     opt_state = opt.init(params)
 
-    def loss_fn(p, batch, rng):
+    def loss_fn(p, batch, rng, dpp):
         xb, yb = batch
-        logits = net.apply({"params": p}, xb, train=True, rng=rng)
-        return jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(logits, yb))
+        # only thread the traced schedule through when drop path is on —
+        # passing it unconditionally would trace the (no-op) mask chain
+        # into every dpp=0 run
+        dp_kw = {"drop_path_prob": dpp} if drop_path_prob > 0 else {}
+        out = net.apply({"params": p}, xb, train=True, rng=rng, **dp_kw)
+        ce = optax.softmax_cross_entropy_with_integer_labels
+        if auxiliary:
+            logits, logits_aux = out
+            return (jnp.mean(ce(logits, yb))
+                    + auxiliary_weight * jnp.mean(ce(logits_aux, yb)))
+        return jnp.mean(ce(out, yb))
 
     @jax.jit
-    def step(params, opt_state, batch, rng):
-        loss, g = jax.value_and_grad(loss_fn)(params, batch, rng)
+    def step(params, opt_state, batch, rng, dpp):
+        loss, g = jax.value_and_grad(loss_fn)(params, batch, rng, dpp)
         updates, opt_state = opt.update(g, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
     history = []
     for epoch in range(epochs):
         total = 0.0
+        # reference train.py:127: drop path ramps linearly over epochs
+        dpp = jnp.float32(drop_path_prob * epoch / max(1, epochs))
         for s in range(steps_per_epoch):
             key, k1, k2 = jax.random.split(key, 3)
             batch = _batch(k1, x_train, y_train, batch_size)
-            params, opt_state, loss = step(params, opt_state, batch, k2)
+            params, opt_state, loss = step(params, opt_state, batch, k2, dpp)
             total += float(loss)
         history.append({"epoch": epoch, "train_loss": total / steps_per_epoch})
         logger.info("darts train %s", history[-1])

@@ -139,3 +139,106 @@ def test_genotype_visualization():
         assert len(paths) == 2
         for p in paths:
             assert os.path.exists(p)
+
+
+# -- auxiliary tower (VERDICT r3 missing #2) ---------------------------------
+
+def test_auxiliary_head_torch_parity():
+    """Forward parity of the aux tower against a torch twin built from the
+    reference architecture (model.py:63-83, GroupNorm(1) standing in for
+    BN per the repo-wide substitution) with transferred weights."""
+    torch = pytest.importorskip("torch")
+    from neuroimagedisttraining_tpu.nas.model import AuxiliaryHeadCIFAR
+
+    C, classes = 16, 7
+    head = AuxiliaryHeadCIFAR(num_classes=classes)
+    x = np.random.RandomState(0).randn(3, 8, 8, C).astype(np.float32)
+    params = head.init(jax.random.PRNGKey(0), jnp.asarray(x))["params"]
+    jx = np.asarray(head.apply({"params": params}, jnp.asarray(x)))
+
+    class TorchAux(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(C, 128, 1, bias=False)
+            self.n1 = torch.nn.GroupNorm(1, 128)
+            self.c2 = torch.nn.Conv2d(128, 768, 2, bias=False)
+            self.n2 = torch.nn.GroupNorm(1, 768)
+            self.fc = torch.nn.Linear(768, classes)
+
+        def forward(self, t):
+            t = torch.relu(t)
+            t = torch.nn.functional.avg_pool2d(
+                t, 5, stride=3, padding=0, count_include_pad=False)
+            t = torch.relu(self.n1(self.c1(t)))
+            t = torch.relu(self.n2(self.c2(t)))
+            return self.fc(t.view(t.size(0), -1))
+
+    net = TorchAux()
+    sd = net.state_dict()
+    sd["c1.weight"] = torch.from_numpy(
+        np.asarray(params["Conv_0"]["kernel"]).transpose(3, 2, 0, 1).copy())
+    sd["n1.weight"] = torch.from_numpy(
+        np.asarray(params["GroupNorm_0"]["scale"]))
+    sd["n1.bias"] = torch.from_numpy(np.asarray(params["GroupNorm_0"]["bias"]))
+    sd["c2.weight"] = torch.from_numpy(
+        np.asarray(params["Conv_1"]["kernel"]).transpose(3, 2, 0, 1).copy())
+    sd["n2.weight"] = torch.from_numpy(
+        np.asarray(params["GroupNorm_1"]["scale"]))
+    sd["n2.bias"] = torch.from_numpy(np.asarray(params["GroupNorm_1"]["bias"]))
+    sd["fc.weight"] = torch.from_numpy(
+        np.asarray(params["Dense_0"]["kernel"]).T.copy())
+    sd["fc.bias"] = torch.from_numpy(np.asarray(params["Dense_0"]["bias"]))
+    net.load_state_dict(sd)
+    tx = net(torch.from_numpy(x.transpose(0, 3, 1, 2).copy()))
+    np.testing.assert_allclose(jx, tx.detach().numpy(), rtol=2e-4, atol=2e-4)
+
+
+def test_network_auxiliary_tower_and_loss_composition():
+    """auxiliary=True: train-mode forward returns both logit sets (aux from
+    the 2/3-depth cell), eval-mode aux is None, and the training loss is
+    main + 0.4*aux exactly (train.py:159-163)."""
+    import optax
+
+    from neuroimagedisttraining_tpu.nas.model import NetworkFromGenotype
+
+    net = NetworkFromGenotype(genotype=DARTS_V2, C=4, num_classes=4,
+                              layers=3, auxiliary=True)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 32, 32, 3)
+                    .astype(np.float32))
+    params = net.init(jax.random.PRNGKey(0), x)["params"]
+    assert any(k.startswith("AuxiliaryHead") for k in params)
+    logits, logits_aux = net.apply({"params": params}, x, train=True)
+    assert logits.shape == (2, 4) and logits_aux.shape == (2, 4)
+    # aux and main heads are different functions of the input
+    assert not np.allclose(np.asarray(logits), np.asarray(logits_aux))
+    eval_logits, eval_aux = net.apply({"params": params}, x, train=False)
+    assert eval_aux is None
+    np.testing.assert_allclose(np.asarray(eval_logits),
+                               np.asarray(logits), atol=1e-5)
+
+    # composition pinned operationally: with weight_decay 0, the aux head's
+    # params move IFF its loss is folded into the total (train.py:159-163) —
+    # auxiliary_weight=0 must leave the head at its init, 0.4 must move it
+    x_np = np.asarray(x)
+    y_np = np.array([1, 3])
+    common = dict(num_classes=4, C=4, layers=3, epochs=1, steps_per_epoch=3,
+                  batch_size=2, weight_decay=0.0, seed=0)
+    _, p0, _ = train_genotype(DARTS_V2, x_np, y_np, auxiliary=True,
+                              auxiliary_weight=0.0, **common)
+    _, p4, hist = train_genotype(DARTS_V2, x_np, y_np, auxiliary=True,
+                                 auxiliary_weight=0.4, **common)
+    assert np.isfinite(hist[-1]["train_loss"])
+    aux_key = next(k for k in p0 if k.startswith("AuxiliaryHead"))
+    # white-box replication of train_genotype's init chain (same seed)
+    k_init, _ = jax.random.split(jax.random.PRNGKey(0))
+    net2 = NetworkFromGenotype(genotype=DARTS_V2, C=4, num_classes=4,
+                               layers=3, auxiliary=True)
+    p_init = net2.init(k_init, jnp.zeros((1, 32, 32, 3)))["params"]
+    flat0 = np.concatenate([np.asarray(v).ravel() for v in
+                            jax.tree_util.tree_leaves(p0[aux_key])])
+    flat4 = np.concatenate([np.asarray(v).ravel() for v in
+                            jax.tree_util.tree_leaves(p4[aux_key])])
+    flat_i = np.concatenate([np.asarray(v).ravel() for v in
+                             jax.tree_util.tree_leaves(p_init[aux_key])])
+    np.testing.assert_allclose(flat0, flat_i, atol=1e-7)  # 0.0: untouched
+    assert np.abs(flat4 - flat_i).max() > 1e-6  # 0.4: trained
