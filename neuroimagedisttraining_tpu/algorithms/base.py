@@ -389,23 +389,46 @@ class FedAlgorithm(abc.ABC):
 
         ``finalize=False`` skips the algorithm's end-of-training pass (e.g.
         FedAvg's final fine-tune) for callers that only need the round loop.
+
+        ``round_time_s`` is stamped at flush boundaries (see
+        utils.records.DeferredRecords): the per-run SUM equals wall time
+        exactly, per-round attribution is ±1 round under the deferred
+        fetch.
         """
+        from ..utils.records import DeferredRecords, to_float
+
         if state is None:
             state = self.init_state(jax.random.PRNGKey(self.seed))
         history: List[Dict[str, Any]] = []
-        for r in range(comm_rounds):
-            t0 = time.perf_counter()
-            state, train_metrics = self.run_round(state, r)
-            record = {"round": r, **{k: _to_float(v) for k, v in train_metrics.items()}}
-            if eval_every and (r + 1) % eval_every == 0:
-                ev = self.evaluate(state)
-                record.update({k: _to_float(v) for k, v in ev.items()
-                               if not k.startswith("acc_per")})
-            record["round_time_s"] = time.perf_counter() - t0
-            history.append(record)
-            logger.info("%s round %d: %s", self.name, r, record)
-            if callback is not None:
-                callback(r, state, record)
+        # metric host-fetches run one round late (utils/records.py): a
+        # callback opts into immediate conversion since it observes
+        # records as they land
+        deferred = DeferredRecords(
+            log=lambda rec: logger.info(
+                "%s round %s: %s", self.name, rec["round"], rec),
+            timed=True)
+        try:
+            for r in range(comm_rounds):
+                t0 = time.perf_counter()
+                state, train_metrics = self.run_round(state, r)
+                record = {"round": r, **dict(train_metrics)}
+                if eval_every and (r + 1) % eval_every == 0:
+                    ev = self.evaluate(state)
+                    record.update({k: v for k, v in ev.items()
+                                   if not k.startswith("acc_per")})
+                history.append(record)
+                if callback is not None:
+                    for k, v in record.items():
+                        record[k] = to_float(v)
+                    record["round_time_s"] = time.perf_counter() - t0
+                    logger.info("%s round %d: %s", self.name, r, record)
+                    callback(r, state, record)
+                else:
+                    deferred.push(record)
+        except BaseException:
+            deferred.flush_safely()  # emit the last completed round
+            raise
+        deferred.flush()
         final_record = None
         if finalize:
             state, final_record = self.finalize(state)

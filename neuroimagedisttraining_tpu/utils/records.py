@@ -1,0 +1,69 @@
+"""One-round-deferred metric materialization.
+
+Converting a device scalar to a python float blocks the host on the
+accelerator; on a tunneled TPU that sync costs ~5x the per-round eval's
+own device time (RESULTS.md round-4 eval anatomy). Both round-loop
+drivers (``FedAlgorithm.run`` and the CLI runner) therefore hold each
+round's record as device values and materialize+log it only after the
+NEXT round's programs are dispatched — same values, same cadence, the
+device queue stays full.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def to_float(v):
+    """0-d device/numpy arrays -> float; python ints/strings/etc. pass
+    through untouched (record keys like ``round`` stay ints)."""
+    if isinstance(v, (jax.Array, np.ndarray)) and np.ndim(v) == 0:
+        return float(v)
+    return v
+
+
+class DeferredRecords:
+    """Holds at most one pending record; ``push`` flushes the previous one.
+
+    ``timed=True`` stamps ``round_time_s`` at flush boundaries (the time
+    since the previous flush), so the SUM over a run equals wall time
+    exactly and per-round attribution is ±1 round — the honest semantics
+    under deferred fetching, where the blocking conversion itself happens
+    between rounds. Call ``flush`` in a ``finally`` so a crash in round r
+    still emits round r-1's already-computed metrics (best-effort: the
+    pending fetch may itself raise if the device is gone).
+    """
+
+    def __init__(self, log: Callable[[Dict[str, Any]], None],
+                 timed: bool = False):
+        self._log = log
+        self._timed = timed
+        self._pending: Optional[Dict[str, Any]] = None
+        self._last_t = time.perf_counter()
+
+    def push(self, record: Dict[str, Any]) -> None:
+        self.flush()
+        self._pending = record
+
+    def flush(self) -> None:
+        rec, self._pending = self._pending, None
+        if rec is None:
+            return
+        for k, v in rec.items():
+            rec[k] = to_float(v)
+        if self._timed:
+            t = time.perf_counter()
+            rec["round_time_s"] = t - self._last_t
+            self._last_t = t
+        self._log(rec)
+
+    def flush_safely(self) -> None:
+        """``flush`` for exception paths: swallow a fetch that dies with
+        the device so the original error propagates instead."""
+        try:
+            self.flush()
+        except Exception:  # pragma: no cover - device-loss path
+            self._pending = None
