@@ -53,8 +53,14 @@ def _device_synth_data(n_clients, n, shape, key, uneven=False):
     # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py),
     # stored bf16 (the compute dtype — skips the per-step convert/relayout);
     # random phased tensors are distributionally the same workload
-    sshape = (phased_sample_shape(shape) if MODEL_KEY == "3dcnn_s2d"
-              else tuple(shape) + (1,))
+    if MODEL_KEY == "3dcnn_s2d":
+        sshape = phased_sample_shape(shape)
+    elif MODEL_KEY == "3dresnet_s2d":
+        sshape = phased_sample_shape(shape, kernel=3, pad=3)
+    elif MODEL_KEY == "small3dcnn_s2d":
+        sshape = phased_sample_shape(shape, kernel=3, pad=1)
+    else:
+        sshape = tuple(shape) + (1,)
     x = jax.random.normal(kx, (n_clients, n) + sshape, jnp.bfloat16)
     y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
     # plant a mean-shift signal so losses stay in a realistic regime
@@ -281,8 +287,14 @@ def tracked_config(name: str):
         print(json.dumps(result))
         return result
     if name == "resnet3d":
-        # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort")
-        MODEL_KEY, VOLUME = "3dresnet", (121, 145, 121)
+        # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort").
+        # Phased-stem twin since r4: the k3/s2/p3 stem at C_in=1 was 66% of
+        # the step; the s2d restatement measures 0.79 vs 0.60 r/s dense
+        # (exactness-tested, tests/test_s2d.py). BENCH_DENSE=1 runs the
+        # reference-layout model for A/B.
+        MODEL_KEY, VOLUME = "3dresnet_s2d", (121, 145, 121)
+        if os.environ.get("BENCH_DENSE"):
+            MODEL_KEY = "3dresnet"
         return main()
     if name == "agg":
         # the aggregation term at REAL parameter scale on the REAL chip
@@ -332,8 +344,14 @@ def tracked_config(name: str):
         model = create_model("small3dcnn", num_classes=1)
         hp = HyperParams(lr=1e-3, momentum=0.9, local_epochs=1,
                          steps_per_epoch=STEPS, batch_size=BATCH)
+        # chunk=16 measured best at the real shape (r4 interleaved sweep:
+        # 8/16/32 = 0.60/0.63/0.63 r/s; the full 64-client vmap fails the
+        # remote compile at this volume). Defense and the personal-model
+        # stack are free (on/off within noise) — RESULTS.md r4 anatomy.
         algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
-                      compute_dtype="bfloat16", client_chunk=8,
+                      compute_dtype="bfloat16",
+                      client_chunk=int(os.environ.get("BENCH_CHUNK", "16"))
+                      or None,
                       defense=RobustAggregator("weak_dp", norm_bound=5.0,
                                                stddev=0.025))
         state = algo.init_state(jax.random.PRNGKey(0))

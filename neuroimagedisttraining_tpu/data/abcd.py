@@ -86,7 +86,7 @@ LAYOUTS = ("channels", "flat", "s2d")
 def _finalize(
     xs_tr, ys_tr, xs_te, ys_te, val_fraction: float, seed: int,
     normalize: bool, layout: str = "channels", pad_to=None,
-    client_ids=None,
+    client_ids=None, s2d_spec=None,
 ) -> FederatedData:
     """Stack per-client splits into FederatedData; optional per-volume
     standardization; optional val split carved from train (the FedFomo
@@ -128,7 +128,10 @@ def _finalize(
             if layout == "s2d":
                 from ..ops.s2d import phase_decompose
 
-                x = np.asarray(phase_decompose(x))
+                # (kernel, pad) of the stem the phases feed: (5, 0) for
+                # the AlexNet3D stem (default), (3, 3) for ResNet_l3
+                k, pd = s2d_spec or (5, 0)
+                x = np.asarray(phase_decompose(x, kernel=k, pad=pd))
         return x
 
     xs_va, ys_va = [], []
@@ -193,6 +196,7 @@ def load_partition_data_abcd(
     seed: int = ABCD_SPLIT_SEED,
     layout: str = "channels",
     client_filter=None,
+    s2d_spec=None,
 ) -> FederatedData:
     """One federated client per acquisition site (``data_loader.py:164-216``).
 
@@ -223,7 +227,8 @@ def load_partition_data_abcd(
     ids = (list(range(len(splits))) if client_filter is None
            else [int(c) for c in client_filter])
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout, pad_to=pad_to, client_ids=ids)
+                     normalize, layout, pad_to=pad_to, client_ids=ids,
+                     s2d_spec=s2d_spec)
 
 
 def load_partition_data_abcd_rescale(
@@ -234,6 +239,7 @@ def load_partition_data_abcd_rescale(
     seed: int = ABCD_SPLIT_SEED,
     layout: str = "channels",
     client_filter=None,
+    s2d_spec=None,
 ) -> FederatedData:
     """Merge all sites' train/test pools (site order), then contiguous equal
     reshard to ``client_number`` clients — ``data_loader.py:220-319``. Client
@@ -270,7 +276,7 @@ def load_partition_data_abcd_rescale(
                     len(rows_te))
     _close_if_h5(X)
     return _finalize(xs_tr, ys_tr, xs_te, ys_te, val_fraction, seed,
-                     normalize, layout, pad_to=pad_to,
+                     normalize, layout, pad_to=pad_to, s2d_spec=s2d_spec,
                      client_ids=list(clients))
 
 

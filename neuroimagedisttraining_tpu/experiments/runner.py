@@ -30,6 +30,14 @@ def seed_everything(seed: int) -> None:
     np.random.seed(seed)
 
 
+# phased-stem twins of the reference models, with each stem's
+# (kernel, pad) decomposition spec (ops/s2d.py)
+S2D_TWINS = {"3dcnn": "3dcnn_s2d", "3dresnet": "3dresnet_s2d",
+             "small3dcnn": "small3dcnn_s2d"}
+S2D_SPECS = {"3dcnn_s2d": (5, 0), "3dresnet_s2d": (3, 3),
+             "small3dcnn_s2d": (3, 1)}
+
+
 def build_data(args: argparse.Namespace, client_filter=None):
     from ..data import load_federated_data
 
@@ -40,6 +48,10 @@ def build_data(args: argparse.Namespace, client_filter=None):
         kwargs["samples_per_client"] = max(args.batch_size, 16)
     elif _is_abcd_h5(args.dataset):
         kwargs["layout"] = getattr(args, "layout", "channels")
+        if kwargs["layout"] == "s2d":
+            # decompose for the stem the resolved model actually has
+            mk = S2D_TWINS.get(args.model, args.model)
+            kwargs["s2d_spec"] = S2D_SPECS.get(mk)
         if client_filter is not None:
             kwargs["client_filter"] = client_filter
     return load_federated_data(
@@ -176,17 +188,16 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             f"--layout {layout} requires an ABCD cohort dataset "
             "(abcd | abcd_site | abcd_rescale); other loaders store NDHWC")
     if layout == "s2d":
-        if model_key == "3dcnn":
-            model_key = "3dcnn_s2d"  # the phased-stem twin of the same model
-        elif model_key != "3dcnn_s2d":
+        model_key = S2D_TWINS.get(model_key, model_key)
+        if model_key not in S2D_SPECS:
             raise SystemExit(
                 f"--layout s2d feeds phase-decomposed input that only the "
                 f"s2d-stem models consume; --model {model_key} would "
-                "misread the phase axis. Use --model 3dcnn (auto-mapped) "
-                "or drop --layout s2d")
-    elif model_key == "3dcnn_s2d":
+                "misread the phase axis. Use --model "
+                f"{'/'.join(S2D_TWINS)} (auto-mapped) or drop --layout s2d")
+    elif model_key in S2D_SPECS:
         raise SystemExit(
-            "--model 3dcnn_s2d consumes phase-decomposed input; pair it "
+            f"--model {model_key} consumes phase-decomposed input; pair it "
             f"with --layout s2d (got --layout {layout})")
 
     if getattr(args, "client_optimizer", "sgd") != "sgd":

@@ -17,13 +17,17 @@ from .alexnet3d import (
     AlexNet3DRegression,
     AlexNet3DS2D,
     SmallCNN3D,
+    SmallCNN3DS2D,
 )
 
 ApplyFn = Callable[..., Any]
 
 
 def _registry():
-    from .resnet3d import ResNet3DL3  # local import: keeps zoo modular
+    from .resnet3d import (  # local import: keeps zoo modular
+        ResNet3DL3,
+        ResNet3DL3S2D,
+    )
     from .resnet2d import ResNet18GN, TinyResNet18
     from .cnn2d import (
         CNNCifar10,
@@ -50,6 +54,9 @@ def _registry():
             num_outputs=num_classes, **kw
         ),
         "3dresnet": lambda num_classes, **kw: ResNet3DL3(num_classes=num_classes, **kw),
+        # TPU-fast ResNet_l3 over phase-decomposed input (k3/p3 stem spec,
+        # ops/s2d.py): the stem stage is 66% of the full-volume step (r4)
+        "3dresnet_s2d": lambda num_classes, **kw: ResNet3DL3S2D(num_classes=num_classes, **kw),
         "resnet18": lambda num_classes, **kw: ResNet18GN(num_classes=num_classes, **kw),
         # BatchNorm variant (forward/eval parity; mutable batch_stats —
         # FL trainers use the GN twin, models/resnet2d.py docstring)
@@ -71,6 +78,8 @@ def _registry():
         "resnet50_gn": lambda num_classes, **kw: resnet50_gn(num_classes=num_classes, **kw),
         # CI/test model
         "small3dcnn": lambda num_classes, **kw: SmallCNN3D(num_classes=num_classes, **kw),
+        # phased twin (k3/s2/p1 stem spec — ops/s2d.py)
+        "small3dcnn_s2d": lambda num_classes, **kw: SmallCNN3DS2D(num_classes=num_classes, **kw),
     }
 
 

@@ -108,26 +108,91 @@ def _group_stats(zf, groups, eps):
     return mu_c, sig_c
 
 
-class S2DStemStage(nn.Module):
-    """Fused stem stage: phased conv + GroupNorm + ReLU + MaxPool3(s3) with
-    the pool hoisted before the normalize affine ("pool-first").
+def phased_stem_stage(mdl: nn.Module, x, *, stem_kernel: int, features: int,
+                      max_groups: int, pool, use_bias: bool,
+                      pool_first: bool, eps: float):
+    """THE pool-first fused stem implementation, shared by every phased
+    stem stage (AlexNet3D k5 stem, ResNet_l3 k3 stem).
 
-    Exact restatement of ``S2DStem -> GroupNorm -> relu -> max_pool3d(3,3)``
-    (same function, verified to 1e-6): max-pool commutes with the monotone
-    per-channel affine+relu — channels with negative GroupNorm scale need
-    the window *min*, which is obtained by folding ``sign(scale)`` into the
-    conv kernel so exactly ONE pool runs on the conv output and the
-    full-size normalized tensor is never materialized. On TPU the training
-    step is HBM-bandwidth-bound in this stage; dropping that 253 MB
-    materialization measures ~15-20% faster end-to-end (RESULTS.md r2).
+    Computes ``masked phased conv [+ bias] -> GroupNorm -> relu ->
+    max_pool3d(*pool)`` with the pool hoisted before the normalize affine:
+    max-pool commutes with the monotone per-channel affine+relu — channels
+    with negative GroupNorm scale need the window *min*, obtained by
+    folding ``sign(scale)`` into the conv kernel so exactly ONE pool runs
+    on the conv output and the full-size normalized tensor is never
+    materialized (~15-20% faster end-to-end, RESULTS.md r2). The GN
+    statistics always come from the PRE-pool conv output. ``pool_first=
+    False`` computes the textbook order with the same params
+    (equivalence testing / fallback).
 
-    Params: ``kernel``/``bias`` (the masked phased conv — SNIP, weight
-    decay and the copy converter see the usual "kernel" leaf) and
-    ``scale``/``bias_gn`` (the GroupNorm affine pair).
-
-    ``pool_first=False`` computes the textbook order with the SAME
-    parameters (equivalence testing / fallback).
+    Creates params on ``mdl``: ``kernel`` (masked phased conv — SNIP,
+    weight decay and the converters see the usual "kernel" leaf),
+    optional ``bias``, and ``scale``/``bias_gn`` (the GN affine pair);
+    sows ``conv_out`` at the conv's resolution for the FLOPs counter
+    (utils/flops.py reads it to cost fused stages correctly).
     """
+    from ..ops.s2d import N_PHASES, r_kernel, stem_slot_mask
+
+    F = features
+    g = min(max_groups, F)
+    while F % g:
+        g -= 1
+    r = r_kernel(stem_kernel)
+    w = mdl.param(
+        "kernel",
+        nn.initializers.variance_scaling(
+            # fan_in counts all r^3*8 slots; only kernel^3 carry taps
+            (r ** 3 * N_PHASES) / float(stem_kernel ** 3),
+            "fan_in", "truncated_normal",
+            in_axis=(0, 1, 2, 3), batch_axis=()),
+        (r,) * 3 + (N_PHASES, F),
+    )
+    b = mdl.param("bias", nn.initializers.zeros, (F,)) if use_bias else None
+    gamma = mdl.param("scale", nn.initializers.ones, (F,))
+    beta = mdl.param("bias_gn", nn.initializers.zeros, (F,))
+    mask = jnp.asarray(stem_slot_mask(stem_kernel), w.dtype)
+    dn_args = ("NDHCW", "DHWIO", "NDHWC")
+    pk, ps, pp = pool
+
+    if not pool_first:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_args)
+        z = lax.conv_general_dilated(
+            x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn)
+        if b is not None:
+            z = z + b
+        mdl.sow("intermediates", "conv_out", z)
+        # normalize explicitly with this module's own affine params
+        zf = z.astype(jnp.float32)
+        mu_c, sig_c = _group_stats(zf, g, eps)
+        y = (zf - mu_c) / sig_c * gamma + beta
+        y = nn.relu(y).astype(z.dtype)
+        return max_pool3d(y, kernel=pk, strides=ps, padding=pp)
+
+    sign = jnp.where(gamma >= 0, 1.0, -1.0).astype(w.dtype)
+    ws = (w * mask) * sign
+    dn = lax.conv_dimension_numbers(x.shape, ws.shape, dn_args)
+    zs = lax.conv_general_dilated(
+        x, ws, (1, 1, 1), "VALID", dimension_numbers=dn)
+    if b is not None:
+        zs = zs + (b * sign.astype(b.dtype))
+    mdl.sow("intermediates", "conv_out", zs)
+    # group stats of z = zs * sign, in f32
+    sf = sign.astype(jnp.float32)
+    zf = zs.astype(jnp.float32) * sf
+    mu_c, sig_c = _group_stats(zf, g, eps)
+    # ONE pool on zs = max over window of z for scale>=0 channels,
+    # -min for scale<0 channels (flax pads max-pool with -inf, so a
+    # padded pool ring never wins the selection)
+    m = max_pool3d(zs, kernel=pk, strides=ps, padding=pp)
+    sel = m.astype(jnp.float32) * sf
+    y = (sel - mu_c) / sig_c * gamma + beta
+    return nn.relu(y).astype(zs.dtype)
+
+
+class S2DStemStage(nn.Module):
+    """AlexNet3D fused stem stage (k5/s2 phased conv + GN + relu +
+    MaxPool3(3,3)) — see :func:`phased_stem_stage` for the derivation and
+    the param contract."""
 
     features: int = 64
     max_groups: int = 32
@@ -136,54 +201,12 @@ class S2DStemStage(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from ..ops.s2d import N_PHASES, R_KERNEL, stem_slot_mask
+        from ..ops.s2d import KERNEL
 
-        F = self.features
-        g = min(self.max_groups, F)
-        while F % g:
-            g -= 1
-        w = self.param(
-            "kernel",
-            nn.initializers.variance_scaling(
-                216.0 / 125.0, "fan_in", "truncated_normal",
-                in_axis=(0, 1, 2, 3), batch_axis=()),
-            (R_KERNEL,) * 3 + (N_PHASES, F),
-        )
-        b = self.param("bias", nn.initializers.zeros, (F,))
-        gamma = self.param("scale", nn.initializers.ones, (F,))
-        beta = self.param("bias_gn", nn.initializers.zeros, (F,))
-        mask = jnp.asarray(stem_slot_mask(), w.dtype)
-        dn_args = ("NDHCW", "DHWIO", "NDHWC")
-
-        if not self.pool_first:
-            dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_args)
-            z = lax.conv_general_dilated(
-                x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn) + b
-            self.sow("intermediates", "conv_out", z)
-            # normalize explicitly with this module's own affine params
-            zf = z.astype(jnp.float32)
-            mu_c, sig_c = _group_stats(zf, g, self.eps)
-            y = (zf - mu_c) / sig_c * gamma + beta
-            y = nn.relu(y).astype(z.dtype)
-            return max_pool3d(y, kernel=3, strides=3)
-
-        sign = jnp.where(gamma >= 0, 1.0, -1.0).astype(w.dtype)
-        ws = (w * mask) * sign
-        dn = lax.conv_dimension_numbers(x.shape, ws.shape, dn_args)
-        zs = lax.conv_general_dilated(
-            x, ws, (1, 1, 1), "VALID", dimension_numbers=dn)
-        zs = zs + (b * sign.astype(b.dtype))
-        self.sow("intermediates", "conv_out", zs)
-        # group stats of z = zs * sign, in f32
-        sf = sign.astype(jnp.float32)
-        zf = zs.astype(jnp.float32) * sf
-        mu_c, sig_c = _group_stats(zf, g, self.eps)
-        # ONE pool on zs = max over window of z for scale>=0 channels,
-        # -min for scale<0 channels
-        m = max_pool3d(zs, kernel=3, strides=3)
-        sel = m.astype(jnp.float32) * sf
-        y = (sel - mu_c) / sig_c * gamma + beta
-        return nn.relu(y).astype(zs.dtype)
+        return phased_stem_stage(
+            self, x, stem_kernel=KERNEL, features=self.features,
+            max_groups=self.max_groups, pool=(3, 3, 0), use_bias=True,
+            pool_first=self.pool_first, eps=self.eps)
 
 
 class AlexNet3DS2D(nn.Module):
@@ -335,3 +358,45 @@ class SmallCNN3D(nn.Module):
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes)(x)
         return x
+
+
+class SmallCNN3DS2D(nn.Module):
+    """SmallCNN3D over phase-decomposed input (k3/s2/p1 stem spec): same
+    function class and outputs, the C_in=1 stem conv restated for the MXU
+    via :class:`models.layers.S2DStemConv`. Input per sample:
+    ``ops.s2d.phased_sample_shape(vol, kernel=3, pad=1)``."""
+
+    num_classes: int = 1
+    width: int = 8
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from .layers import S2DStemConv
+
+        x = S2DStemConv(self.width, kernel_size=3)(x)
+        x = group_norm(self.width)(x)
+        x = nn.relu(x)
+        x = Conv3d(self.width * 2, kernel_size=3, strides=1, padding=1)(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2, 3))
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+def convert_smallcnn3d_params(params) -> dict:
+    """:class:`SmallCNN3D` param tree -> :class:`SmallCNN3DS2D` (stem
+    kernel remapped tap-for-tap, everything else unchanged)."""
+    from ..ops.s2d import remap_stem_kernel
+
+    out = dict(params)
+    stem = out.pop("Conv3d_0")["Conv_0"]
+    out["S2DStemConv_0"] = {
+        "kernel": remap_stem_kernel(stem["kernel"], 3),
+        "bias": stem["bias"],
+    }
+    # the second conv keeps its dense-model name via explicit renumber
+    out["Conv3d_0"] = out.pop("Conv3d_1")
+    return out

@@ -105,3 +105,48 @@ class SyncBatchNorm(nn.Module):
             momentum=self.momentum,
             axis_name=self.axis_name,
         )(x)
+
+
+class S2DStemConv(nn.Module):
+    """Masked phased conv replacing a C_in=1 stride-2 stem conv.
+
+    Consumes ``(B, D', H', 8, W')`` phase-decomposed input
+    (``ops.s2d.phase_decompose(x, kernel, pad)``) and computes exactly the
+    dense ``Conv3d(1->F, kernel, stride=2, padding=pad)`` via a VALID
+    stride-1 conv over the phases; structurally-zero remap slots are kept
+    zero by a constant mask (see ops/s2d.py — the model class is exactly
+    the dense stem's). Params are ``kernel``/``bias`` like an ordinary
+    conv, at the remapped shape ``(r, r, r, 8, F)``.
+    """
+
+    features: int
+    kernel_size: int = 3
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.s2d import N_PHASES, r_kernel, stem_slot_mask
+
+        k = self.kernel_size
+        r = r_kernel(k)
+        w = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(
+                # fan_in counts all r^3*8 slots; only k^3 carry taps
+                (r ** 3 * N_PHASES) / float(k ** 3),
+                "fan_in", "truncated_normal",
+                in_axis=(0, 1, 2, 3), batch_axis=()),
+            (r,) * 3 + (N_PHASES, self.features),
+        )
+        mask = jnp.asarray(stem_slot_mask(k), w.dtype)
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NDHCW", "DHWIO", "NDHWC"))
+        z = lax.conv_general_dilated(
+            x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn)
+        if self.use_bias:
+            z = z + self.param("bias", nn.initializers.zeros,
+                               (self.features,))
+        return z

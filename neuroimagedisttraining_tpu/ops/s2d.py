@@ -38,36 +38,45 @@ import jax.numpy as jnp
 import numpy as np
 
 STRIDE = 2
-KERNEL = 5
+KERNEL = 5  # the AlexNet3D stem spec (k5 s2 VALID) — the module defaults
 R_KERNEL = 3  # ceil(KERNEL / STRIDE)
 N_PHASES = STRIDE ** 3
 
 
-def out_extent(size: int) -> int:
-    """VALID stride-2 kernel-5 output extent (matches torch floor mode)."""
-    return (size - KERNEL) // STRIDE + 1
+def r_kernel(kernel: int = KERNEL) -> int:
+    """Remapped per-axis kernel extent: ceil(kernel / stride)."""
+    return -(-kernel // STRIDE)
 
 
-def phase_extent(size: int) -> int:
-    """Phase-subgrid extent needed so the stride-1 kernel-3 conv over it
-    yields exactly ``out_extent(size)`` positions."""
-    return out_extent(size) + R_KERNEL - 1
+def out_extent(size: int, kernel: int = KERNEL, pad: int = 0) -> int:
+    """Stride-2 conv output extent with torch-style integer padding
+    (floor mode). The default (k5, p0) is the AlexNet3D stem; the 3D
+    ResNet stem is (k3, p3) — ``salient_models.py:92``."""
+    return (size + 2 * pad - kernel) // STRIDE + 1
 
 
-def phase_decompose(x) -> jax.Array:
+def phase_extent(size: int, kernel: int = KERNEL, pad: int = 0) -> int:
+    """Phase-subgrid extent needed so the stride-1 ``r_kernel`` conv over
+    it yields exactly ``out_extent(size)`` positions."""
+    return out_extent(size, kernel, pad) + r_kernel(kernel) - 1
+
+
+def phase_decompose(x, kernel: int = KERNEL, pad: int = 0) -> jax.Array:
     """(..., D, H, W) single-channel volume -> (..., D', H', 8, W') phased.
 
-    Works on numpy or jax arrays; pads each spatial dim with zeros so every
-    phase subgrid has the exact extent (padding never reaches any valid
-    conv window). Phase index is ``pd*4 + ph*2 + pw``, stored on the
+    Works on numpy or jax arrays. The conv's own zero padding ``pad`` is
+    folded in HERE (left-pad each spatial dim), so the phased conv is
+    always VALID; right zero-padding tops every phase subgrid up to the
+    exact extent (never reaching any valid conv window). Phase index is
+    ``pd*4 + ph*2 + pw`` over the PADDED frame, stored on the
     next-to-minor axis (see module docstring for the layout rationale).
     """
     xp = jnp if isinstance(x, jax.Array) else np
     D, H, W = x.shape[-3:]
-    exts = (phase_extent(D), phase_extent(H), phase_extent(W))
+    exts = tuple(phase_extent(s, kernel, pad) for s in (D, H, W))
     need = [2 * e for e in exts]  # phase p covers indices p, p+2, ...
     pads = [(0, 0)] * (x.ndim - 3) + [
-        (0, max(0, n - s)) for n, s in zip(need, (D, H, W))
+        (pad, max(0, n - s - pad)) for n, s in zip(need, (D, H, W))
     ]
     x = xp.pad(x, pads)
     phases = [
@@ -77,27 +86,34 @@ def phase_decompose(x) -> jax.Array:
     return xp.stack(phases, axis=-2)
 
 
-def remap_stem_kernel(w) -> jax.Array:
-    """(5,5,5,1,F) reference stem kernel -> (3,3,3,8,F) phased kernel."""
+def remap_stem_kernel(w, kernel: int = None) -> jax.Array:
+    """(k,k,k,1,F) reference stem kernel -> (r,r,r,8,F) phased kernel.
+
+    The tap->slot bijection is over the padded frame, so it is independent
+    of the conv's padding: tap t lands at slot ``t // 2``, phase
+    ``t % 2`` per axis."""
     xp = jnp if isinstance(w, jax.Array) else np
+    k = kernel if kernel is not None else w.shape[0]
+    r = r_kernel(k)
     F = w.shape[-1]
-    w2 = np.zeros((R_KERNEL,) * 3 + (N_PHASES, F), dtype=np.float32)
+    w2 = np.zeros((r,) * 3 + (N_PHASES, F), dtype=np.float32)
     w_np = np.asarray(w, dtype=np.float32)
-    for td in range(KERNEL):
-        for th in range(KERNEL):
-            for tw in range(KERNEL):
+    for td in range(k):
+        for th in range(k):
+            for tw in range(k):
                 ph = (td % 2) * 4 + (th % 2) * 2 + (tw % 2)
                 w2[td // 2, th // 2, tw // 2, ph, :] = w_np[td, th, tw, 0, :]
     return xp.asarray(w2, dtype=w.dtype if hasattr(w, "dtype") else None)
 
 
-def stem_slot_mask() -> np.ndarray:
-    """(3,3,3,8,1) 0/1 mask of remapped-kernel slots that carry real taps.
+def stem_slot_mask(kernel: int = KERNEL) -> np.ndarray:
+    """(r,r,r,8,1) 0/1 mask of remapped-kernel slots that carry real taps
+    (125/216 for the AlexNet k5 stem, 27/64 for the ResNet k3 stem).
 
     Derived from the remap itself so the tap->slot bijection has a single
     source of truth."""
     return np.asarray(
-        remap_stem_kernel(np.ones((KERNEL,) * 3 + (1, 1), np.float32)))
+        remap_stem_kernel(np.ones((kernel,) * 3 + (1, 1), np.float32)))
 
 
 def convert_alexnet3d_params(params) -> dict:
@@ -123,7 +139,9 @@ def convert_alexnet3d_params(params) -> dict:
     return out
 
 
-def phased_sample_shape(volume: Tuple[int, int, int]) -> Tuple[int, ...]:
+def phased_sample_shape(volume: Tuple[int, int, int], kernel: int = KERNEL,
+                        pad: int = 0) -> Tuple[int, ...]:
     """Stored per-sample shape for a (D, H, W) volume: (D', H', 8, W')."""
     d, h, w = volume
-    return (phase_extent(d), phase_extent(h), N_PHASES, phase_extent(w))
+    return (phase_extent(d, kernel, pad), phase_extent(h, kernel, pad),
+            N_PHASES, phase_extent(w, kernel, pad))

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""20-seed/side statistical study of the 2D CE convergence cell
+(VERDICT r3 item 5 second half / weak #3).
+
+The r3 A/B measured a −0.086 back-half gap with NON-overlapping 5-seed
+ranges on the FedAvg 2D CE cell; 5 seeds cannot rule out a systematic
+difference. This study holds the dataset, the Dirichlet partition, and
+the INITIAL WEIGHTS fixed (jax init transferred to torch), varies ONLY
+the training RNG stream over >=20 seeds per side — both sides AUGMENTED
+per the r4 default (each with its own crop/flip stream) — and reports
+the two back-half-accuracy distributions.
+
+    python scripts/seed_study_2d.py [n_seeds] [rounds]
+
+Prints per-seed rows, then a summary JSON line with means, ranges, the
+overlap fraction, and Welch's t. tests/test_convergence_ab.py's
+exact-schedule gate pins SEMANTIC equality; this pins the STATISTICAL
+question at sample sizes where batch-order chaos can be averaged out.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+
+def main(n_seeds: int = 20, rounds: int = 20) -> dict:
+    import numpy as np
+
+    import jax
+
+    import test_convergence_ab as ab  # the A/B harness (tests/)
+
+    torch = ab.torch
+
+    data = ab._make_dataset().replace(aug_pad_value=(0.0, 0.0, 0.0))
+    xs_tr = [np.asarray(data.x_train[c])[: int(data.n_train[c])]
+             for c in range(ab.N_CLIENTS)]
+    ys_tr = [np.asarray(data.y_train[c])[: int(data.n_train[c])]
+             for c in range(ab.N_CLIENTS)]
+    x_te = np.concatenate([np.asarray(data.x_test[c])[: int(data.n_test[c])]
+                           for c in range(ab.N_CLIENTS)])
+    y_te = np.concatenate([np.asarray(data.y_test[c])[: int(data.n_test[c])]
+                           for c in range(ab.N_CLIENTS)])
+
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.models import create_model
+
+    model = create_model("cnn_cifar10", num_classes=ab.CLASSES)
+    n_max = max(len(y) for y in ys_tr)
+    hp = HyperParams(lr=ab.LR, lr_decay=ab.DECAY, momentum=ab.MOMENTUM,
+                     weight_decay=0.0, grad_clip=10.0,
+                     local_epochs=ab.EPOCHS,
+                     steps_per_epoch=max(1, -(-n_max // ab.BS)),
+                     batch_size=ab.BS)
+    algo = FedAvg(model, data, hp, loss_type="ce", frac=1.0, seed=0,
+                  track_personal=False)
+    assert algo.augment_fn is not None
+    state0 = algo.init_state(jax.random.PRNGKey(0))
+    init_np = jax.tree_util.tree_map(np.asarray, state0.global_params)
+    back = rounds // 2
+
+    jax_accs, torch_accs = [], []
+    for s in range(n_seeds):
+        # jax side: fixed init/params, seed-s training stream
+        state = state0.replace(rng=jax.random.PRNGKey(10_000 + s))
+        accs = []
+        for r in range(rounds):
+            state, _ = algo.run_round(state, r)
+            accs.append(float(algo.evaluate(state)["global_acc"]))
+        jax_accs.append(float(np.mean(accs[back:])))
+
+        # torch side: same init, seed-s generator, augmented
+        net = ab.TorchCNN(ab.CLASSES)
+        ab._jax_params_to_torch(init_np, net)
+        xt = [torch.from_numpy(x.transpose(0, 3, 1, 2).copy())
+              for x in xs_tr]
+        yt = [torch.from_numpy(y.astype(np.int64)) for y in ys_tr]
+        x_tet = torch.from_numpy(x_te.transpose(0, 3, 1, 2).copy())
+        y_tet = torch.from_numpy(y_te.astype(np.int64))
+        accs_t = ab._torch_fed_rounds(
+            net, xt, yt, x_tet, y_tet, torch.nn.CrossEntropyLoss(),
+            lambda n, x, y: (n(x).argmax(1) == y).float().mean().item(),
+            rounds=rounds, augment=True, seed=20_000 + s)
+        torch_accs.append(float(np.mean(accs_t[back:])))
+        print(f"seed {s:2d}: jax {jax_accs[-1]:.3f}  torch "
+              f"{torch_accs[-1]:.3f}", flush=True)
+
+    ja, ta = np.asarray(jax_accs), np.asarray(torch_accs)
+    # Welch's t statistic
+    se = np.sqrt(ja.var(ddof=1) / len(ja) + ta.var(ddof=1) / len(ta))
+    t = float((ja.mean() - ta.mean()) / max(se, 1e-9))
+    overlap_lo, overlap_hi = (max(ja.min(), ta.min()),
+                              min(ja.max(), ta.max()))
+    summary = {
+        "n_seeds": n_seeds, "rounds": rounds,
+        "jax_mean": round(float(ja.mean()), 4),
+        "jax_range": [round(float(ja.min()), 3), round(float(ja.max()), 3)],
+        "torch_mean": round(float(ta.mean()), 4),
+        "torch_range": [round(float(ta.min()), 3),
+                        round(float(ta.max()), 3)],
+        "gap": round(float(ja.mean() - ta.mean()), 4),
+        "welch_t": round(t, 2),
+        "ranges_overlap": overlap_lo <= overlap_hi,
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    r = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    main(n, r)

@@ -162,7 +162,7 @@ def _torch_crop_flip(x, g, padding=4):
 
 def _torch_fed_rounds(net, xt, yt, x_te, y_te, loss_fn, acc_fn,
                       lr0=None, rounds=None, post_step=None,
-                      augment=False):
+                      augment=False, seed=0):
     """Reference-semantics FedAvg round loop (fedavg_api.py:40-117),
     written from the documented behavior and shared by the 2D/3D/masked
     A/B tests: full participation, shuffled-epoch local SGD with
@@ -173,7 +173,7 @@ def _torch_fed_rounds(net, xt, yt, x_te, y_te, loss_fn, acc_fn,
     lr0 = LR if lr0 is None else lr0
     rounds = ROUNDS if rounds is None else rounds
     w_global = {k: v.clone() for k, v in net.state_dict().items()}
-    g = torch.Generator().manual_seed(0)
+    g = torch.Generator().manual_seed(seed)
     accs = []
     for r in range(rounds):
         locals_, weights = [], []

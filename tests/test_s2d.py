@@ -139,7 +139,9 @@ def test_runner_rejects_s2d_layout_mismatches(tmp_path):
     args = parse_args(base + ["--layout", "s2d"])
     with pytest.raises(SystemExit):
         run_experiment(args, "fedavg")
-    args = parse_args(["--dataset", "abcd_site", "--model", "small3dcnn",
+    # a model with no phased twin must be rejected under --layout s2d
+    # (small3dcnn/3dcnn/3dresnet auto-map to their twins since r4)
+    args = parse_args(["--dataset", "abcd_site", "--model", "3dcnn_deeper",
                        "--layout", "s2d", "--data_dir", "x.h5",
                        "--log_dir", str(tmp_path)])
     with pytest.raises(SystemExit):
@@ -209,3 +211,108 @@ def test_pool_first_stage_grads_match():
     for k in ga:
         np.testing.assert_allclose(np.asarray(ga[k]), np.asarray(gb[k]),
                                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+# -- ResNet_l3 s2d twin (r4): k3/s2/p3 stem spec -----------------------------
+
+def test_phase_decompose_padded_spec_matches_dense_conv():
+    """The generalized (kernel=3, pad=3) decomposition must reproduce the
+    dense k3/s2/p3 conv exactly: phased VALID k2/s1 conv over the padded
+    phases == lax conv with padding ((3,3),)*3 and stride 2."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 15, 17, 15, 1).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 1, 6).astype(np.float32))
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    ref = lax.conv_general_dilated(
+        x, w, (2, 2, 2), [(3, 3)] * 3, dimension_numbers=dn)
+
+    xs = phase_decompose(x[..., 0], kernel=3, pad=3)
+    w2 = remap_stem_kernel(w, kernel=3)
+    dn2 = lax.conv_dimension_numbers(
+        xs.shape, w2.shape, ("NDHCW", "DHWIO", "NDHWC"))
+    out = lax.conv_general_dilated(
+        xs, w2, (1, 1, 1), "VALID", dimension_numbers=dn2)
+    assert out.shape == ref.shape, (out.shape, ref.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet3d_s2d_forward_parity():
+    """ResNet3DL3S2D(convert(params)) on phased input == ResNet3DL3 on the
+    raw volume — logits and penultimate features."""
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+    from neuroimagedisttraining_tpu.models.resnet3d import (
+        ResNet3DL3S2D,
+        convert_resnet3d_params,
+    )
+
+    vol = (29, 33, 29)
+    dense = create_model("3dresnet", num_classes=1)
+    params = init_params(dense, jax.random.PRNGKey(0), vol + (1,))
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, *vol, 1).astype(np.float32))
+    ref_logits, ref_feat = dense.apply({"params": params}, x, train=False)
+
+    s2d = ResNet3DL3S2D(num_classes=1)
+    xs = phase_decompose(x[..., 0], kernel=3, pad=3)
+    p2 = convert_resnet3d_params(params)
+    out_logits, out_feat = s2d.apply({"params": p2}, xs, train=False)
+    np.testing.assert_allclose(np.asarray(out_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out_feat),
+                               np.asarray(ref_feat), rtol=2e-4, atol=2e-4)
+    # pool-first == textbook order on the same converted params
+    s2d_tb = ResNet3DL3S2D(num_classes=1, pool_first=False)
+    tb_logits, _ = s2d_tb.apply({"params": p2}, xs, train=False)
+    np.testing.assert_allclose(np.asarray(out_logits), np.asarray(tb_logits),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet3d_s2d_grads_finite_and_mask_respected():
+    """Gradients flow and structurally-zero slots (37/64 for k3) stay
+    zero-gradient through the masked phased kernel."""
+    from neuroimagedisttraining_tpu.models import init_params
+    from neuroimagedisttraining_tpu.models.resnet3d import ResNet3DL3S2D
+
+    vol = (29, 33, 29)
+    model = ResNet3DL3S2D(num_classes=1)
+    xs = jnp.asarray(np.random.RandomState(2).randn(
+        2, *phased_sample_shape(vol, kernel=3, pad=3)).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(0), xs)["params"]
+
+    def loss(p):
+        logits, _ = model.apply({"params": p}, xs, train=True)
+        return jnp.sum(logits ** 2)
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    gk = np.asarray(g["S2DResNetStem_0"]["kernel"])
+    mask = stem_slot_mask(3)[..., 0]
+    assert np.all(gk[mask == 0] == 0), "zero slots leaked gradient"
+    assert np.any(gk[mask == 1] != 0)
+
+
+def test_smallcnn3d_s2d_forward_parity():
+    """SmallCNN3DS2D(convert(params)) on (k3,p1)-phased input equals
+    SmallCNN3D on the raw volume."""
+    from neuroimagedisttraining_tpu.models import create_model, init_params
+    from neuroimagedisttraining_tpu.models.alexnet3d import (
+        SmallCNN3DS2D,
+        convert_smallcnn3d_params,
+    )
+
+    vol = (13, 15, 13)
+    dense = create_model("small3dcnn", num_classes=1)
+    params = init_params(dense, jax.random.PRNGKey(0), vol + (1,))
+    x = jnp.asarray(np.random.RandomState(3).randn(2, *vol, 1)
+                    .astype(np.float32))
+    ref = dense.apply({"params": params}, x, train=False)
+
+    xs = phase_decompose(x[..., 0], kernel=3, pad=1)
+    twin = SmallCNN3DS2D(num_classes=1)
+    out = twin.apply({"params": convert_smallcnn3d_params(params)}, xs,
+                     train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
