@@ -38,27 +38,32 @@ TARGET_ROUNDS_PER_SEC = 10.0  # BASELINE.json north star (v4-32)
 MODEL_KEY = "3dcnn_s2d"  # tests override with a CI-scale model
 
 
-def _device_synth_data(n_clients, n, shape, key, uneven=False):
+def _device_synth_data(n_clients, n, shape, key, uneven=False,
+                       model_key=None):
     """Generate the federated dataset directly on device (HBM-resident).
+
+    ``model_key`` picks the stored sample shape (phased for the s2d
+    twins via the runner's S2D_SPECS table — the one source of truth);
+    it defaults to the module-global MODEL_KEY for the bench's own use.
+    Callers importing this from scripts should pass it explicitly (an r4
+    A/B was invalidated by the global defaulting to the AlexNet twin).
 
     ``uneven=True`` draws per-client counts in [n/2, n] (deterministic) so
     ``_full_batches()`` is False and the masked-epoch machinery — per-
     example batch weights + no-op step selects, what real uneven ABCD
     cohorts exercise — is actually priced (ADVICE r3)."""
     from neuroimagedisttraining_tpu.data.types import FederatedData
-
+    from neuroimagedisttraining_tpu.experiments.runner import S2D_SPECS
     from neuroimagedisttraining_tpu.ops.s2d import phased_sample_shape
 
+    model_key = model_key or MODEL_KEY
     kx, ky = jax.random.split(key)
     # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py),
     # stored bf16 (the compute dtype — skips the per-step convert/relayout);
     # random phased tensors are distributionally the same workload
-    if MODEL_KEY == "3dcnn_s2d":
-        sshape = phased_sample_shape(shape)
-    elif MODEL_KEY == "3dresnet_s2d":
-        sshape = phased_sample_shape(shape, kernel=3, pad=3)
-    elif MODEL_KEY == "small3dcnn_s2d":
-        sshape = phased_sample_shape(shape, kernel=3, pad=1)
+    spec = S2D_SPECS.get(model_key)
+    if spec is not None:
+        sshape = phased_sample_shape(shape, kernel=spec[0], pad=spec[1])
     else:
         sshape = tuple(shape) + (1,)
     x = jax.random.normal(kx, (n_clients, n) + sshape, jnp.bfloat16)
@@ -289,9 +294,9 @@ def tracked_config(name: str):
     if name == "resnet3d":
         # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort").
         # Phased-stem twin since r4: the k3/s2/p3 stem at C_in=1 was 66% of
-        # the step; the s2d restatement measures 0.79 vs 0.60 r/s dense
-        # (exactness-tested, tests/test_s2d.py). BENCH_DENSE=1 runs the
-        # reference-layout model for A/B.
+        # the step; the s2d restatement measures 0.80 vs 0.60 r/s dense
+        # (exactness-tested, tests/test_s2d.py; RESULTS.md tracked table).
+        # BENCH_DENSE=1 runs the reference-layout model for A/B.
         MODEL_KEY, VOLUME = "3dresnet_s2d", (121, 145, 121)
         if os.environ.get("BENCH_DENSE"):
             MODEL_KEY = "3dresnet"

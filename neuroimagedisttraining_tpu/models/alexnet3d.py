@@ -21,7 +21,14 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax import lax
 
-from .layers import Conv3d, avg_pool3d, flatten, group_norm, max_pool3d
+from .layers import (
+    Conv3d,
+    S2DStemConv,
+    avg_pool3d,
+    flatten,
+    group_norm,
+    max_pool3d,
+)
 
 
 class _Features(nn.Module):
@@ -57,40 +64,13 @@ class _Features(nn.Module):
         return x
 
 
-class S2DStem(nn.Module):
-    """Phase-decomposed stem: the TPU-fast form of Conv3d(1->F, k5, s2).
-
-    Consumes the phased NDHCW batch ``(B, D', H', 8, W')`` produced by
-    ``ops.s2d.phase_decompose`` and emits the exact activations of the
-    reference stem in NDHWC. The 91 structurally-unused kernel slots are
-    masked to zero at apply time so the hypothesis class stays identical
-    to the dense stride-2 stem (see ops/s2d.py docstring).
-    """
+class S2DStem(S2DStemConv):
+    """Phase-decomposed AlexNet stem: the TPU-fast form of
+    Conv3d(1->F, k5, s2) — :class:`models.layers.S2DStemConv` at the k5
+    spec (125 of 216 slots live)."""
 
     features: int = 64
-
-    @nn.compact
-    def __call__(self, x):
-        from ..ops.s2d import N_PHASES, R_KERNEL, stem_slot_mask
-
-        # lecun-normal with the MASK-AWARE fan-in: only 125 of the 216
-        # kernel slots are live, so scale variance to match the dense
-        # stride-2 stem's 1/125 (fresh-init dynamics parity, not just
-        # converted-weights parity)
-        w = self.param(
-            "kernel",
-            nn.initializers.variance_scaling(
-                216.0 / 125.0, "fan_in", "truncated_normal",
-                in_axis=(0, 1, 2, 3), batch_axis=()),
-            (R_KERNEL,) * 3 + (N_PHASES, self.features),
-        )
-        b = self.param("bias", nn.initializers.zeros, (self.features,))
-        mask = jnp.asarray(stem_slot_mask(), w.dtype)
-        dn = lax.conv_dimension_numbers(
-            x.shape, w.shape, ("NDHCW", "DHWIO", "NDHWC"))
-        y = lax.conv_general_dilated(
-            x, w * mask, (1, 1, 1), "VALID", dimension_numbers=dn)
-        return y + b
+    kernel_size: int = 5
 
 
 def _group_stats(zf, groups, eps):
@@ -131,26 +111,16 @@ def phased_stem_stage(mdl: nn.Module, x, *, stem_kernel: int, features: int,
     sows ``conv_out`` at the conv's resolution for the FLOPs counter
     (utils/flops.py reads it to cost fused stages correctly).
     """
-    from ..ops.s2d import N_PHASES, r_kernel, stem_slot_mask
+    from .layers import phased_stem_kernel
 
     F = features
     g = min(max_groups, F)
     while F % g:
         g -= 1
-    r = r_kernel(stem_kernel)
-    w = mdl.param(
-        "kernel",
-        nn.initializers.variance_scaling(
-            # fan_in counts all r^3*8 slots; only kernel^3 carry taps
-            (r ** 3 * N_PHASES) / float(stem_kernel ** 3),
-            "fan_in", "truncated_normal",
-            in_axis=(0, 1, 2, 3), batch_axis=()),
-        (r,) * 3 + (N_PHASES, F),
-    )
+    w, mask = phased_stem_kernel(mdl, stem_kernel, F)
     b = mdl.param("bias", nn.initializers.zeros, (F,)) if use_bias else None
     gamma = mdl.param("scale", nn.initializers.ones, (F,))
     beta = mdl.param("bias_gn", nn.initializers.zeros, (F,))
-    mask = jnp.asarray(stem_slot_mask(stem_kernel), w.dtype)
     dn_args = ("NDHCW", "DHWIO", "NDHWC")
     pk, ps, pp = pool
 

@@ -107,6 +107,34 @@ class SyncBatchNorm(nn.Module):
         )(x)
 
 
+def phased_stem_kernel(mdl: nn.Module, stem_kernel: int, features: int):
+    """Create THE masked phased stem kernel param on ``mdl``.
+
+    One source of truth for every phased stem (S2DStemConv, the fused
+    phased_stem_stage): a ``kernel`` param of shape ``(r, r, r, 8, F)``
+    with mask-aware lecun-normal init — fan_in counts all ``r^3*8``
+    slots but only ``stem_kernel^3`` carry taps, so variance is scaled
+    by their ratio to match the dense stride-2 stem's (fresh-init
+    dynamics parity, not just converted-weights parity). Returns
+    ``(w, mask)`` where ``mask`` zeroes the structurally-unused slots
+    (see ops/s2d.py — the hypothesis class stays exactly the dense
+    stem's)."""
+    import jax.numpy as jnp
+
+    from ..ops.s2d import N_PHASES, r_kernel, stem_slot_mask
+
+    r = r_kernel(stem_kernel)
+    w = mdl.param(
+        "kernel",
+        nn.initializers.variance_scaling(
+            (r ** 3 * N_PHASES) / float(stem_kernel ** 3),
+            "fan_in", "truncated_normal",
+            in_axis=(0, 1, 2, 3), batch_axis=()),
+        (r,) * 3 + (N_PHASES, features),
+    )
+    return w, jnp.asarray(stem_slot_mask(stem_kernel), w.dtype)
+
+
 class S2DStemConv(nn.Module):
     """Masked phased conv replacing a C_in=1 stride-2 stem conv.
 
@@ -125,23 +153,9 @@ class S2DStemConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        import jax.numpy as jnp
         from jax import lax
 
-        from ..ops.s2d import N_PHASES, r_kernel, stem_slot_mask
-
-        k = self.kernel_size
-        r = r_kernel(k)
-        w = self.param(
-            "kernel",
-            nn.initializers.variance_scaling(
-                # fan_in counts all r^3*8 slots; only k^3 carry taps
-                (r ** 3 * N_PHASES) / float(k ** 3),
-                "fan_in", "truncated_normal",
-                in_axis=(0, 1, 2, 3), batch_axis=()),
-            (r,) * 3 + (N_PHASES, self.features),
-        )
-        mask = jnp.asarray(stem_slot_mask(k), w.dtype)
+        w, mask = phased_stem_kernel(self, self.kernel_size, self.features)
         dn = lax.conv_dimension_numbers(
             x.shape, w.shape, ("NDHCW", "DHWIO", "NDHWC"))
         z = lax.conv_general_dilated(

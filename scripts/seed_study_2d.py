@@ -105,7 +105,7 @@ def main(n_seeds: int = 20, rounds: int = 20) -> dict:
                         round(float(ta.max()), 3)],
         "gap": round(float(ja.mean() - ta.mean()), 4),
         "welch_t": round(t, 2),
-        "ranges_overlap": overlap_lo <= overlap_hi,
+        "ranges_overlap": bool(overlap_lo <= overlap_hi),
     }
     print(json.dumps(summary))
     return summary
