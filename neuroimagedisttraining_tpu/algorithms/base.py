@@ -433,13 +433,7 @@ class FedAlgorithm(abc.ABC):
         if finalize:
             state, final_record = self.finalize(state)
         if final_record is not None:
-            record = {k: _to_float(v) for k, v in final_record.items()}
+            record = {k: to_float(v) for k, v in final_record.items()}
             history.append(record)
             logger.info("%s final: %s", self.name, record)
         return state, history
-
-
-def _to_float(v):
-    if isinstance(v, (jax.Array, np.ndarray)) and np.ndim(v) == 0:
-        return float(v)
-    return v

@@ -340,7 +340,12 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         if getattr(args, "batching", "epoch") != "epoch":
             parts.append("wr")  # with-replacement draws train differently
         if not getattr(args, "augment", 1):
-            parts.append("noaug")  # un-augmented CIFAR/tiny ablation
+            from ..data import dataset_is_augmentable
+
+            # only augmentable datasets consume the flag; an ABCD lineage
+            # must not split on a no-op (same rule as 'nopers' below)
+            if dataset_is_augmentable(args.dataset):
+                parts.append("noaug")  # un-augmented CIFAR/tiny ablation
         if getattr(args, "eval_clients", 0):
             parts.append(f"evK{args.eval_clients}")
         if getattr(args, "data_dtype", ""):

@@ -587,7 +587,7 @@ def run_experiment(args: argparse.Namespace,
         # record is floated+logged only after round r+1's programs are
         # dispatched, so the per-round eval costs its ~21 ms of device
         # time instead of a ~110 ms tunnel sync
-        from ..utils.records import DeferredRecords
+        from ..utils.records import DeferredRecords, to_float
 
         deferred = DeferredRecords(
             log=lambda rec: logger.info(
@@ -642,7 +642,7 @@ def run_experiment(args: argparse.Namespace,
             state, fin_rec = algo.finalize(state)
         if fin_rec is not None:
             # the reference's final fine-tune record (round -1)
-            record = {k: v if k in ("round", "finetune") else _scalar(v)
+            record = {k: v if k in ("round", "finetune") else to_float(v)
                       for k, v in fin_rec.items()}
             history.append(record)
             logger.info("%s final: %s", algo_name, record)
@@ -702,10 +702,6 @@ def run_experiment(args: argparse.Namespace,
         from .logging_utils import remove_run_file_logger
 
         remove_run_file_logger(log_handler)
-
-
-def _scalar(v):
-    return float(v) if np.ndim(v) == 0 else v
 
 
 def main(argv: Optional[Sequence[str]] = None,
