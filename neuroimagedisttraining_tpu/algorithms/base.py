@@ -40,6 +40,33 @@ def sample_client_indexes(
     ).astype(np.int32)
 
 
+class FusedMetrics:
+    """A fused block's per-round metric series, fetched lazily in ONE
+    host transfer (the in-graph ``packed`` stack; see ``_get_fused_fn``).
+    Until materialized, holding it costs nothing — the driver dispatches
+    the next block first, then materializes the previous one."""
+
+    def __init__(self, ys_device, packed):
+        self._ys = ys_device
+        self._packed = packed
+        self._host = None
+
+    def materialize(self) -> Dict[str, Any]:
+        if self._host is None:
+            flat, treedef = jax.tree_util.tree_flatten(self._ys)
+            vals = np.asarray(self._packed)  # one transfer for the block
+            self._host = jax.tree_util.tree_unflatten(
+                treedef, [vals[i] for i in range(len(flat))])
+            self._ys = self._packed = None  # free the device buffers
+        return self._host
+
+    def __getitem__(self, key):
+        return self.materialize()[key]
+
+    def __contains__(self, key):
+        return key in self.materialize()
+
+
 class FedAlgorithm(abc.ABC):
     """Base class: owns model apply fn, data, hyperparams, and jitted kernels."""
 
@@ -129,6 +156,7 @@ class FedAlgorithm(abc.ABC):
             model, compute_dtype=self.compute_dtype,
             channel_inject=channel_inject)
         self.eval_client = make_eval_fn(self.apply_fn, loss_type, eval_batch)
+        self._fused_cache: Dict[Any, Any] = {}  # (block, eval_every) -> jit
         self._build()
 
     # -- per-algorithm pieces -------------------------------------------------
@@ -144,10 +172,16 @@ class FedAlgorithm(abc.ABC):
     def run_round(self, state: Any, round_idx: int) -> Any:
         """Execute one federated round; returns (state, train_metrics dict)."""
 
-    @abc.abstractmethod
     def evaluate(self, state: Any) -> Dict[str, Any]:
         """Evaluate per the reference protocol (global and/or personal
-        per-client accuracy, mean over clients — sailentgrads_api.py:231-285)."""
+        per-client accuracy, mean over clients — sailentgrads_api.py:231-285).
+
+        Default: delegate to the traceable ``eval_metrics(state, x_test,
+        y_test, n_test)`` hook (which the fused round loop also calls
+        in-graph). Algorithms with host-side eval composition (DisPFL's
+        per-round local tests, FedFomo) override ``evaluate`` directly."""
+        return self.eval_metrics(
+            state, self.data.x_test, self.data.y_test, self.data.n_test)
 
     def finalize(self, state: Any):
         """Optional end-of-training pass after the last round. Returns
@@ -376,6 +410,211 @@ class FedAlgorithm(abc.ABC):
 
         return eval_personal
 
+    # -- fused multi-round execution ------------------------------------------
+    #: True for algorithms whose only host-side per-round work is the
+    #: seeded client draw; their whole round block can run as ONE jitted
+    #: program (an outer ``lax.scan`` over rounds — the TPU-idiomatic
+    #: extension of "no Python between clients" to "no Python between
+    #: rounds"). The draws stay host-precomputed with the exact
+    #: ``np.random.seed(round_idx)`` calls of the unfused path, so the
+    #: reference's cross-algorithm sampling contract (fedavg_api.py:92-100)
+    #: is preserved bit-for-bit.
+    supports_fused: bool = False
+
+    #: names for the scalars ``_round_jit`` returns after the state
+    _round_metric_names = ("train_loss",)
+
+    def _fused_host_inputs(self, round_idx: int):
+        """The per-round host-side inputs of ``run_round``, to be stacked
+        along a leading round axis for the fused scan. Standard centralized
+        algorithms: the seeded client draw."""
+        return (sample_client_indexes(
+            round_idx, self.num_clients, self.clients_per_round),)
+
+    def _fused_data_args(self):
+        """Round-invariant device args of ``_round_jit`` after round_idx."""
+        d = self.data
+        return (d.x_train, d.y_train, d.n_train)
+
+    def _get_fused_fn(self, block: int, eval_every: int):
+        """Build (and cache per (block, eval_every)) the jitted K-round
+        program: ``lax.scan`` over ``_round_jit`` with the eval cadence
+        folded in-graph via ``lax.cond`` (zero host round-trips inside a
+        block; the reference's ``frequency_of_the_test`` cadence,
+        main_sailentgrads.py:90)."""
+        cache = self._fused_cache
+        key = (block, eval_every)
+        if key in cache:
+            return cache[key]
+        n_host = len(self._fused_host_inputs(0))
+        n_data = len(self._fused_data_args())
+
+        def fused(state, host_stack, round_ids, *args):
+            data_args = args[:n_data]
+            test_args = args[n_data:]
+
+            def eval_branch(s):
+                return {k: v for k, v in
+                        self.eval_metrics(s, *test_args).items()
+                        if not k.startswith("acc_per")}
+
+            def zero_branch(s):
+                shapes = jax.eval_shape(eval_branch, s)
+                return jax.tree_util.tree_map(
+                    lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+
+            def body(s, xs):
+                hins, r = xs[:n_host], xs[n_host]
+                out = self._round_jit(s, *hins, r, *data_args)
+                s, metrics = out[0], out[1:]
+                ys = dict(zip(self._round_metric_names, metrics))
+                if eval_every:
+                    do = (r.astype(jnp.int32) + 1) % eval_every == 0
+                    ys["eval"] = jax.lax.cond(
+                        do, eval_branch, zero_branch, s)
+                return s, ys
+
+            state, ys = jax.lax.scan(
+                body, state, host_stack + (round_ids,))
+            # pack every per-round scalar series into ONE f32 array: the
+            # host materializes a block's metrics in a single transfer
+            # (on a tunneled TPU each leaf fetch costs ~110 ms — measured
+            # 442 ms for 4 leaves — so per-leaf fetches would eat the
+            # fusion win)
+            packed = jnp.stack([
+                x.astype(jnp.float32)
+                for x in jax.tree_util.tree_leaves(ys)])
+            return state, ys, packed
+
+        fn = cache[key] = jax.jit(fused)
+        return fn
+
+    def run_rounds_fused(self, state: Any, start_round: int,
+                         n_rounds: int, eval_every: int = 0):
+        """Run ``n_rounds`` federated rounds as one jitted program.
+
+        Returns ``(state, ys)`` where ``ys`` is a :class:`FusedMetrics`:
+        indexing it (or calling ``.materialize()``) fetches the whole
+        block's metric series in ONE host transfer as a pytree of numpy
+        arrays with a leading round axis of length ``n_rounds``. When
+        ``eval_every`` is set, ``ys["eval"]`` holds the eval metrics
+        (zeros on non-eval rounds — ``lax.cond`` skips their compute).
+        Semantically identical to ``n_rounds`` ``run_round`` calls
+        (tests/test_fused_rounds.py pins it); the win is dispatch/fetch
+        amortization: one program launch and one metric materialization
+        per block instead of per round.
+        """
+        if not self.supports_fused:
+            raise ValueError(
+                f"{self.name}: fused rounds need all per-round host work "
+                "to be the seeded client draw; this algorithm has "
+                "data-dependent host control flow (topology/dropout "
+                "draws) — run it with fuse_rounds=1")
+        host = [self._fused_host_inputs(r)
+                for r in range(start_round, start_round + n_rounds)]
+        host_stack = tuple(
+            jnp.asarray(np.stack([h[i] for h in host]))
+            for i in range(len(host[0])))
+        round_ids = jnp.arange(
+            start_round, start_round + n_rounds, dtype=jnp.float32)
+        fn = self._get_fused_fn(n_rounds, eval_every)
+        state, ys, packed = fn(
+            state, host_stack, round_ids,
+            *self._fused_data_args(), self.data.x_test,
+            self.data.y_test, self.data.n_test)
+        return state, FusedMetrics(ys, packed)
+
+    def _fused_block_loop(self, state, start_round: int, total: int,
+                          block: int, eval_every: int, on_record,
+                          timed: bool = False):
+        """The shared fused-block driver (library ``run(fuse_rounds=K)``
+        and the CLI runner's ``--fuse_rounds`` both use it): dispatch
+        block b+1, then materialize and emit block b's per-round records
+        — the device queue never drains. ``on_record(round_idx, rec,
+        state_out)`` receives each round's record in order plus the
+        emitting block's (already computed) output state.
+
+        ``timed=True`` stamps ``round_time_s`` as the block's
+        flush-to-flush wall time split evenly: flushes happen after the
+        blocking materialize, so the per-run SUM equals wall time and
+        per-round attribution is ±1 block (the fused analogue of
+        DeferredRecords' timed semantics — the dispatch itself is async
+        and takes microseconds, so timing it would be meaningless).
+
+        A success-path flush error propagates; only when an exception is
+        already unwinding is the final flush best-effort (the pending
+        block's device state may be gone)."""
+        mark = time.perf_counter()
+        pending = None  # previous block, dispatched but not yet fetched
+
+        def flush(p):
+            nonlocal mark
+            r0, k, ys, state_out = p
+            host = dict(ys.materialize())  # blocks until block complete
+            now = time.perf_counter()
+            wall, mark = now - mark, now
+            ev = host.pop("eval", None)
+            for i in range(k):
+                rec: Dict[str, Any] = {"round": r0 + i}
+                for name in self._round_metric_names:
+                    rec[name] = float(host[name][i])
+                if ev is not None and (r0 + i + 1) % eval_every == 0:
+                    rec.update({k2: float(v[i]) for k2, v in ev.items()})
+                if timed:
+                    rec["round_time_s"] = wall / k
+                on_record(r0 + i, rec, state_out)
+
+        try:
+            for r0 in range(start_round, total, block):
+                k = min(block, total - r0)
+                state, ys = self.run_rounds_fused(
+                    state, r0, k, eval_every=eval_every)
+                if pending is not None:
+                    flush(pending)
+                pending = (r0, k, ys, state)
+            if pending is not None:
+                flush(pending)  # success path: a flush error propagates
+                pending = None
+        finally:
+            if pending is not None:  # an exception is unwinding
+                try:
+                    flush(pending)
+                except Exception:  # crashed mid-block: device state gone
+                    logger.exception("fused block metrics lost")
+        return state
+
+    def _run_fused(self, comm_rounds: int, eval_every: int, state: Any,
+                   finalize: bool, block: int):
+        """``run`` with the round loop executed in fused blocks
+        (``_fused_block_loop``)."""
+        if state is None:
+            state = self.init_state(jax.random.PRNGKey(self.seed))
+        history: List[Dict[str, Any]] = []
+
+        def on_record(r, rec, _state_out):
+            history.append(rec)
+            logger.info("%s round %d: %s", self.name, r, rec)
+
+        state = self._fused_block_loop(
+            state, 0, comm_rounds, block, eval_every, on_record,
+            timed=True)
+        return self._finalize_into_history(
+            state, history, finalize)
+
+    def _finalize_into_history(self, state, history, finalize: bool):
+        """Shared tail of both drivers: run the algorithm's end-of-training
+        pass and append its record (round = -1) to the history."""
+        from ..utils.records import to_float
+
+        final_record = None
+        if finalize:
+            state, final_record = self.finalize(state)
+        if final_record is not None:
+            record = {k: to_float(v) for k, v in final_record.items()}
+            history.append(record)
+            logger.info("%s final: %s", self.name, record)
+        return state, history
+
     # -- driver ---------------------------------------------------------------
     def run(
         self,
@@ -384,11 +623,17 @@ class FedAlgorithm(abc.ABC):
         state: Any = None,
         callback=None,
         finalize: bool = True,
+        fuse_rounds: int = 1,
     ):
         """The federated training driver (the reference's ``API.train()``).
 
         ``finalize=False`` skips the algorithm's end-of-training pass (e.g.
         FedAvg's final fine-tune) for callers that only need the round loop.
+
+        ``fuse_rounds=K`` (supported algorithms) executes the loop in
+        K-round fused programs — see ``run_rounds_fused``. Incompatible
+        with ``callback``: per-round host control (checkpointing) is
+        exactly what fusion removes.
 
         ``round_time_s`` is stamped at flush boundaries (see
         utils.records.DeferredRecords): the per-run SUM equals wall time
@@ -397,6 +642,14 @@ class FedAlgorithm(abc.ABC):
         """
         from ..utils.records import DeferredRecords, to_float
 
+        if fuse_rounds > 1:
+            if callback is not None:
+                raise ValueError(
+                    "fuse_rounds > 1 removes per-round host control; "
+                    "per-round callbacks (checkpointing) need "
+                    "fuse_rounds=1")
+            return self._run_fused(
+                comm_rounds, eval_every, state, finalize, fuse_rounds)
         if state is None:
             state = self.init_state(jax.random.PRNGKey(self.seed))
         history: List[Dict[str, Any]] = []
@@ -429,11 +682,5 @@ class FedAlgorithm(abc.ABC):
             deferred.flush_safely()  # emit the last completed round
             raise
         deferred.flush()
-        final_record = None
-        if finalize:
-            state, final_record = self.finalize(state)
-        if final_record is not None:
-            record = {k: to_float(v) for k, v in final_record.items()}
-            history.append(record)
-            logger.info("%s final: %s", self.name, record)
-        return state, history
+        return self._finalize_into_history(
+            state, history, finalize)

@@ -37,6 +37,8 @@ class DittoState:
 
 class Ditto(FedAlgorithm):
     name = "ditto"
+    supports_fused = True
+    _round_metric_names = ("train_loss", "personal_train_loss")
 
     def cost_trained_clients_per_round(self) -> int:
         # each selected client trains a global AND a personal leg
@@ -116,15 +118,11 @@ class Ditto(FedAlgorithm):
         )
         return state, {"train_loss": g_loss, "personal_train_loss": p_loss}
 
-    def evaluate(self, state: DittoState) -> Dict[str, Any]:
-        ev_g = self._eval_global(
-            state.global_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+    def eval_metrics(self, state: DittoState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
+        ev_g = self._eval_global(state.global_params, x_test, y_test, n_test)
         ev_p = self._eval_personal(
-            state.personal_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+            state.personal_params, x_test, y_test, n_test)
         return {
             "global_acc": ev_g["acc"], "global_loss": ev_g["loss"],
             "personal_acc": ev_p["acc"], "personal_loss": ev_p["loss"],

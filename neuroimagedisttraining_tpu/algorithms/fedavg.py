@@ -47,6 +47,7 @@ class FedAvgState:
 
 class FedAvg(FedAlgorithm):
     name = "fedavg"
+    supports_fused = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
                  **kwargs):
@@ -138,17 +139,13 @@ class FedAvg(FedAlgorithm):
                      if not k.startswith("acc_per")}}
         return state, record
 
-    def evaluate(self, state: FedAvgState) -> Dict[str, Any]:
-        ev = self._eval_global(
-            state.global_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+    def eval_metrics(self, state: FedAvgState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
+        ev = self._eval_global(state.global_params, x_test, y_test, n_test)
         out = {"global_acc": ev["acc"], "global_loss": ev["loss"],
                "acc_per_client": ev["acc_per_client"]}
         if state.personal_params is not None:
             evp = self._eval_personal(
-                state.personal_params, self.data.x_test, self.data.y_test,
-                self.data.n_test,
-            )
+                state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
         return out

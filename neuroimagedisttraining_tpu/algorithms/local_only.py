@@ -25,6 +25,7 @@ class LocalOnlyState:
 
 class LocalOnly(FedAlgorithm):
     name = "local"
+    supports_fused = True
 
     def _build(self) -> None:
         self.client_update = make_client_update(
@@ -71,10 +72,9 @@ class LocalOnly(FedAlgorithm):
         )
         return state, {"train_loss": loss}
 
-    def evaluate(self, state: LocalOnlyState) -> Dict[str, Any]:
+    def eval_metrics(self, state: LocalOnlyState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
         ev = self._eval_personal(
-            state.personal_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+            state.personal_params, x_test, y_test, n_test)
         return {"personal_acc": ev["acc"], "personal_loss": ev["loss"],
                 "acc_per_client": ev["acc_per_client"]}

@@ -39,6 +39,7 @@ class SalientGradsState:
 
 class SalientGrads(FedAlgorithm):
     name = "salientgrads"
+    supports_fused = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
@@ -142,13 +143,11 @@ class SalientGrads(FedAlgorithm):
         )
         return state, {"train_loss": loss}
 
-    def evaluate(self, state: SalientGradsState) -> Dict[str, Any]:
+    def eval_metrics(self, state: SalientGradsState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
         # evaluate the masked global model, as the reference does (the
         # aggregate of masked locals is already masked; assert via density)
-        ev = self._eval_global(
-            state.global_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+        ev = self._eval_global(state.global_params, x_test, y_test, n_test)
         return {
             "global_acc": ev["acc"],
             "global_loss": ev["loss"],

@@ -48,6 +48,7 @@ class SubAvgState:
 
 class SubAvg(FedAlgorithm):
     name = "subavg"
+    supports_fused = True
     masks_evolve = True  # pruning changes per-client density
 
     def __init__(self, *args, each_prune_ratio: float = 0.2,
@@ -180,16 +181,15 @@ class SubAvg(FedAlgorithm):
         )
         return state, {"train_loss": loss}
 
-    def evaluate(self, state: SubAvgState) -> Dict[str, Any]:
+    def eval_metrics(self, state: SubAvgState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
         # reference evaluates the global model through each client's mask
         # (subavg_api.py _local_test_on_all_clients)
         c = self.num_clients
         per_client = jax.tree_util.tree_map(
             jnp.multiply, broadcast_tree(state.global_params, c), state.masks
         )
-        ev = self._eval_personal(
-            per_client, self.data.x_test, self.data.y_test, self.data.n_test
-        )
+        ev = self._eval_personal(per_client, x_test, y_test, n_test)
         dens = jax.vmap(mask_density)(state.masks)
         return {
             "personal_acc": ev["acc"], "personal_loss": ev["loss"],

@@ -139,6 +139,15 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "when continuing a pre-round-4 lineage")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="chunk vmapped clients to bound HBM (0 = full vmap)")
+    p.add_argument("--fuse_rounds", type=int, default=1,
+                   help="execute the round loop in K-round fused programs "
+                        "(lax.scan over rounds — one dispatch + one metric "
+                        "fetch per block). CLI-supported: fedavg, "
+                        "salientgrads, ditto, local (subavg fuses on the "
+                        "library path only — its evolving masks need "
+                        "per-round cost snapshots here). Incompatible "
+                        "with --checkpoint_dir (round-granular host "
+                        "control); 1 = unfused")
     p.add_argument("--eval_clients", type=int, default=0,
                    help="sampled-eval mode: evaluate only this many "
                         "(seeded) clients per eval instead of the whole "

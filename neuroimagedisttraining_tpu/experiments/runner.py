@@ -461,6 +461,43 @@ def save_stat_info(args: argparse.Namespace, identity: str,
     return path
 
 
+def _cost_round_record(algo, cost, samples_per_client, state):
+    """One round's cost record (stat_info counters, shared by the unfused
+    and fused loops): reuse the constant record when masks are static
+    (skips the device->host param pull), else snapshot the state."""
+    if cost.per_round and not algo.masks_evolve:
+        return cost.record_repeat()
+    cost_params, cost_mask = algo.cost_snapshot(state)
+    if cost_params is None:
+        return None
+    return cost.record_round(
+        cost_params, cost_mask,
+        n_clients=algo.cost_trained_clients_per_round(),
+        samples_per_client=samples_per_client)
+
+
+def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
+                      ev_every, cost, samples_per_client, history):
+    """The runner's fused round loop (--fuse_rounds K): the shared
+    block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
+    accounting. Masks are static here (evolving-mask algorithms are
+    refused), so ONE post-round snapshot prices every round — taken from
+    the emitting block's output state, whose nonzero pattern matches the
+    unfused loop's post-round-0 snapshot (a zero-init bias is nonzero
+    after any trained round; masked weights are exact zeros either
+    way)."""
+    def on_record(r, rec, state_out):
+        crec = _cost_round_record(algo, cost, samples_per_client, state_out)
+        if crec is not None:
+            rec["sum_training_flops"] = crec["sum_training_flops"]
+            rec["sum_comm_params"] = crec["sum_comm_params"]
+        history.append(rec)
+        logger.info("%s round %d: %s", algo_name, r, rec)
+
+    return algo._fused_block_loop(
+        state, start_round, total, block, ev_every, on_record)
+
+
 def run_experiment(args: argparse.Namespace,
                    algo_name: Optional[str] = None) -> Dict[str, Any]:
     import jax
@@ -593,22 +630,42 @@ def run_experiment(args: argparse.Namespace,
             log=lambda rec: logger.info(
                 "%s round %s: %s", algo_name, rec["round"], rec))
 
+        fuse = max(1, getattr(args, "fuse_rounds", 1) or 1)
+        if fuse > 1:
+            # K-round fused programs (FedAlgorithm.run_rounds_fused): one
+            # dispatch + one metric fetch per block. Per-round host
+            # control is exactly what fusion removes, so the features
+            # that need it are refused, not silently degraded.
+            if ckpt_mgr is not None:
+                raise SystemExit(
+                    "--fuse_rounds removes per-round host control; "
+                    "round-granular checkpointing (--checkpoint_dir) "
+                    "needs --fuse_rounds 1")
+            if not algo.supports_fused:
+                raise SystemExit(
+                    f"--fuse_rounds: {algo_name} has data-dependent "
+                    "per-round host work (topology/dropout draws); "
+                    "supported: fedavg, salientgrads, ditto, local")
+            if algo.masks_evolve:
+                raise SystemExit(
+                    f"--fuse_rounds: {algo_name}'s per-round cost "
+                    "accounting snapshots evolving masks; use "
+                    "--fuse_rounds 1")
+            state = _run_fused_rounds(
+                algo, algo_name, state, start_round,
+                max(start_round, args.comm_round), fuse,
+                args.frequency_of_the_test or 0, cost,
+                samples_per_client, history)
+            final_eval = None  # re-evaluated once below
+
         try:
-            for r in range(start_round, max(start_round, args.comm_round)):
+            for r in ([] if fuse > 1 else
+                      range(start_round, max(start_round,
+                                             args.comm_round))):
                 state, rec = algo.run_round(state, r)
                 record = {"round": r, **dict(rec)}
-                if cost.per_round and not algo.masks_evolve:
-                    # static masks: per-round cost is constant; skip the
-                    # device→host param pull
-                    crec = cost.record_repeat()
-                else:
-                    cost_params, cost_mask = algo.cost_snapshot(state)
-                    crec = None
-                    if cost_params is not None:
-                        crec = cost.record_round(
-                            cost_params, cost_mask,
-                            n_clients=algo.cost_trained_clients_per_round(),
-                            samples_per_client=samples_per_client)
+                crec = _cost_round_record(
+                    algo, cost, samples_per_client, state)
                 if crec is not None:
                     record["sum_training_flops"] = crec["sum_training_flops"]
                     record["sum_comm_params"] = crec["sum_comm_params"]

@@ -151,6 +151,17 @@ def test_abcd_disk_client_filter_two_process(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env.pop("JAX_NUM_CPU_DEVICES", None)
+    # hand the workers the pytest process's persistent compile cache
+    # (conftest sets it via jax.config, which subprocesses don't inherit):
+    # without it every run pays two CONCURRENT cold full-size XLA:CPU
+    # compiles on this 1-core host — observed >900 s and a spurious
+    # timeout failure
+    import jax as _jax
+
+    cache_dir = getattr(_jax.config, "jax_compilation_cache_dir", "")
+    if cache_dir:
+        env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
@@ -164,7 +175,10 @@ def test_abcd_disk_client_filter_two_process(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=900)
+            # generous: a cold-cache run compiles the full-size program
+            # twice concurrently on one core (~12-20 min); warm runs take
+            # ~2 min
+            out, _ = p.communicate(timeout=1800)
             outs.append(out)
     finally:
         for p in procs:

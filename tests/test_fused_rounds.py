@@ -1,0 +1,178 @@
+"""Fused multi-round execution (FedAlgorithm.run_rounds_fused).
+
+K rounds as one jitted ``lax.scan`` program must be SEMANTICALLY
+IDENTICAL to K sequential ``run_round`` calls: same seeded client draws
+(the reference's ``np.random.seed(round_idx)`` contract,
+fedavg_api.py:92-100), same lr-decay schedule, same eval cadence
+(``frequency_of_the_test``, main_sailentgrads.py:90). On the CPU mesh the
+scan body traces the same ops in the same order, so the gate is bitwise.
+"""
+import jax
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import (
+    DisPFL,
+    Ditto,
+    FedAvg,
+    SalientGrads,
+)
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+
+
+def _data():
+    return make_synthetic_federated(
+        n_clients=6, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1),
+    )
+
+
+def _hp():
+    return HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9,
+                       local_epochs=1, steps_per_epoch=2, batch_size=8)
+
+
+def _max_tree_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+def test_salientgrads_fused_bitwise_equals_unfused_with_sampling():
+    # frac<1 exercises the seeded per-round draw inside the fused block
+    algo = SalientGrads(create_model("small3dcnn", num_classes=1),
+                        _data(), _hp(), loss_type="bce", frac=0.5, seed=3)
+    s0 = algo.init_state(jax.random.PRNGKey(3))
+
+    s_u, losses_u, accs_u = s0, [], []
+    for r in range(4):
+        s_u, m = algo.run_round(s_u, r)
+        losses_u.append(float(m["train_loss"]))
+        accs_u.append(float(algo.evaluate(s_u)["global_acc"]))
+
+    s_f, ys = algo.run_rounds_fused(s0, 0, 4, eval_every=1)
+    assert _max_tree_diff(s_u.global_params, s_f.global_params) == 0.0
+    np.testing.assert_array_equal(np.asarray(ys["train_loss"]), losses_u)
+    np.testing.assert_array_equal(
+        np.asarray(ys["eval"]["global_acc"]), accs_u)
+    # per-round sub-dicts carry no per-client arrays (record-ready)
+    assert not any(k.startswith("acc_per") for k in ys["eval"])
+
+
+def test_fused_eval_cadence_matches_frequency_of_the_test():
+    algo = FedAvg(create_model("small3dcnn", num_classes=1),
+                  _data(), _hp(), loss_type="bce", frac=1.0, seed=0)
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    _, ys = algo.run_rounds_fused(s0, 0, 4, eval_every=2)
+    acc = np.asarray(ys["eval"]["global_acc"])
+    # rounds 1 and 3 are eval rounds; 0 and 2 are zero-filled cond skips
+    assert acc[0] == 0.0 and acc[2] == 0.0
+    assert acc[1] > 0.0 and acc[3] > 0.0
+
+
+def test_run_fuse_rounds_history_matches_unfused():
+    def mk():
+        return Ditto(create_model("small3dcnn", num_classes=1),
+                     _data(), _hp(), loss_type="bce", frac=1.0, seed=1,
+                     lamda=0.5)
+
+    import time as _time
+
+    algo = mk()
+    s0 = algo.init_state(jax.random.PRNGKey(1))
+    _, hist_u = algo.run(comm_rounds=5, eval_every=2, state=s0,
+                         finalize=False)
+    t0 = _time.perf_counter()
+    _, hist_f = mk().run(comm_rounds=5, eval_every=2, state=s0,
+                         finalize=False, fuse_rounds=3)  # uneven tail block
+    elapsed = _time.perf_counter() - t0
+    # round_time_s is stamped at flush boundaries (after the blocking
+    # materialize), NOT around the async dispatch: the sum must account
+    # for real wall time, not microseconds of host prep
+    times = [h["round_time_s"] for h in hist_f]
+    assert all(t > 0 for t in times)
+    assert 0.2 * elapsed < sum(times) <= 1.05 * elapsed, (sum(times),
+                                                          elapsed)
+    assert [h["round"] for h in hist_f] == [h["round"] for h in hist_u]
+    for hu, hf in zip(hist_u, hist_f):
+        assert set(hu) - {"round_time_s"} == set(hf) - {"round_time_s"}
+        for k in hu:
+            if k in ("round_time_s", "round"):
+                continue
+            assert float(hu[k]) == float(hf[k]), (hu["round"], k)
+    # ditto's two per-round losses both surfaced
+    assert "personal_train_loss" in hist_f[0]
+
+
+def test_fused_unsupported_algorithm_raises():
+    algo = DisPFL(create_model("small3dcnn", num_classes=1),
+                  _data(), _hp(), loss_type="bce", seed=0)
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="fused"):
+        algo.run_rounds_fused(s0, 0, 2)
+
+
+def _cli_argv(tmp_path, tag, **over):
+    base = {
+        "--model": "small3dcnn", "--dataset": "synthetic",
+        "--client_num_in_total": "4", "--batch_size": "8",
+        "--epochs": "1", "--comm_round": "5", "--lr": "0.05",
+        "--frequency_of_the_test": "2",
+        "--log_dir": str(tmp_path / f"LOG{tag}"),
+        "--results_dir": "",
+    }
+    base.update({k: str(v) for k, v in over.items()})
+    return [x for kv in base.items() for x in kv]
+
+
+def test_runner_fuse_rounds_matches_unfused(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    out_u = run_experiment(
+        parse_args(_cli_argv(tmp_path, "u"), algo="salientgrads"),
+        "salientgrads")
+    out_f = run_experiment(
+        parse_args(_cli_argv(tmp_path, "f", **{"--fuse_rounds": 2}),
+                   algo="salientgrads"), "salientgrads")
+    hu = [h for h in out_u["history"] if h["round"] >= 0]
+    hf = [h for h in out_f["history"] if h["round"] >= 0]
+    assert len(hf) == len(hu) == 5
+    for a, b in zip(hu, hf):
+        assert set(a) == set(b), (a["round"], set(a) ^ set(b))
+        for k in ("train_loss", "sum_training_flops", "sum_comm_params"):
+            assert float(a[k]) == float(b[k]), (a["round"], k)
+        if "global_acc" in a:  # eval cadence (frequency_of_the_test=2)
+            assert float(a["global_acc"]) == float(b["global_acc"])
+    assert "global_acc" in hf[1] and "global_acc" not in hf[0]
+
+
+def test_runner_fuse_rounds_refusals(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    with pytest.raises(SystemExit, match="checkpoint"):
+        run_experiment(parse_args(
+            _cli_argv(tmp_path, "c", **{
+                "--fuse_rounds": 2,
+                "--checkpoint_dir": str(tmp_path / "ckpt")}),
+            algo="fedavg"), "fedavg")
+    with pytest.raises(SystemExit, match="fuse_rounds"):
+        run_experiment(parse_args(
+            _cli_argv(tmp_path, "d", **{"--fuse_rounds": 2}),
+            algo="dispfl"), "dispfl")
+
+
+def test_fused_with_callback_refused():
+    algo = FedAvg(create_model("small3dcnn", num_classes=1),
+                  _data(), _hp(), loss_type="bce", seed=0)
+    with pytest.raises(ValueError, match="callback"):
+        algo.run(comm_rounds=2, fuse_rounds=2,
+                 callback=lambda r, s, rec: None)
