@@ -172,6 +172,16 @@ class FedAlgorithm(abc.ABC):
     def run_round(self, state: Any, round_idx: int) -> Any:
         """Execute one federated round; returns (state, train_metrics dict)."""
 
+    def eval_metrics(self, state: Any, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
+        """Traceable eval hook (the fused round loop calls it in-graph).
+        Subclasses implement this OR override ``evaluate`` (host-side
+        composition); this guard restores the fail-fast contract that
+        de-abstracting ``evaluate`` removed."""
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement eval_metrics (traceable"
+            " eval over explicit test arrays) or override evaluate")
+
     def evaluate(self, state: Any) -> Dict[str, Any]:
         """Evaluate per the reference protocol (global and/or personal
         per-client accuracy, mean over clients — sailentgrads_api.py:231-285).
@@ -526,13 +536,15 @@ class FedAlgorithm(abc.ABC):
 
     def _fused_block_loop(self, state, start_round: int, total: int,
                           block: int, eval_every: int, on_record,
-                          timed: bool = False):
+                          timed: bool = False, on_block=None):
         """The shared fused-block driver (library ``run(fuse_rounds=K)``
         and the CLI runner's ``--fuse_rounds`` both use it): dispatch
         block b+1, then materialize and emit block b's per-round records
         — the device queue never drains. ``on_record(round_idx, rec,
         state_out)`` receives each round's record in order plus the
-        emitting block's (already computed) output state.
+        emitting block's (already computed) output state;
+        ``on_block(end_round, state_out)`` fires once per flushed block
+        (the runner's block-granular checkpoint hook).
 
         ``timed=True`` stamps ``round_time_s`` as the block's
         flush-to-flush wall time split evenly: flushes happen after the
@@ -563,6 +575,10 @@ class FedAlgorithm(abc.ABC):
                 if timed:
                     rec["round_time_s"] = wall / k
                 on_record(r0 + i, rec, state_out)
+            if on_block is not None:
+                # block boundary: state_out is computed (materialize
+                # above waited on it) — checkpoint-granularity hook
+                on_block(r0 + k, state_out)
 
         try:
             for r0 in range(start_round, total, block):
@@ -570,14 +586,18 @@ class FedAlgorithm(abc.ABC):
                 state, ys = self.run_rounds_fused(
                     state, r0, k, eval_every=eval_every)
                 if pending is not None:
-                    flush(pending)
+                    # clear BEFORE flushing: if flush raises mid-way
+                    # (e.g. on_block checkpoint save), the finally must
+                    # not re-emit the block's already-appended records
+                    p, pending = pending, None
+                    flush(p)
                 pending = (r0, k, ys, state)
             if pending is not None:
-                flush(pending)  # success path: a flush error propagates
-                pending = None
+                p, pending = pending, None
+                flush(p)  # success path: a flush error propagates
         finally:
-            if pending is not None:  # an exception is unwinding
-                try:
+            if pending is not None:  # an exception is unwinding and this
+                try:                 # block's flush never started
                     flush(pending)
                 except Exception:  # crashed mid-block: device state gone
                     logger.exception("fused block metrics lost")

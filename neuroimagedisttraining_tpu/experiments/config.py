@@ -145,9 +145,11 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "fetch per block). CLI-supported: fedavg, "
                         "salientgrads, ditto, local (subavg fuses on the "
                         "library path only — its evolving masks need "
-                        "per-round cost snapshots here). Incompatible "
-                        "with --checkpoint_dir (round-granular host "
-                        "control); 1 = unfused")
+                        "per-round cost snapshots here). With "
+                        "--checkpoint_dir, checkpoints save at block "
+                        "boundaries instead of every round (lineages stay "
+                        "resumable across fused/unfused runs); "
+                        "1 = unfused")
     p.add_argument("--eval_clients", type=int, default=0,
                    help="sampled-eval mode: evaluate only this many "
                         "(seeded) clients per eval instead of the whole "

@@ -461,6 +461,16 @@ def save_stat_info(args: argparse.Namespace, identity: str,
     return path
 
 
+def _ckpt_metadata(args, algo, cost):
+    """Checkpoint metadata sidecar (shared by the per-round and
+    block-boundary save sites — a key consumed by
+    _resolve_lineage_semantics or the cost-sidecar restore must appear in
+    BOTH or fused<->unfused lineage resume breaks)."""
+    return {"cost": cost.snapshot_totals(),
+            "batching": getattr(args, "batching", "epoch"),
+            "augment": algo.augment_fn is not None}
+
+
 def _cost_round_record(algo, cost, samples_per_client, state):
     """One round's cost record (stat_info counters, shared by the unfused
     and fused loops): reuse the constant record when masks are static
@@ -477,7 +487,8 @@ def _cost_round_record(algo, cost, samples_per_client, state):
 
 
 def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
-                      ev_every, cost, samples_per_client, history):
+                      ev_every, cost, samples_per_client, history,
+                      ckpt_mgr=None, args=None):
     """The runner's fused round loop (--fuse_rounds K): the shared
     block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
     accounting. Masks are static here (evolving-mask algorithms are
@@ -485,7 +496,13 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
     the emitting block's output state, whose nonzero pattern matches the
     unfused loop's post-round-0 snapshot (a zero-init bias is nonzero
     after any trained round; masked weights are exact zeros either
-    way)."""
+    way).
+
+    Checkpoints coarsen to BLOCK granularity: the unfused loop saves
+    after every round, this loop saves each block's output state at its
+    boundary round (same (round -> state) contract, so fused and unfused
+    lineages resume each other; a resume simply starts at the last saved
+    boundary)."""
     def on_record(r, rec, state_out):
         crec = _cost_round_record(algo, cost, samples_per_client, state_out)
         if crec is not None:
@@ -494,8 +511,14 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
         history.append(rec)
         logger.info("%s round %d: %s", algo_name, r, rec)
 
+    def on_block(end_round, state_out):
+        if ckpt_mgr is not None:
+            ckpt_mgr.save(end_round, state_out,
+                          metadata=_ckpt_metadata(args, algo, cost))
+
     return algo._fused_block_loop(
-        state, start_round, total, block, ev_every, on_record)
+        state, start_round, total, block, ev_every, on_record,
+        on_block=on_block)
 
 
 def run_experiment(args: argparse.Namespace,
@@ -634,13 +657,9 @@ def run_experiment(args: argparse.Namespace,
         if fuse > 1:
             # K-round fused programs (FedAlgorithm.run_rounds_fused): one
             # dispatch + one metric fetch per block. Per-round host
-            # control is exactly what fusion removes, so the features
-            # that need it are refused, not silently degraded.
-            if ckpt_mgr is not None:
-                raise SystemExit(
-                    "--fuse_rounds removes per-round host control; "
-                    "round-granular checkpointing (--checkpoint_dir) "
-                    "needs --fuse_rounds 1")
+            # control is exactly what fusion removes, so features that
+            # need it either coarsen to block granularity (checkpoints
+            # save at block boundaries) or are refused outright.
             if not algo.supports_fused:
                 raise SystemExit(
                     f"--fuse_rounds: {algo_name} has data-dependent "
@@ -655,7 +674,8 @@ def run_experiment(args: argparse.Namespace,
                 algo, algo_name, state, start_round,
                 max(start_round, args.comm_round), fuse,
                 args.frequency_of_the_test or 0, cost,
-                samples_per_client, history)
+                samples_per_client, history,
+                ckpt_mgr=ckpt_mgr, args=args)
             final_eval = None  # re-evaluated once below
 
         try:
@@ -680,11 +700,7 @@ def run_experiment(args: argparse.Namespace,
                 deferred.push(record)
                 if ckpt_mgr is not None:
                     ckpt_mgr.save(r + 1, state,
-                                  metadata={"cost": cost.snapshot_totals(),
-                                            "batching": getattr(
-                                                args, "batching", "epoch"),
-                                            "augment": algo.augment_fn
-                                            is not None})
+                                  metadata=_ckpt_metadata(args, algo, cost))
         except BaseException:
             deferred.flush_safely()  # emit the last completed round
             raise

@@ -152,22 +152,63 @@ def test_runner_fuse_rounds_matches_unfused(tmp_path):
     assert "global_acc" in hf[1] and "global_acc" not in hf[0]
 
 
-def test_runner_fuse_rounds_refusals(tmp_path):
+def test_runner_fuse_rounds_refuses_host_randomness_algos(tmp_path):
     from neuroimagedisttraining_tpu.experiments import (
         parse_args,
         run_experiment,
     )
 
-    with pytest.raises(SystemExit, match="checkpoint"):
-        run_experiment(parse_args(
-            _cli_argv(tmp_path, "c", **{
-                "--fuse_rounds": 2,
-                "--checkpoint_dir": str(tmp_path / "ckpt")}),
-            algo="fedavg"), "fedavg")
     with pytest.raises(SystemExit, match="fuse_rounds"):
         run_experiment(parse_args(
             _cli_argv(tmp_path, "d", **{"--fuse_rounds": 2}),
             algo="dispfl"), "dispfl")
+
+
+def test_runner_fused_checkpoints_at_block_boundaries_and_resumes(tmp_path):
+    """Fused runs checkpoint each block's output state at its boundary
+    round (same (round -> state) contract as the unfused per-round saves),
+    and a fused lineage resumes into an unfused continuation whose rounds
+    match a straight-through unfused run exactly."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    # straight-through unfused reference run, 4 rounds
+    out_ref = run_experiment(
+        parse_args(_cli_argv(tmp_path, "ref", **{"--comm_round": 4}),
+                   algo="salientgrads"), "salientgrads")
+    # fused first leg: one block of 2 -> a single checkpoint at round 2
+    out_f = run_experiment(
+        parse_args(_cli_argv(tmp_path, "f", **{
+            "--comm_round": 2, "--fuse_rounds": 2,
+            "--checkpoint_dir": ckpt}), algo="salientgrads"),
+        "salientgrads")
+    from neuroimagedisttraining_tpu.utils.checkpoint import (
+        CheckpointManager,
+    )
+    from neuroimagedisttraining_tpu.experiments.config import run_identity
+
+    args_probe = parse_args(_cli_argv(tmp_path, "p", **{
+        "--comm_round": 2, "--fuse_rounds": 2, "--checkpoint_dir": ckpt}),
+        algo="salientgrads")
+    mgr = CheckpointManager(
+        ckpt, run_identity(args_probe, "salientgrads", for_checkpoint=True))
+    assert mgr.latest_step() == 2  # block boundary, not per-round
+    # unfused resume finishes rounds 2-3 from the fused lineage
+    out_r = run_experiment(
+        parse_args(_cli_argv(tmp_path, "r", **{
+            "--comm_round": 4, "--checkpoint_dir": ckpt})
+            + ["--resume"], algo="salientgrads"), "salientgrads")
+    ref = {h["round"]: h for h in out_ref["history"] if h["round"] >= 0}
+    got = {h["round"]: h for h in
+           (out_f["history"] + out_r["history"]) if h["round"] >= 0}
+    assert sorted(got) == [0, 1, 2, 3]
+    for r in got:
+        assert float(got[r]["train_loss"]) == float(ref[r]["train_loss"]), r
+        assert float(got[r]["sum_training_flops"]) == \
+            float(ref[r]["sum_training_flops"]), r
 
 
 def test_fused_with_callback_refused():
