@@ -394,9 +394,16 @@ class FedAlgorithm(abc.ABC):
         return eval_all
 
     def _make_personal_eval(self):
-        """Eval stacked per-client params, each on its own client's test set."""
+        """Eval stacked per-client params, each on its own client's test
+        set. Runs through ``_vmap_clients`` so ``client_chunk`` bounds the
+        concurrent per-client activations — personal eval carries
+        per-client WEIGHTS, so XLA cannot fold the client axis into the
+        conv batch the way the shared-params global eval does, and the
+        full vmap at ABCD volume would hold every client's eval
+        activations at once."""
         eval_client = self.eval_client
         eval_idx = self._eval_idx
+        vmapped = self._vmap_clients(eval_client, in_axes=(0, 0, 0, 0))
 
         @jax.jit
         def eval_personal(params_stack, x_test, y_test, n_test):
@@ -407,7 +414,7 @@ class FedAlgorithm(abc.ABC):
                 x_test = jnp.take(x_test, eval_idx, axis=0)
                 y_test = jnp.take(y_test, eval_idx, axis=0)
                 n_test = jnp.take(n_test, eval_idx)
-            correct, loss_sum, total = jax.vmap(eval_client)(
+            correct, loss_sum, total = vmapped(
                 params_stack, x_test, y_test, n_test
             )
             totals = jnp.maximum(total, 1)
@@ -477,6 +484,13 @@ class FedAlgorithm(abc.ABC):
                 hins, r = xs[:n_host], xs[n_host]
                 out = self._round_jit(s, *hins, r, *data_args)
                 s, metrics = out[0], out[1:]
+                # fail fast if a subclass's _round_jit outputs drifted from
+                # its _round_metric_names — dict(zip(...)) would silently
+                # drop or mislabel metrics (ADVICE r4)
+                assert len(metrics) == len(self._round_metric_names), (
+                    f"{type(self).__name__}._round_jit returned "
+                    f"{len(metrics)} metrics but _round_metric_names has "
+                    f"{len(self._round_metric_names)}")
                 ys = dict(zip(self._round_metric_names, metrics))
                 if eval_every:
                     do = (r.astype(jnp.int32) + 1) % eval_every == 0
@@ -490,7 +504,14 @@ class FedAlgorithm(abc.ABC):
             # host materializes a block's metrics in a single transfer
             # (on a tunneled TPU each leaf fetch costs ~110 ms — measured
             # 442 ms for 4 leaves — so per-leaf fetches would eat the
-            # fusion win)
+            # fusion win). CONTRACT: every _round_metric_names /
+            # eval_metrics leaf must be an inexact (floating) scalar — the
+            # f32 cast is the canonical record dtype, and an int/bool
+            # metric would be silently coerced (asserted here, ADVICE r4)
+            for x in jax.tree_util.tree_leaves(ys):
+                assert jnp.issubdtype(x.dtype, jnp.inexact), (
+                    f"per-round metrics must be floating (got {x.dtype}); "
+                    "the packed single-transfer stack records f32")
             packed = jnp.stack([
                 x.astype(jnp.float32)
                 for x in jax.tree_util.tree_leaves(ys)])
@@ -516,10 +537,13 @@ class FedAlgorithm(abc.ABC):
         """
         if not self.supports_fused:
             raise ValueError(
-                f"{self.name}: fused rounds need all per-round host work "
-                "to be the seeded client draw; this algorithm has "
-                "data-dependent host control flow (topology/dropout "
-                "draws) — run it with fuse_rounds=1")
+                f"{self.name}: fused rounds need every per-round host "
+                "input to be a pure function of round_idx; this "
+                "algorithm's host work is data-DEPENDENT (FedFomo biases "
+                "its neighbor draw by accumulated weights read back from "
+                "device, fedfomo_api.py:130-144; TurboAggregate's "
+                "share/reconstruct protocol is host-interactive) — run "
+                "it with fuse_rounds=1")
         host = [self._fused_host_inputs(r)
                 for r in range(start_round, start_round + n_rounds)]
         host_stack = tuple(

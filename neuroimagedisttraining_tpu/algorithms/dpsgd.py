@@ -35,6 +35,12 @@ class DPSGDState:
 
 class DPSGD(FedAlgorithm):
     name = "dpsgd"
+    # the only per-round host input is the neighbor adjacency, a pure
+    # function of round_idx (np.random.RandomState(round_idx) inside
+    # neighbor_adjacency — _benefit_choose's seeded draw, dpsgd_api.py:
+    # 116-139), so a K-round block precomputes the adjacency stack and
+    # runs as ONE lax.scan program like the centralized algorithms
+    supports_fused = True
 
     def cost_trained_clients_per_round(self) -> int:
         # gossip rounds train the whole cohort (dpsgd_api.py:41-103)
@@ -77,29 +83,32 @@ class DPSGD(FedAlgorithm):
             rng=s_rng,
         )
 
-    def run_round(self, state: DPSGDState, round_idx: int):
-        adj = neighbor_adjacency(
+    def _fused_host_inputs(self, round_idx: int):
+        # the round's adjacency, with the exact seeded draw of the unfused
+        # path (neighbor_adjacency reseeds from round_idx internally)
+        return (neighbor_adjacency(
             round_idx, self.num_clients, self.clients_per_round,
             mode=self.neighbor_mode,
-        )
+        ),)
+
+    def run_round(self, state: DPSGDState, round_idx: int):
+        (adj,) = self._fused_host_inputs(round_idx)
         state, loss = self._round_jit(
             state, jnp.asarray(adj), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
         )
         return state, {"train_loss": loss}
 
-    def evaluate(self, state: DPSGDState) -> Dict[str, Any]:
-        # global average model (dpsgd_api.py:85 _avg_aggregate) + personal
+    def eval_metrics(self, state: DPSGDState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
+        # global average model (dpsgd_api.py:85 _avg_aggregate) + personal;
+        # fully traceable, so the fused block evals in-graph too
         avg = jax.tree_util.tree_map(
             lambda x: jnp.mean(x, axis=0), state.personal_params
         )
-        ev_g = self._eval_global(
-            avg, self.data.x_test, self.data.y_test, self.data.n_test
-        )
+        ev_g = self._eval_global(avg, x_test, y_test, n_test)
         ev_p = self._eval_personal(
-            state.personal_params, self.data.x_test, self.data.y_test,
-            self.data.n_test,
-        )
+            state.personal_params, x_test, y_test, n_test)
         return {
             "global_acc": ev_g["acc"], "global_loss": ev_g["loss"],
             "personal_acc": ev_p["acc"], "personal_loss": ev_p["loss"],

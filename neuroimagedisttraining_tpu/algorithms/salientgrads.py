@@ -14,6 +14,15 @@ Here the scoring pass is a vmapped ``jax.grad`` w.r.t. an all-ones mask
 multiplier (mean over clients = the "saliency psum"), and the training round
 is the same single jitted SPMD program as FedAvg with the mask broadcast
 along the client axis.
+
+Like the reference, each trained client's locally-trained weights are kept
+as its *personal* model (``w_per_mdls[cur_clnt] = w_per``,
+``sailentgrads_api.py:107-110,133``) and the per-round eval protocol tests
+BOTH the global model and every client's personal model on its local test
+set (``_test_on_all_clients(w_global, w_per_mdls, round_idx)``,
+``:238,262-283``), plus one final eval at round -1 after the loop
+(``:147``). ``track_personal=False`` drops the on-device stack for
+large-C simulations (same opt-out as FedAvg's).
 """
 from __future__ import annotations
 
@@ -23,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..core.state import broadcast_tree
+from ..core.state import broadcast_tree, tree_scatter_update
 from ..core.trainer import make_client_update
 from ..models import init_params
 from ..ops.sparsity import make_snip_score_fn, mask_density, mask_from_scores
@@ -34,6 +43,12 @@ from .base import FedAlgorithm, sample_client_indexes
 class SalientGradsState:
     global_params: Any
     mask: Any
+    # [C, ...] — w_per_mdls (sailentgrads_api.py:107-110), or None when
+    # personal tracking is off. Initialized to dense copies of the initial
+    # global model (the reference's mask multiply at init is commented
+    # out, :110) and updated with each trained client's masked local
+    # weights. Same HBM caveat as FedAvgState.personal_params.
+    personal_params: Any
     rng: jax.Array
 
 
@@ -44,7 +59,8 @@ class SalientGrads(FedAlgorithm):
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
                  fused_kernels: bool = False, snip_mask: bool = True,
-                 stratified_sampling: bool = False, **kwargs):
+                 stratified_sampling: bool = False,
+                 track_personal: bool = True, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
@@ -53,9 +69,12 @@ class SalientGrads(FedAlgorithm):
         # --snip_mask 0: all-ones mask, the reference's dense-control mode
         # (sailentgrads_api.py:91-103)
         self.snip_mask = snip_mask
-        # --stratified_sampling: class-balanced scoring batches over 25
-        # iterations (client.py:32-42; see ops/sparsity docstring)
+        # --stratified_sampling: exact 25-fold stratified scoring
+        # (client.py:32-42; see ops/sparsity docstring)
         self.stratified_sampling = stratified_sampling
+        # track_personal=False drops the on-device w_per_mdls stack and the
+        # personal half of the per-round eval — O(C x model) HBM
+        self.track_personal = track_personal
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -100,7 +119,7 @@ class SalientGrads(FedAlgorithm):
         def round_fn(state: SalientGradsState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, _, mean_loss = self._train_selected_weighted(
+            new_global, locals_, mean_loss = self._train_selected_weighted(
                 self.client_update, state.global_params, state.mask,
                 sel_idx, round_idx, round_key, x_train, y_train, n_train,
                 defense=self.defense,
@@ -110,14 +129,21 @@ class SalientGrads(FedAlgorithm):
                 # model keeps the SNIP sparsity invariant
                 new_global = jax.tree_util.tree_map(
                     lambda p, m: p * m, new_global, state.mask)
+            new_personal = state.personal_params
+            if new_personal is not None:
+                # w_per_mdls[cur_clnt] = the client's (pre-defense) locally
+                # trained weights (sailentgrads_api.py:133)
+                new_personal = tree_scatter_update(
+                    new_personal, sel_idx, locals_)
             return (
                 SalientGradsState(global_params=new_global, mask=state.mask,
-                                  rng=rng),
+                                  personal_params=new_personal, rng=rng),
                 mean_loss,
             )
 
         self._round_jit = jax.jit(round_fn)
         self._eval_global = self._make_global_eval()
+        self._eval_personal = self._make_personal_eval()
 
     def init_state(self, rng: jax.Array) -> SalientGradsState:
         p_rng, m_rng, s_rng = jax.random.split(rng, 3)
@@ -131,7 +157,14 @@ class SalientGrads(FedAlgorithm):
                 params, self.data.x_train, self.data.y_train,
                 self.data.n_train, m_rng,
             )
-        return SalientGradsState(global_params=params, mask=mask, rng=s_rng)
+        return SalientGradsState(
+            global_params=params, mask=mask,
+            # w_per_mdls init: dense copies of the initial global model —
+            # the reference's init-time mask multiply is commented out
+            # (sailentgrads_api.py:107-110)
+            personal_params=(broadcast_tree(params, self.num_clients)
+                             if self.track_personal else None),
+            rng=s_rng)
 
     def run_round(self, state: SalientGradsState, round_idx: int):
         sel = sample_client_indexes(
@@ -143,14 +176,31 @@ class SalientGrads(FedAlgorithm):
         )
         return state, {"train_loss": loss}
 
+    def finalize(self, state: SalientGradsState):
+        """One final global+personal eval after the last round — the
+        reference's ``_test_on_all_clients(w_global, w_per_mdls, -1)``
+        (``sailentgrads_api.py:147``; no fine-tune, unlike FedAvg)."""
+        ev = self.evaluate(state)
+        record = {"round": -1,
+                  **{k: v for k, v in ev.items()
+                     if not k.startswith("acc_per")}}
+        return state, record
+
     def eval_metrics(self, state: SalientGradsState, x_test, y_test,
                      n_test) -> Dict[str, Any]:
-        # evaluate the masked global model, as the reference does (the
-        # aggregate of masked locals is already masked; assert via density)
+        # the reference protocol tests the global model AND every client's
+        # personal model on its local test set (sailentgrads_api.py:238,
+        # 262-283); global params are already masked (the aggregate of
+        # masked locals; assert via density)
         ev = self._eval_global(state.global_params, x_test, y_test, n_test)
-        return {
+        out = {
             "global_acc": ev["acc"],
             "global_loss": ev["loss"],
             "mask_density": mask_density(state.mask),
             "acc_per_client": ev["acc_per_client"],
         }
+        if state.personal_params is not None:
+            evp = self._eval_personal(
+                state.personal_params, x_test, y_test, n_test)
+            out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
+        return out

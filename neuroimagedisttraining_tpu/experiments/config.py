@@ -80,16 +80,23 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="inert (reference epoch|step local-loop switch; "
                         "'step' is dead code in the reference)")
     p.add_argument("--final_finetune", type=int, default=1,
-                   help="run the algorithm's end-of-training pass (FedAvg's "
-                        "final per-client fine-tune, fedavg_api.py:79-88); "
-                        "0 skips it")
-    p.add_argument("--track_personal", type=int, default=1,
-                   help="fedavg: keep per-client personal models "
-                        "(w_per_mdls, fedavg_api.py:42-45) on device for "
-                        "personal eval + final fine-tune. The stack is one "
-                        "full model per client in HBM; pass 0 for very "
-                        "large --client_num_in_total simulations that "
-                        "don't need personal models")
+                   help="run the algorithm's end-of-training pass "
+                        "(FedAvg: final per-client fine-tune, "
+                        "fedavg_api.py:79-88; SalientGrads: the eval-only "
+                        "final round=-1 _test_on_all_clients, "
+                        "sailentgrads_api.py:147); 0 skips it")
+    p.add_argument("--track_personal", type=int, default=None,
+                   help="fedavg/salientgrads: keep per-client personal "
+                        "models (w_per_mdls, fedavg_api.py:42-45 / "
+                        "sailentgrads_api.py:107-110) on device for "
+                        "per-round personal eval (+ fedavg's final "
+                        "fine-tune). The stack is one full model per "
+                        "client in HBM; pass 0 for very large "
+                        "--client_num_in_total simulations that don't "
+                        "need personal models. The None sentinel lets the "
+                        "runner distinguish an explicit choice from the "
+                        "default when resuming a pre-round-5 salientgrads "
+                        "lineage (whose states have no personal stack)")
 
     # -- robust aggregation (fedml_core/robustness/robust_aggregation.py;
     # dead code in the reference — no caller — wired end-to-end here)
@@ -143,9 +150,12 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="execute the round loop in K-round fused programs "
                         "(lax.scan over rounds — one dispatch + one metric "
                         "fetch per block). CLI-supported: fedavg, "
-                        "salientgrads, ditto, local (subavg fuses on the "
-                        "library path only — its evolving masks need "
-                        "per-round cost snapshots here). With "
+                        "salientgrads, ditto, local, dpsgd, and "
+                        "dispfl --static (subavg and evolving-mask dispfl "
+                        "fuse on the library path only — their evolving "
+                        "masks need per-round cost snapshots here; fedfomo/"
+                        "turboaggregate have data-dependent host work and "
+                        "cannot fuse). With "
                         "--checkpoint_dir, checkpoints save at block "
                         "boundaries instead of every round (lineages stay "
                         "resumable across fused/unfused runs); "
@@ -291,6 +301,10 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
     args.augment_explicit = getattr(args, "augment", None) is not None
     if getattr(args, "augment", None) is None:
         args.augment = 1
+    args.track_personal_explicit = \
+        getattr(args, "track_personal", None) is not None
+    if getattr(args, "track_personal", None) is None:
+        args.track_personal = 1
     return args
 
 
@@ -363,9 +377,10 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
             parts.append(f"dt{args.data_dtype}")
     if not getattr(args, "final_finetune", 1):
         parts.append("noft")
-    if algo == "fedavg" and not getattr(args, "track_personal", 1):
-        # only fedavg consumes the flag; other algorithms' lineage must not
-        # split on a no-op
+    if algo in ("fedavg", "salientgrads") and \
+            not getattr(args, "track_personal", 1):
+        # only fedavg/salientgrads consume the flag; other algorithms'
+        # lineage must not split on a no-op
         parts.append("nopers")
     if getattr(args, "global_test", False):
         parts.append("g")  # main_dispfl.py:198-199

@@ -100,7 +100,8 @@ def _check_augment_consistency(args, algo) -> None:
 
 
 def _resolve_lineage_semantics(args, meta: dict, last: int,
-                               directory: str) -> None:
+                               directory: str,
+                               algo_name: str = "") -> None:
     """Reconcile this run's training semantics (batching mode, CIFAR
     augmentation) with an existing checkpoint lineage BEFORE the algorithm
     is built — both knobs are baked into the jitted kernels at build time.
@@ -116,7 +117,20 @@ def _resolve_lineage_semantics(args, meta: dict, last: int,
     start recording the adapted value; an explicit mismatch, or any fresh
     run that would overwrite the lineage round by round, is refused.
     """
-    def _refuse(knob, lineage_val, here_val, fix):
+    def _adopt_or_refuse(knob, lineage_val, here_val, explicit,
+                         provenance, fix):
+        """One lineage knob: equal -> no-op; defaulted resume -> adopt the
+        lineage's value (warning); explicit mismatch or overwriting fresh
+        run -> refuse with knob-specific guidance."""
+        if lineage_val == here_val:
+            return
+        if args.resume and not explicit:
+            logger.warning(
+                "lineage has %s=%s (%s); continuing with those semantics "
+                "instead of the current default", knob, lineage_val,
+                provenance)
+            setattr(args, knob, lineage_val)
+            return
         action = ("resuming it" if args.resume
                   else "a fresh run overwriting it round by round")
         raise SystemExit(
@@ -124,41 +138,50 @@ def _resolve_lineage_semantics(args, meta: dict, last: int,
             f"lineage up to round {last}; {action} with {knob}={here_val} "
             f"would mix training semantics. {fix}")
 
-    here_b = getattr(args, "batching", "epoch")
     lineage_b = meta.get("batching") or "replacement"  # None = pre-round-3
-    if lineage_b != here_b:
-        if args.resume and not getattr(args, "batching_explicit", True):
-            logger.warning(
-                "lineage trained with --batching %s (%s); continuing with "
-                "those semantics instead of the current default",
-                lineage_b,
-                "recorded" if meta.get("batching") else
-                "pre-round-3 sidecar-less, the only semantics it can have")
-            args.batching = lineage_b
-        else:
-            _refuse("batching", lineage_b, here_b,
-                    f"Pass --batching {lineage_b} to continue it, or start "
-                    "a fresh lineage (--tag or a different "
-                    "--checkpoint_dir).")
+    _adopt_or_refuse(
+        "batching", lineage_b, getattr(args, "batching", "epoch"),
+        getattr(args, "batching_explicit", True),
+        "recorded" if meta.get("batching") else
+        "pre-round-3 sidecar-less, the only semantics it can have",
+        f"Pass --batching {lineage_b} to continue it, or start a fresh "
+        "lineage (--tag or a different --checkpoint_dir).")
 
-    here_a = bool(getattr(args, "augment", 1)) \
-        and _dataset_augmentable(args.dataset)
     pa = meta.get("augment")
-    lineage_a = bool(pa)  # None = pre-round-4 lineage: un-augmented
-    if lineage_a != here_a:
-        if args.resume and not getattr(args, "augment_explicit", True):
-            logger.warning(
-                "lineage trained with augment=%d (%s); continuing with "
-                "those semantics instead of the current default",
-                int(lineage_a),
-                "recorded" if pa is not None else
-                "pre-round-4 sidecar-less, the only semantics it can have")
-            args.augment = int(lineage_a)
-        else:
-            _refuse("augment", int(lineage_a), int(here_a),
-                    f"Pass --augment {int(lineage_a)} to continue it, or "
-                    "start a fresh lineage (--tag or a different "
-                    "--checkpoint_dir).")
+    lineage_a = int(bool(pa))  # None = pre-round-4 lineage: un-augmented
+    here_a = int(bool(getattr(args, "augment", 1))
+                 and _dataset_augmentable(args.dataset))
+    _adopt_or_refuse(
+        "augment", lineage_a, here_a,
+        getattr(args, "augment_explicit", True),
+        "recorded" if pa is not None else
+        "pre-round-4 sidecar-less, the only semantics it can have",
+        f"Pass --augment {lineage_a} to continue it, or start a fresh "
+        "lineage (--tag or a different --checkpoint_dir).")
+
+    # SalientGrads only: its state grew the personal_params stack in
+    # round 5 under the SAME default identity (fedavg lineages split on
+    # the 'nopers' tag from day one, so their structure always matches
+    # their identity). A sidecar-less lineage (track_personal None)
+    # predates the stack — its checkpoints hold 3-field states that
+    # cannot be restored into the 4-field template, and the personal
+    # models' history is unrecoverable, so a defaulted resume continues
+    # under the lineage's own (personal-less) protocol. NOTE the remedy
+    # is the defaulted resume, NOT an explicit --track_personal 0: that
+    # flag adds the 'nopers' tag to the CHECKPOINT identity (it must —
+    # fedavg's two modes store different state structures), which would
+    # point at a different, empty lineage dir.
+    if algo_name == "salientgrads":
+        tp = meta.get("track_personal")
+        _adopt_or_refuse(
+            "track_personal", int(bool(tp)),  # None = pre-r5: no stack
+            int(bool(getattr(args, "track_personal", 1))),
+            getattr(args, "track_personal_explicit", True),
+            "recorded" if tp is not None else
+            "pre-round-5 sidecar-less: its states have no personal stack",
+            "Resume WITHOUT --track_personal to continue it under the "
+            "lineage's own protocol, or start a fresh lineage (--tag or "
+            "a different --checkpoint_dir) for the other mode.")
 
 
 def infer_loss_type(args: argparse.Namespace, class_num: int) -> str:
@@ -288,7 +311,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                      snip_mask=bool(getattr(args, "snip_mask", 1)),
                      stratified_sampling=bool(
                          getattr(args, "stratified_sampling", 0)),
-                     fused_kernels=bool(getattr(args, "fused_kernels", 0)))
+                     fused_kernels=bool(getattr(args, "fused_kernels", 0)),
+                     track_personal=bool(
+                         getattr(args, "track_personal", 1)))
     elif algo_name == "fedavg":
         extra = dict(defense=defense,
                      track_personal=bool(
@@ -468,7 +493,8 @@ def _ckpt_metadata(args, algo, cost):
     BOTH or fused<->unfused lineage resume breaks)."""
     return {"cost": cost.snapshot_totals(),
             "batching": getattr(args, "batching", "epoch"),
-            "augment": algo.augment_fn is not None}
+            "augment": algo.augment_fn is not None,
+            "track_personal": bool(getattr(args, "track_personal", 1))}
 
 
 def _cost_round_record(algo, cost, samples_per_client, state):
@@ -545,7 +571,7 @@ def run_experiment(args: argparse.Namespace,
             if last is not None:
                 _resolve_lineage_semantics(
                     args, ckpt_mgr.load_metadata(last) or {}, last,
-                    ckpt_mgr.directory)
+                    ckpt_mgr.directory, algo_name)
         identity = run_identity(args, algo_name)
         configure_console()
         log_handler = add_run_file_logger(
@@ -663,8 +689,10 @@ def run_experiment(args: argparse.Namespace,
             if not algo.supports_fused:
                 raise SystemExit(
                     f"--fuse_rounds: {algo_name} has data-dependent "
-                    "per-round host work (topology/dropout draws); "
-                    "supported: fedavg, salientgrads, ditto, local")
+                    "per-round host work (FedFomo's accumulated-weight-"
+                    "biased neighbor draw / TurboAggregate's interactive "
+                    "share protocol); supported: fedavg, salientgrads, "
+                    "ditto, local, dpsgd, dispfl(--static)")
             if algo.masks_evolve:
                 raise SystemExit(
                     f"--fuse_rounds: {algo_name}'s per-round cost "
@@ -719,12 +747,16 @@ def run_experiment(args: argparse.Namespace,
                       for k, v in fin_rec.items()}
             history.append(record)
             logger.info("%s final: %s", algo_name, record)
-            # the fine-tune pass trains every client once — count it
-            cost_params, cost_mask = algo.cost_snapshot(state)
-            if cost_params is not None:
-                cost.record_round(cost_params, cost_mask,
-                                  n_clients=algo.num_clients,
-                                  samples_per_client=samples_per_client)
+            # only a finalize that actually TRAINED counts toward the
+            # FLOPs/comm counters (FedAvg's fine-tune marks its record
+            # with finetune=True; SalientGrads's finalize is the
+            # reference's eval-only final _test_on_all_clients)
+            if record.get("finetune"):
+                cost_params, cost_mask = algo.cost_snapshot(state)
+                if cost_params is not None:
+                    cost.record_round(cost_params, cost_mask,
+                                      n_clients=algo.num_clients,
+                                      samples_per_client=samples_per_client)
             # finalize() already evaluated the post-fine-tune state; reuse
             # its metrics instead of re-running the full-cohort evals
             final_eval = {k: v for k, v in fin_rec.items()

@@ -14,6 +14,7 @@ import pytest
 from neuroimagedisttraining_tpu.algorithms import (
     DisPFL,
     Ditto,
+    DPSGD,
     FedAvg,
     SalientGrads,
 )
@@ -47,17 +48,23 @@ def test_salientgrads_fused_bitwise_equals_unfused_with_sampling():
                         _data(), _hp(), loss_type="bce", frac=0.5, seed=3)
     s0 = algo.init_state(jax.random.PRNGKey(3))
 
-    s_u, losses_u, accs_u = s0, [], []
+    s_u, losses_u, accs_u, pers_u = s0, [], [], []
     for r in range(4):
         s_u, m = algo.run_round(s_u, r)
         losses_u.append(float(m["train_loss"]))
-        accs_u.append(float(algo.evaluate(s_u)["global_acc"]))
+        ev = algo.evaluate(s_u)
+        accs_u.append(float(ev["global_acc"]))
+        pers_u.append(float(ev["personal_acc"]))
 
     s_f, ys = algo.run_rounds_fused(s0, 0, 4, eval_every=1)
     assert _max_tree_diff(s_u.global_params, s_f.global_params) == 0.0
+    assert _max_tree_diff(s_u.personal_params, s_f.personal_params) == 0.0
     np.testing.assert_array_equal(np.asarray(ys["train_loss"]), losses_u)
     np.testing.assert_array_equal(
         np.asarray(ys["eval"]["global_acc"]), accs_u)
+    # the personal half of the eval protocol rides the fused path too
+    np.testing.assert_array_equal(
+        np.asarray(ys["eval"]["personal_acc"]), pers_u)
     # per-round sub-dicts carry no per-client arrays (record-ready)
     assert not any(k.startswith("acc_per") for k in ys["eval"])
 
@@ -107,9 +114,65 @@ def test_run_fuse_rounds_history_matches_unfused():
     assert "personal_train_loss" in hist_f[0]
 
 
-def test_fused_unsupported_algorithm_raises():
+def _check_fused_matches_unfused(algo, seed, n_rounds=4):
+    """Shared gate for the decentralized fused paths: states and
+    per-round train metrics bitwise; eval accuracies bitwise (count
+    ratios); eval losses to f32 round-off (the standalone eval program
+    and the in-scan eval branch may reassociate the loss-sum reduction
+    — measured 1 ulp on CPU)."""
+    s0 = algo.init_state(jax.random.PRNGKey(seed))
+    s_u, recs = s0, []
+    for r in range(n_rounds):
+        s_u, m = algo.run_round(s_u, r)
+        ev = {k: float(v) for k, v in algo.evaluate(s_u).items()
+              if not k.startswith("acc_per")}
+        recs.append(({k: float(v) for k, v in m.items()}, ev))
+    s_f, ys = algo.run_rounds_fused(s0, 0, n_rounds, eval_every=1)
+    assert _max_tree_diff(s_u.personal_params, s_f.personal_params) == 0.0
+    h = ys.materialize()
+    for i, (m, ev) in enumerate(recs):
+        for k, v in m.items():
+            assert float(h[k][i]) == v, (algo.name, k, i)
+        for k, v in ev.items():
+            got = float(h["eval"][k][i])
+            if k.endswith("acc") or k.endswith("density"):
+                assert got == v, (algo.name, k, i)
+            else:
+                assert abs(got - v) <= 4e-7 * max(1.0, abs(v)), (
+                    algo.name, k, i, got, v)
+
+
+def test_dpsgd_fused_bitwise_equals_unfused():
+    """DPSGD's adjacency is a pure function of round_idx
+    (dpsgd_api.py:116-139 seeded _benefit_choose) — the fused block
+    precomputes the adjacency stack and must replay the gossip exactly."""
+    algo = DPSGD(create_model("small3dcnn", num_classes=1),
+                 _data(), _hp(), loss_type="bce", frac=0.5, seed=2,
+                 neighbor_mode="random")
+    _check_fused_matches_unfused(algo, seed=2)
+
+
+def test_dispfl_fused_bitwise_equals_unfused():
+    """DisPFL's per-round host inputs (active coin flips + neighbor
+    draws, dispfl_api.py:96,196-220) are data-independent host RNG —
+    replayable into a fused block; fire/regrow evolution is in-graph and
+    scans. Exercises dropout (active<1), mask evolution, and the two
+    per-round local-test series."""
     algo = DisPFL(create_model("small3dcnn", num_classes=1),
-                  _data(), _hp(), loss_type="bce", seed=0)
+                  _data(), _hp(), loss_type="bce", frac=0.5, seed=2,
+                  active=0.8, total_rounds=4)
+    _check_fused_matches_unfused(algo, seed=2)
+    # the local-test series rode the fused metrics
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    _, ys = algo.run_rounds_fused(s0, 0, 2, eval_every=0)
+    assert "new_mask_test_acc" in ys and "old_mask_test_acc" in ys
+
+
+def test_fused_unsupported_algorithm_raises():
+    from neuroimagedisttraining_tpu.algorithms import TurboAggregate
+
+    algo = TurboAggregate(create_model("small3dcnn", num_classes=1),
+                          _data(), _hp(), loss_type="bce", seed=0)
     s0 = algo.init_state(jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="fused"):
         algo.run_rounds_fused(s0, 0, 2)
@@ -152,16 +215,35 @@ def test_runner_fuse_rounds_matches_unfused(tmp_path):
     assert "global_acc" in hf[1] and "global_acc" not in hf[0]
 
 
-def test_runner_fuse_rounds_refuses_host_randomness_algos(tmp_path):
+def test_runner_fuse_rounds_gates(tmp_path):
+    """The CLI gate: data-dependent host work (fedfomo) is refused
+    outright; default DisPFL is refused only on the evolving-mask cost
+    accounting; DisPFL --static fuses and matches its unfused run."""
     from neuroimagedisttraining_tpu.experiments import (
         parse_args,
         run_experiment,
     )
 
-    with pytest.raises(SystemExit, match="fuse_rounds"):
+    with pytest.raises(SystemExit, match="data-dependent"):
+        run_experiment(parse_args(
+            _cli_argv(tmp_path, "ff", **{"--fuse_rounds": 2}),
+            algo="fedfomo"), "fedfomo")
+    with pytest.raises(SystemExit, match="evolving masks"):
         run_experiment(parse_args(
             _cli_argv(tmp_path, "d", **{"--fuse_rounds": 2}),
             algo="dispfl"), "dispfl")
+    out_u = run_experiment(parse_args(
+        _cli_argv(tmp_path, "su") + ["--static"], algo="dispfl"),
+        "dispfl")
+    out_f = run_experiment(parse_args(
+        _cli_argv(tmp_path, "sf", **{"--fuse_rounds": 2}) + ["--static"],
+        algo="dispfl"), "dispfl")
+    hu = [h for h in out_u["history"] if h["round"] >= 0]
+    hf = [h for h in out_f["history"] if h["round"] >= 0]
+    assert len(hf) == len(hu) == 5
+    for a, b in zip(hu, hf):
+        assert float(a["train_loss"]) == float(b["train_loss"])
+        assert float(a["old_mask_test_acc"]) == float(b["old_mask_test_acc"])
 
 
 def test_runner_fused_checkpoints_at_block_boundaries_and_resumes(tmp_path):

@@ -10,7 +10,7 @@ from neuroimagedisttraining_tpu.models import create_model
 from neuroimagedisttraining_tpu.ops.sparsity import kernel_flags, mask_density
 
 
-def _make(dense_ratio=0.5, itersnip=2):
+def _make(dense_ratio=0.5, itersnip=2, frac=1.0, **kw):
     data = make_synthetic_federated(
         n_clients=8, samples_per_client=24, test_per_client=8,
         sample_shape=(8, 8, 8, 1),
@@ -19,8 +19,8 @@ def _make(dense_ratio=0.5, itersnip=2):
     hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9, local_epochs=1,
                      steps_per_epoch=4, batch_size=8)
     return SalientGrads(
-        model, data, hp, loss_type="bce", frac=1.0, seed=0,
-        dense_ratio=dense_ratio, itersnip_iterations=itersnip,
+        model, data, hp, loss_type="bce", frac=frac, seed=0,
+        dense_ratio=dense_ratio, itersnip_iterations=itersnip, **kw,
     )
 
 
@@ -45,6 +45,138 @@ def test_masked_training_stays_sparse_and_learns():
     ):
         if k:
             assert np.allclose(np.asarray(p)[np.asarray(m) == 0], 0.0)
+
+
+def test_personal_models_track_trained_clients_only():
+    """w_per_mdls semantics (sailentgrads_api.py:107-110,133): personal
+    models start as dense copies of the initial global model; each round
+    only the TRAINED clients' entries are replaced with their masked local
+    weights; unsampled clients keep their previous personal model."""
+    from neuroimagedisttraining_tpu.algorithms.base import (
+        sample_client_indexes,
+    )
+
+    algo = _make(frac=0.5)
+    state0 = algo.init_state(jax.random.PRNGKey(0))
+    state, _ = algo.run_round(state0, 0)
+    trained = set(sample_client_indexes(0, 8, 4).tolist())
+    flags = kernel_flags(state.global_params)
+    for c in range(8):
+        pers_c = jax.tree_util.tree_map(
+            lambda p: np.asarray(p[c]), state.personal_params)
+        init_c = jax.tree_util.tree_map(
+            lambda p: np.asarray(p[c]), state0.personal_params)
+        if c in trained:
+            # trained entries are the masked local weights: zero where the
+            # global mask is zero, and different from the init
+            assert any(
+                not np.array_equal(a, b)
+                for a, b in zip(jax.tree_util.tree_leaves(pers_c),
+                                jax.tree_util.tree_leaves(init_c)))
+            for p, m, k in zip(
+                jax.tree_util.tree_leaves(pers_c),
+                jax.tree_util.tree_leaves(state.mask),
+                jax.tree_util.tree_leaves(flags),
+            ):
+                if k:
+                    assert np.allclose(p[np.asarray(m) == 0], 0.0)
+        else:
+            # unsampled: bitwise-unchanged (and dense — init is unmasked,
+            # the reference's init-time mask multiply is commented out)
+            for a, b in zip(jax.tree_util.tree_leaves(pers_c),
+                            jax.tree_util.tree_leaves(init_c)):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_personal_eval_emitted_and_final_eval_record():
+    """The per-round eval protocol reports BOTH halves (person_test_acc,
+    sailentgrads_api.py:238,276-283) plus one final round=-1 eval after
+    the loop (:147)."""
+    algo = _make(frac=0.5)
+    state, hist = algo.run(comm_rounds=3, eval_every=1)
+    ev = algo.evaluate(state)
+    assert "personal_acc" in ev and "global_acc" in ev
+    assert 0.0 <= float(ev["personal_acc"]) <= 1.0
+    per_round = [h for h in hist if h["round"] >= 0]
+    assert all("personal_acc" in h for h in per_round)
+    final = [h for h in hist if h["round"] == -1]
+    assert len(final) == 1 and "personal_acc" in final[0]
+    # the final record is a pure re-eval of the last state (no fine-tune)
+    assert float(final[0]["global_acc"]) == float(per_round[-1]["global_acc"])
+
+
+def test_track_personal_opt_out():
+    algo = _make(track_personal=False)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    assert state.personal_params is None
+    state, _ = algo.run_round(state, 0)
+    ev = algo.evaluate(state)
+    assert "personal_acc" not in ev and "global_acc" in ev
+
+
+def test_pre_r5_lineage_resumes_personal_less(tmp_path):
+    """A pre-round-5 salientgrads checkpoint lineage holds 3-field states
+    (no personal stack) under the DEFAULT identity. A defaulted resume
+    must adapt to the lineage's personal-less protocol (warning, not a
+    structure-mismatch crash); an explicit --track_personal 1 resume is
+    refused with guidance."""
+    import pytest
+
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+    from neuroimagedisttraining_tpu.experiments.config import run_identity
+
+    ckpt = str(tmp_path / "ckpt")
+
+    def argv(tag, *extra):
+        base = ["--model", "small3dcnn", "--dataset", "synthetic",
+                "--client_num_in_total", "4", "--batch_size", "8",
+                "--epochs", "1", "--comm_round", "4", "--lr", "0.05",
+                "--log_dir", str(tmp_path / f"LOG{tag}"),
+                "--results_dir", "", "--checkpoint_dir", ckpt]
+        return base + list(extra)
+
+    # simulate the old lineage: run a real personal-less 2-round lineage
+    # (lands under the 'nopers' identity), then rename it to the DEFAULT
+    # identity and strip the sidecar's track_personal entry — exactly the
+    # on-disk layout a pre-round-5 run left behind
+    import glob
+    import json
+    import os
+    import shutil
+
+    run_experiment(
+        parse_args(argv("0", "--track_personal", "0", "--comm_round", "2"),
+                   algo="salientgrads"), "salientgrads")
+    args_old = parse_args(argv("0", "--track_personal", "0"),
+                          algo="salientgrads")
+    args_def = parse_args(argv("0"), algo="salientgrads")
+    old_dir = os.path.join(
+        ckpt, run_identity(args_old, "salientgrads", for_checkpoint=True))
+    def_dir = os.path.join(
+        ckpt, run_identity(args_def, "salientgrads", for_checkpoint=True))
+    assert old_dir != def_dir and os.path.isdir(old_dir)
+    shutil.move(old_dir, def_dir)
+    for p in glob.glob(os.path.join(def_dir, "meta_*.json")):
+        with open(p) as f:
+            meta = json.load(f)
+        meta.pop("track_personal", None)
+        with open(p, "w") as f:
+            json.dump(meta, f)
+
+    out = run_experiment(
+        parse_args(argv("r") + ["--resume"], algo="salientgrads"),
+        "salientgrads")
+    hist = [h for h in out["history"] if h["round"] >= 0]
+    assert [h["round"] for h in hist] == [2, 3]
+    assert all("personal_acc" not in h for h in hist)
+
+    with pytest.raises(SystemExit, match="track_personal"):
+        run_experiment(
+            parse_args(argv("x") + ["--resume", "--track_personal", "1"],
+                       algo="salientgrads"), "salientgrads")
 
 
 def test_mask_is_global_not_per_client():
