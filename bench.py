@@ -39,7 +39,7 @@ MODEL_KEY = "3dcnn_s2d"  # tests override with a CI-scale model
 
 
 def _device_synth_data(n_clients, n, shape, key, uneven=False,
-                       model_key=None):
+                       model_key=None, test_per_client=None):
     """Generate the federated dataset directly on device (HBM-resident).
 
     ``model_key`` picks the stored sample shape (phased for the s2d
@@ -51,13 +51,19 @@ def _device_synth_data(n_clients, n, shape, key, uneven=False,
     ``uneven=True`` draws per-client counts in [n/2, n] (deterministic) so
     ``_full_batches()`` is False and the masked-epoch machinery — per-
     example batch weights + no-op step selects, what real uneven ABCD
-    cohorts exercise — is actually priced (ADVICE r3)."""
+    cohorts exercise — is actually priced (ADVICE r3).
+
+    ``test_per_client`` (default n//4): HBM control for big cohorts. The
+    whole construction runs as ONE jitted program so the signal-planting
+    add never materializes a second cohort-sized buffer — at C=32 the
+    padded train cohort alone is ~11.7 GB of the v5e's 15.75 GB (the
+    (…,8,61) phased tail lane-pads 61->128, ~2.1x), so a top-level
+    two-step build OOMs before the first round."""
     from neuroimagedisttraining_tpu.data.types import FederatedData
     from neuroimagedisttraining_tpu.experiments.runner import S2D_SPECS
     from neuroimagedisttraining_tpu.ops.s2d import phased_sample_shape
 
     model_key = model_key or MODEL_KEY
-    kx, ky = jax.random.split(key)
     # volumes live in the TPU-fast phase-decomposed layout (ops/s2d.py),
     # stored bf16 (the compute dtype — skips the per-step convert/relayout);
     # random phased tensors are distributionally the same workload
@@ -66,20 +72,38 @@ def _device_synth_data(n_clients, n, shape, key, uneven=False,
         sshape = phased_sample_shape(shape, kernel=spec[0], pad=spec[1])
     else:
         sshape = tuple(shape) + (1,)
-    x = jax.random.normal(kx, (n_clients, n) + sshape, jnp.bfloat16)
-    y = jax.random.bernoulli(ky, 0.5, (n_clients, n)).astype(jnp.int32)
-    # plant a mean-shift signal so losses stay in a realistic regime
-    x = x + 0.75 * (y[..., None, None, None, None].astype(x.dtype) * 2 - 1)
+    m = test_per_client or max(4, n // 4)
+
+    def build(k):
+        kx, ky, ktx, kty = jax.random.split(k, 4)
+
+        def planted(kk_x, kk_y, rows):
+            y = jax.random.bernoulli(
+                kk_y, 0.5, (n_clients, rows)).astype(jnp.int32)
+            x = jax.random.normal(
+                kk_x, (n_clients, rows) + sshape, jnp.bfloat16)
+            # plant a mean-shift signal so losses stay realistic
+            shift = y[(...,) + (None,) * len(sshape)].astype(x.dtype)
+            return x + 0.75 * (shift * 2 - 1), y
+
+        x, y = planted(kx, ky, n)
+        # independent test draw (same planted distribution) instead of a
+        # slice-copy of train rows: a slice would briefly hold train +
+        # test + slice temp, and cannot be smaller than n//4 rows without
+        # changing the train cohort
+        xt, yt = planted(ktx, kty, m)
+        return x, y, xt, yt
+
+    x, y, xt, yt = jax.jit(build)(key)
     if uneven:
         counts = jnp.asarray(
             np.random.RandomState(0).randint(n // 2, n + 1, n_clients),
             jnp.int32)
     else:
         counts = jnp.full((n_clients,), n, jnp.int32)
-    m = max(4, n // 4)
     return FederatedData(
         x_train=x, y_train=y, n_train=counts,
-        x_test=x[:, :m], y_test=y[:, :m],
+        x_test=xt, y_test=yt,
         n_test=jnp.full((n_clients,), m, jnp.int32),
         class_num=2,
     )
@@ -98,7 +122,13 @@ def _timed_rounds(algo, state, n_rounds=10, eval_every_round=False):
     ``eval_every_round`` also runs the full per-round eval protocol inside
     the timed region (frequency_of_the_test=1 — the reference evaluates
     every round by default, sailentgrads_api.py:141-143), so the returned
-    rate prices the O(clients) eval cost instead of footnoting it.
+    rate prices the O(clients) eval cost instead of footnoting it. Since
+    r5 that protocol includes BOTH halves of the reference's
+    _test_on_all_clients: the global model on every client's local test
+    set AND every client's personal model on its own test set
+    (sailentgrads_api.py:238,262-283) — the personal half carries
+    per-client weights, so it cannot use the 80-wide shared-params
+    batching the global half gets.
 
     Metric fetches are delayed ONE round (the r4 eval-path fix, mirrored
     in FedAlgorithm.run): the eval's device cost is ~21 ms but a blocking
@@ -154,14 +184,14 @@ def _timed_rounds_fused(algo, state, n_rounds=10, eval_every=0):
     return n_rounds / (time.perf_counter() - t0)
 
 
-def main(uneven: bool = False):
+def main(uneven: bool = False, test_per_client: int = None):
     from neuroimagedisttraining_tpu.algorithms import SalientGrads
     from neuroimagedisttraining_tpu.core.state import HyperParams
     from neuroimagedisttraining_tpu.models import create_model
 
     data = _device_synth_data(
         N_CLIENTS, SAMPLES_PER_CLIENT, VOLUME, jax.random.PRNGKey(0),
-        uneven=uneven,
+        uneven=uneven, test_per_client=test_per_client,
     )
     model = create_model(MODEL_KEY, num_classes=1)
     import os
@@ -216,41 +246,84 @@ def main(uneven: bool = False):
                         itersnip_iterations=1, compute_dtype="bfloat16",
                         remat_local=remat, fused_kernels=fused)
     state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
+    def _try_fused(a, s, **kw):
+        """Fused-spelling timing, or None when the K-round program does
+        not fit: at C=32 full volume XLA materializes an extra full-
+        cohort copy for the scan's while loop (the unfused per-round
+        program does not), so the fused spelling OOMs exactly when the
+        cohort fills HBM — fall back to the loop numbers and record the
+        gap."""
+        try:
+            return _timed_rounds_fused(a, s, **kw)
+        except jax.errors.JaxRuntimeError as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) and \
+                    "Ran out of memory" not in str(e):
+                raise
+            print("# fused spelling OOMs at this scale; loop numbers only",
+                  flush=True)
+            return None
+
     rps_loop = _timed_rounds(algo, state)
     # eval-inclusive rate: the same workload at frequency_of_the_test=1
-    # (global model tested on every client's local test set each round)
+    # — since r5 this prices the FULL reference protocol (global +
+    # per-client personal models, sailentgrads_api.py:262-283)
     rps_with_eval_loop = _timed_rounds(algo, state, n_rounds=8,
                                        eval_every_round=True)
     # fused round loop (run_rounds_fused): K rounds as one program —
     # semantically identical (tests/test_fused_rounds.py), dispatch/fetch
     # amortized. The headline is the better of the two spellings; both
     # are recorded.
-    rps_fused = _timed_rounds_fused(algo, state, n_rounds=10)
-    rps_with_eval_fused = _timed_rounds_fused(algo, state, n_rounds=8,
-                                              eval_every=1)
-    rounds_per_sec = max(rps_loop, rps_fused)
-    rps_with_eval = max(rps_with_eval_loop, rps_with_eval_fused)
+    rps_fused = _try_fused(algo, state, n_rounds=10)
+    rps_with_eval_fused = _try_fused(algo, state, n_rounds=8, eval_every=1)
+    # secondary: the global-only half (what r3/r4 benches priced) — a
+    # personal-less instance isolates the personal half's cost
+    algo_g = SalientGrads(model, data, hp, loss_type="bce", frac=1.0,
+                          seed=0, client_chunk=chunk, dense_ratio=0.5,
+                          itersnip_iterations=1, compute_dtype="bfloat16",
+                          remat_local=remat, fused_kernels=fused,
+                          track_personal=False)
+    state_g = algo_g.init_state(jax.random.PRNGKey(0))
+    # best-of-both-spellings, SAME selection rule as the full-protocol
+    # number — mixing spellings would corrupt the personal-half delta
+    # these two numbers exist to isolate
+    rps_g_fused = _try_fused(algo_g, state_g, n_rounds=8, eval_every=1)
+    rps_g_loop = _timed_rounds(algo_g, state_g, n_rounds=8,
+                               eval_every_round=True)
+    rps_eval_global_only = max(
+        x for x in (rps_g_loop, rps_g_fused) if x is not None)
+    rounds_per_sec = max(x for x in (rps_loop, rps_fused) if x is not None)
+    rps_with_eval = max(x for x in (rps_with_eval_loop, rps_with_eval_fused)
+                        if x is not None)
     samples_per_round = N_CLIENTS * STEPS * BATCH
     n_chips = len(jax.devices())
     # target basis: 10 rounds/sec x 32 clients / 32 chips (v4-32 north
     # star) = 10 client-rounds/sec/chip; see module docstring
     client_rounds_per_sec_per_chip = rounds_per_sec * N_CLIENTS / n_chips
     result = {
-        "metric": ("salientgrads_rounds_per_sec_abcd_alexnet3d_8clients"
-                   if MODEL_KEY == "3dcnn_s2d" else
-                   f"salientgrads_rounds_per_sec_abcd_{MODEL_KEY}_8clients")
+        "metric": (
+            f"salientgrads_rounds_per_sec_abcd_alexnet3d_{N_CLIENTS}clients"
+            if MODEL_KEY == "3dcnn_s2d" else
+            f"salientgrads_rounds_per_sec_abcd_{MODEL_KEY}_"
+            f"{N_CLIENTS}clients")
         + ("_uneven" if uneven else ""),
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 4),
         "extra": {
+            # full reference eval protocol (global + personal halves)
             "rounds_per_sec_eval_every_1": round(rps_with_eval, 4),
+            # global-only eval (the r3/r4 definition), kept as secondary
+            "rounds_per_sec_eval_every_1_global_only": round(
+                rps_eval_global_only, 4),
             "rounds_per_sec_python_loop": round(rps_loop, 4),
-            "rounds_per_sec_fused": round(rps_fused, 4),
+            # None = the fused spelling OOMs at this scale (see _try_fused)
+            "rounds_per_sec_fused": (
+                round(rps_fused, 4) if rps_fused is not None else None),
             "rounds_per_sec_eval_every_1_python_loop": round(
                 rps_with_eval_loop, 4),
-            "rounds_per_sec_eval_every_1_fused": round(
-                rps_with_eval_fused, 4),
+            "rounds_per_sec_eval_every_1_fused": (
+                round(rps_with_eval_fused, 4)
+                if rps_with_eval_fused is not None else None),
             "client_samples_per_sec": round(rounds_per_sec * samples_per_round, 2),
             "client_rounds_per_sec_per_chip": round(
                 client_rounds_per_sec_per_chip, 2),
@@ -372,6 +445,17 @@ def tracked_config(name: str):
         }
         print(json.dumps(result))
         return result
+    if name == "clients32":
+        # the primary workload at the NORTH-STAR client count (C=32) on
+        # the one real chip (VERDICT r4 weak #4): measures the scan-length
+        # and cohort-residency scaling directly instead of assuming
+        # linearity from the 8-client cell. The padded train cohort is
+        # ~11.7 GB of 15.75 GB HBM, so the test split shrinks to
+        # 4 volumes/client (eval-inclusive extras are therefore NOT
+        # comparable to the 8-client cell's 10-volume test shards; the
+        # primary eval-free rate is the tracked number).
+        N_CLIENTS = 32
+        return main(test_per_client=4)
     if name == "uneven":
         # primary workload with uneven shards ([20,40] samples/client): the
         # masked epoch path — per-example weights, no-op step selects —

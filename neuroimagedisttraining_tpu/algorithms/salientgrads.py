@@ -60,6 +60,7 @@ class SalientGrads(FedAlgorithm):
                  itersnip_iterations: int = 1, defense=None,
                  fused_kernels: bool = False, snip_mask: bool = True,
                  stratified_sampling: bool = False,
+                 stratified_mode: str = "exact",
                  track_personal: bool = True, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
@@ -69,9 +70,19 @@ class SalientGrads(FedAlgorithm):
         # --snip_mask 0: all-ones mask, the reference's dense-control mode
         # (sailentgrads_api.py:91-103)
         self.snip_mask = snip_mask
-        # --stratified_sampling: exact 25-fold stratified scoring
-        # (client.py:32-42; see ops/sparsity docstring)
+        # --stratified_sampling: per-class-balanced SNIP scoring.
+        # stratified_mode="exact" (default) replays the reference's
+        # StratifiedKFold(25, shuffle, seed 42) schedule, scoring each
+        # split's TRAIN side (client.py:32-42) via a host-computed
+        # pad+mask index schedule; "balanced" is the fast path — 25
+        # class-balanced random batch draws (documented approximation,
+        # see ops/sparsity.make_snip_score_fn).
         self.stratified_sampling = stratified_sampling
+        if stratified_mode not in ("exact", "balanced"):
+            raise ValueError(
+                f"stratified_mode {stratified_mode!r} not in "
+                "('exact', 'balanced')")
+        self.stratified_mode = stratified_mode
         # track_personal=False drops the on-device w_per_mdls stack and the
         # personal half of the per-round eval — O(C x model) HBM
         self.track_personal = track_personal
@@ -86,28 +97,55 @@ class SalientGrads(FedAlgorithm):
             full_batches=self._full_batches(),
             augment_fn=self.augment_fn,
         )
-        self.snip_scores = make_snip_score_fn(
-            self.apply_fn, self.loss_type, self.hp.batch_size,
-            stratified=self.stratified_sampling,
-            num_classes=self.data.class_num,
-            augment_fn=self.augment_fn,
-        )
+        self._fold_sched = None
+        if self.snip_mask and self.stratified_sampling and \
+                self.stratified_mode == "exact":
+            # the reference's exact StratifiedKFold(25, shuffle, seed 42)
+            # schedule, computed host-side per client (labels are tiny;
+            # multihost cohorts should use stratified_mode="balanced" —
+            # the schedule needs every client's labels on every host)
+            import numpy as np
+
+            from ..ops.sparsity import (
+                make_snip_fold_score_fn,
+                stacked_fold_schedules,
+            )
+
+            idx, w = stacked_fold_schedules(
+                np.asarray(self.data.y_train),
+                np.asarray(self.data.n_train))
+            self._fold_sched = (jnp.asarray(idx), jnp.asarray(w))
+            self.snip_fold_scores = make_snip_fold_score_fn(
+                self.apply_fn, self.loss_type, augment_fn=self.augment_fn)
+        else:
+            self.snip_scores = make_snip_score_fn(
+                self.apply_fn, self.loss_type, self.hp.batch_size,
+                stratified=self.stratified_sampling,
+                num_classes=self.data.class_num,
+                augment_fn=self.augment_fn,
+            )
 
         def global_mask_fn(params, x_train, y_train, n_train, rng):
             """All clients score their own shards; mean; global top-k."""
             c = x_train.shape[0]
             keys = jax.random.split(rng, c)
             params_b = broadcast_tree(params, c)
-            # stratified mode scores over 25 balanced batches (the
-            # reference's StratifiedKFold(n_splits=25), client.py:36)
-            n_iters = 25 if self.stratified_sampling \
-                else self.itersnip_iterations
-            scores = self._vmap_clients(
-                lambda p, x, y, n, k: self.snip_scores(
-                    p, x, y, n, k, n_iters
-                ),
-                in_axes=(0, 0, 0, 0, 0),
-            )(params_b, x_train, y_train, n_train, keys)
+            if self._fold_sched is not None:
+                idx, w = self._fold_sched
+                scores = self._vmap_clients(
+                    self.snip_fold_scores, in_axes=(0, 0, 0, 0, 0, 0),
+                )(params_b, x_train, y_train, idx, w, keys)
+            else:
+                # balanced mode scores over 25 balanced batches (the
+                # reference's n_splits=25, client.py:36)
+                n_iters = 25 if self.stratified_sampling \
+                    else self.itersnip_iterations
+                scores = self._vmap_clients(
+                    lambda p, x, y, n, k: self.snip_scores(
+                        p, x, y, n, k, n_iters
+                    ),
+                    in_axes=(0, 0, 0, 0, 0),
+                )(params_b, x_train, y_train, n_train, keys)
             # server-side mean over clients (snip.py:120-140)
             mean_scores = jax.tree_util.tree_map(
                 lambda s: jnp.mean(s, axis=0), scores

@@ -230,6 +230,13 @@ def add_algo_args(p: argparse.ArgumentParser, algo: str) -> None:
         _add_once(p, "--itersnip_iteration", type=int, default=1)
         _add_once(p, "--snip_mask", type=int, default=1)
         _add_once(p, "--stratified_sampling", type=int, default=0)
+        _add_once(p, "--stratified_mode", type=str, default="exact",
+                  choices=["exact", "balanced"],
+                  help="--stratified_sampling scoring schedule: exact = "
+                       "the reference's StratifiedKFold(25, shuffle, "
+                       "seed 42) train-side folds (sailentgrads/"
+                       "client.py:32-42); balanced = 25 class-balanced "
+                       "random draws (fast path)")
     elif algo in ("dispfl", "dpsgd"):
         # main_dispfl.py:93-108
         _add_once(p, "--cs", type=str, default="random",
@@ -350,6 +357,11 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
                          if isinstance(v, float) else f"{extra[:4]}{v}")
     # defense and fine-tune knobs change training behavior — they must
     # split checkpoint/log/stat_info lineages (unlike inert identity tags)
+    if algo == "salientgrads" and getattr(args, "stratified_sampling", 0):
+        # the scoring schedule changes the mask and hence all training —
+        # both stratified modes split from the itersnip default and from
+        # each other (exact = reference folds, balanced = random draws)
+        parts.append(f"strat-{getattr(args, 'stratified_mode', 'exact')}")
     if getattr(args, "defense_type", "none") != "none":
         parts.append(f"def{args.defense_type}")
         parts.append(f"nb{args.norm_bound:g}")

@@ -311,6 +311,8 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                      snip_mask=bool(getattr(args, "snip_mask", 1)),
                      stratified_sampling=bool(
                          getattr(args, "stratified_sampling", 0)),
+                     stratified_mode=getattr(args, "stratified_mode",
+                                             "exact"),
                      fused_kernels=bool(getattr(args, "fused_kernels", 0)),
                      track_personal=bool(
                          getattr(args, "track_personal", 1)))

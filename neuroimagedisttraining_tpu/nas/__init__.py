@@ -4,7 +4,13 @@ bilevel architect, genotype derivation, final-training model)."""
 from .architect import Architect, ArchitectState
 from .genotypes import DARTS, DARTS_V1, DARTS_V2, PRIMITIVES, Genotype
 from .visualize import cell_dot, genotype_dot, plot
-from .model import GenotypeCell, NetworkFromGenotype
+from .model import (
+    AuxiliaryHeadCIFAR,
+    AuxiliaryHeadImageNet,
+    GenotypeCell,
+    NetworkFromGenotype,
+    NetworkImageNetFromGenotype,
+)
 from .supernet import (
     GumbelSearchNetwork,
     SearchNetwork,
@@ -20,11 +26,14 @@ __all__ = [
     "plot",
     "Architect",
     "ArchitectState",
+    "AuxiliaryHeadCIFAR",
+    "AuxiliaryHeadImageNet",
     "DARTS",
     "DARTS_V1",
     "DARTS_V2",
     "Genotype",
     "GenotypeCell",
+    "NetworkImageNetFromGenotype",
     "GumbelSearchNetwork",
     "NetworkFromGenotype",
     "PRIMITIVES",

@@ -87,6 +87,101 @@ class AuxiliaryHeadCIFAR(nn.Module):
         return nn.Dense(self.num_classes)(x.reshape(x.shape[0], -1))
 
 
+class AuxiliaryHeadImageNet(nn.Module):
+    """The ImageNet auxiliary classifier (``model.py:86-109``): relu →
+    avgpool(5, stride 2, no padding — VALID makes torch's
+    ``count_include_pad=False`` moot) → 1x1 conv to 128 → norm → relu →
+    2x2 conv to 768 → relu → linear. The reference deliberately OMITS the
+    second norm ("commented out for consistency with the experiments in
+    the paper", ``model.py:98-100``) — mirrored here. Fed the 2/3-depth
+    cell output (7x7 at 224 ImageNet scale → 2x2 after the pool → 1x1
+    after the 2x2 conv, so the flatten is exactly 768 wide)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(2, 2), padding="VALID")
+        x = nn.Conv(128, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=1)(x)
+        x = nn.relu(x)
+        x = nn.Conv(768, (2, 2), use_bias=False, padding="VALID")(x)
+        # no second norm (reference model.py:98-100)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x.reshape(x.shape[0], -1))
+
+
+class NetworkImageNetFromGenotype(nn.Module):
+    """NetworkImageNet equivalent (``model.py:161-247``): dual stride-2
+    stem (stem0: 3→C/2 s2 → C s2; stem1: one more s2, so cell 0 sees
+    56x56/28x28 features at 224 input and starts with
+    ``reduction_prev=True``), genotype cells with reductions at 1/3 and
+    2/3 depth, 7x7 average pool (the reference's fixed ``AvgPool2d(7)``,
+    not adaptive), linear classifier. ``auxiliary=True`` adds
+    :class:`AuxiliaryHeadImageNet` on the 2/3-depth cell's output in
+    train mode. Norms are GroupNorm(1) per the repo-wide BatchNorm
+    substitution; drop-path follows the CIFAR network's traced-prob
+    pattern."""
+
+    genotype: Genotype
+    C: int = 48
+    num_classes: int = 1000
+    layers: int = 14
+    drop_path_prob: float = 0.0
+    auxiliary: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False,
+                 rng: Optional[jax.Array] = None,
+                 drop_path_prob=None):
+        dpp = (self.drop_path_prob if drop_path_prob is None
+               else drop_path_prob)
+        dp_on = (self.drop_path_prob > 0 or drop_path_prob is not None)
+        # stem0 (model.py:167-173)
+        s = nn.Conv(self.C // 2, (3, 3), strides=(2, 2), padding=1,
+                    use_bias=False)(x)
+        s = nn.GroupNorm(num_groups=1)(s)
+        s = nn.relu(s)
+        s = nn.Conv(self.C, (3, 3), strides=(2, 2), padding=1,
+                    use_bias=False)(s)
+        s0 = nn.GroupNorm(num_groups=1)(s)
+        # stem1 (model.py:175-179)
+        s = nn.relu(s0)
+        s = nn.Conv(self.C, (3, 3), strides=(2, 2), padding=1,
+                    use_bias=False)(s)
+        s1 = nn.GroupNorm(num_groups=1)(s)
+
+        logits_aux = None
+        C_curr = self.C
+        reduction_prev = True  # stem1 halved the grid (model.py:183)
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = GenotypeCell(
+                genotype=self.genotype, C=C_curr,
+                reduction=reduction, reduction_prev=reduction_prev,
+            )
+            cell_rng = (jax.random.fold_in(rng, i)
+                        if rng is not None and dp_on else None)
+            s0, s1 = s1, cell(
+                s0, s1, train=train,
+                drop_path_rng=cell_rng, drop_path_prob=dpp)
+            reduction_prev = reduction
+            if self.auxiliary and i == 2 * self.layers // 3:
+                aux = AuxiliaryHeadImageNet(num_classes=self.num_classes)(s1)
+                logits_aux = aux if train else None
+
+        # fixed 7x7 average pool (model.py:242) — the torch model only
+        # works at grids the pool tiles exactly; mirror that contract
+        out = nn.avg_pool(s1, (7, 7), strides=(7, 7), padding="VALID")
+        logits = nn.Dense(self.num_classes)(out.reshape(out.shape[0], -1))
+        if self.auxiliary:
+            return logits, logits_aux
+        return logits
+
+
 class NetworkFromGenotype(nn.Module):
     """NetworkCIFAR equivalent: stem + genotype cells + GAP + classifier.
 

@@ -150,6 +150,115 @@ def make_snip_score_fn(apply_fn, loss_type: str, batch_size: int,
     return snip_scores
 
 
+def stratified_fold_schedule(y: np.ndarray, n_valid: int,
+                             n_splits: int = 25, seed: int = 42):
+    """Host-side exact replica of the reference's stratified scoring
+    schedule for ONE client (``sailentgrads/client.py:32-42``):
+    ``StratifiedKFold(n_splits, shuffle=True, random_state=seed)`` over
+    the client's labels, scoring each split on its TRAIN side — i.e.
+    each of the ``n_splits`` scoring batches is the ~(K-1)/K complement
+    of one fold, NOT the small fold itself.
+
+    Returns ``(idx, w)`` of shape [n_splits, L] where L = the largest
+    train-side size; rows are padded with index 0 / weight 0 so the
+    jitted scorer can consume a static shape (the weighted-mean loss
+    ignores padding exactly).
+    """
+    from sklearn.model_selection import StratifiedKFold
+
+    yv = np.asarray(y[:n_valid])
+    splitter = StratifiedKFold(n_splits=n_splits, shuffle=True,
+                               random_state=seed)
+    trains = [tr for tr, _ in splitter.split(np.zeros_like(yv), yv)]
+    L = max(len(t) for t in trains)
+    idx = np.zeros((n_splits, L), np.int32)
+    w = np.zeros((n_splits, L), np.float32)
+    for k, tr in enumerate(trains):
+        idx[k, :len(tr)] = tr
+        w[k, :len(tr)] = 1.0
+    return idx, w
+
+
+def stacked_fold_schedules(y_all: np.ndarray, n_all: np.ndarray,
+                           n_splits: int = 25, seed: int = 42):
+    """Per-client fold schedules stacked along a leading client axis
+    ([C, n_splits, L] with one global L) for the vmapped scoring pass.
+    Raises the same sklearn error the reference hits when a client has
+    fewer than ``n_splits`` members of some class."""
+    per = []
+    for c in range(y_all.shape[0]):
+        try:
+            per.append(stratified_fold_schedule(
+                y_all[c], int(n_all[c]), n_splits=n_splits, seed=seed))
+        except ValueError as e:
+            # same constraint the reference hits (n_splits=25 hard-coded,
+            # client.py:36) — surface which client and the escape hatch
+            raise ValueError(
+                f"exact stratified SNIP needs >= {n_splits} samples of "
+                f"every class on every client; client {c} is too small "
+                f"({e}). Use stratified_mode='balanced' "
+                "(--stratified_mode balanced) for small shards.") from e
+    L = max(i.shape[1] for i, _ in per)
+
+    def pad(a, fill):
+        out = np.full((a.shape[0], L), fill, a.dtype)
+        out[:, :a.shape[1]] = a
+        return out
+
+    idx = np.stack([pad(i, 0) for i, _ in per])
+    w = np.stack([pad(wt, 0.0) for _, wt in per])
+    return idx, w
+
+
+def make_snip_fold_score_fn(apply_fn, loss_type: str, augment_fn=None):
+    """Exact-fold SNIP scorer: ``fold_scores(params, x, y, fold_idx,
+    fold_w, rng)`` scans the [S, L] schedule from
+    :func:`stratified_fold_schedule`, computing |dL/dmask| of the
+    weight-masked loss ``sum(w * per_example_loss) / sum(w)`` per fold
+    batch (padding rows carry w=0, so they contribute exactly nothing)
+    and returns the mean score pytree over folds — the reference's
+    ``get_mean_sailency_scores`` over the 25 fold scores
+    (``client.py:44,49``). Augmentation applies per fold batch like the
+    reference's transform-bearing dataset indexing (``client.py:38-40``).
+    """
+    from ..core.losses import PER_EXAMPLE_LOSSES
+
+    per_ex = PER_EXAMPLE_LOSSES[loss_type]
+
+    def fold_scores(params, x, y, fold_idx, fold_w, rng):
+        flags = kernel_flags(params)
+
+        def body(carry, xs):
+            idx, w, key = xs
+            k_aug, k_drop = jax.random.split(key)
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            if augment_fn is not None:
+                xb = augment_fn(k_aug, xb)
+
+            def loss_of_mask(m):
+                masked = jax.tree_util.tree_map(
+                    lambda p, mm, k: p * mm if k else p, params, m, flags
+                )
+                logits = apply_fn(masked, xb, train=True, rng=k_drop)
+                losses = per_ex(logits, yb)
+                return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+            grads = jax.grad(loss_of_mask)(ones_like_tree(params))
+            s = jax.tree_util.tree_map(
+                lambda g, k: jnp.abs(g) if k else jnp.zeros_like(g),
+                grads, flags)
+            return jax.tree_util.tree_map(jnp.add, carry, s), None
+
+        n_splits = fold_idx.shape[0]
+        keys = jax.random.split(rng, n_splits)
+        total, _ = jax.lax.scan(
+            body, zeros_like_tree(params), (fold_idx, fold_w, keys))
+        return jax.tree_util.tree_map(lambda t: t / n_splits, total)
+
+    return fold_scores
+
+
 def mask_from_scores(scores: Any, keep_ratio: float) -> Any:
     """Global top-k binary mask from a (mean) score pytree.
 

@@ -257,3 +257,118 @@ def test_dispfl_random_regrow_mode():
                     jax.tree_util.tree_leaves(after)):
         np.testing.assert_array_equal(b, a)
     assert np.isfinite(rec["train_loss"])
+
+
+def test_stratified_fold_schedule_matches_sklearn():
+    """The exact-mode schedule must be sklearn's StratifiedKFold(25,
+    shuffle, seed 42) train sides (sailentgrads/client.py:36-38), row k =
+    split k, padded with weight-0 entries to the longest train side."""
+    from sklearn.model_selection import StratifiedKFold
+
+    from neuroimagedisttraining_tpu.ops.sparsity import (
+        stratified_fold_schedule,
+    )
+
+    rng = np.random.RandomState(7)
+    n = 103  # not divisible by 25 -> unequal folds -> padding exercised
+    y = rng.randint(0, 2, n + 5)  # trailing entries beyond n_valid ignored
+    idx, w = stratified_fold_schedule(y, n, n_splits=25, seed=42)
+    ref = [tr for tr, _ in StratifiedKFold(
+        n_splits=25, shuffle=True, random_state=42
+    ).split(np.zeros(n), y[:n])]
+    assert idx.shape == w.shape == (25, max(len(t) for t in ref))
+    for k, tr in enumerate(ref):
+        np.testing.assert_array_equal(idx[k, :len(tr)], tr)
+        assert w[k, :len(tr)].all() and not w[k, len(tr):].any()
+        assert (idx[k, len(tr):] == 0).all()  # padding points at sample 0
+
+
+def test_fold_scores_padding_is_exact():
+    """Scoring through the padded static-shape schedule must equal the
+    unpadded per-fold computation bit-for-bit in semantics (weighted-mean
+    loss with w=0 padding == plain mean over the real fold batch)."""
+    from neuroimagedisttraining_tpu.core.losses import PER_EXAMPLE_LOSSES
+    from neuroimagedisttraining_tpu.models import make_apply_fn
+    from neuroimagedisttraining_tpu.ops.sparsity import (
+        make_snip_fold_score_fn,
+        stratified_fold_schedule,
+    )
+
+    model = create_model("small3dcnn", num_classes=1)
+    params = init_params(model, jax.random.PRNGKey(0), (8, 8, 8, 1))
+    apply_fn = make_apply_fn(model)
+    n, n_splits = 23, 5  # 23 % 5 != 0 -> padded rows
+    x = jax.random.normal(jax.random.PRNGKey(1), (n, 8, 8, 8, 1))
+    y = jnp.asarray(np.random.RandomState(3).randint(0, 2, n))
+    idx, w = stratified_fold_schedule(np.asarray(y), n,
+                                      n_splits=n_splits, seed=42)
+    assert (w == 0).any()  # padding actually present
+    rng = jax.random.PRNGKey(9)
+    scorer = make_snip_fold_score_fn(apply_fn, "bce")
+    got = scorer(params, x, y, jnp.asarray(idx), jnp.asarray(w), rng)
+
+    # manual unpadded reference with the same per-fold rng keys
+    per_ex = PER_EXAMPLE_LOSSES["bce"]
+    flags = kernel_flags(params)
+    keys = jax.random.split(rng, n_splits)
+    acc = None
+    for k in range(n_splits):
+        real = idx[k][w[k] > 0]
+        _, k_drop = jax.random.split(keys[k])
+        xb, yb = x[real], y[real]
+
+        def loss_of_mask(m):
+            masked = jax.tree_util.tree_map(
+                lambda p, mm, kk: p * mm if kk else p, params, m, flags)
+            return jnp.mean(per_ex(
+                apply_fn(masked, xb, train=True, rng=k_drop), yb))
+
+        g = jax.grad(loss_of_mask)(
+            jax.tree_util.tree_map(jnp.ones_like, params))
+        s = jax.tree_util.tree_map(
+            lambda gg, kk: jnp.abs(gg) if kk else jnp.zeros_like(gg),
+            g, flags)
+        acc = s if acc is None else jax.tree_util.tree_map(jnp.add, acc, s)
+    ref = jax.tree_util.tree_map(lambda t: t / n_splits, acc)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-7)
+
+
+def test_salientgrads_exact_vs_balanced_stratified_modes():
+    """Both stratified modes produce valid masks at the requested density;
+    exact mode is deterministic given (labels, seed 42) — two independent
+    inits agree bit-for-bit on the mask, the balanced mode's random draws
+    need not."""
+    from neuroimagedisttraining_tpu.algorithms import SalientGrads
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=60, test_per_client=8,
+        sample_shape=(8, 8, 8, 1),
+    )
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, momentum=0.9, local_epochs=1,
+                     steps_per_epoch=2, batch_size=8)
+
+    def mk(mode):
+        return SalientGrads(model, data, hp, loss_type="bce", frac=1.0,
+                            seed=0, dense_ratio=0.3,
+                            stratified_sampling=True, stratified_mode=mode)
+
+    me = mk("exact").init_state(jax.random.PRNGKey(0)).mask
+    mb = mk("balanced").init_state(jax.random.PRNGKey(0)).mask
+    assert abs(float(mask_density(me)) - 0.3) < 0.03
+    assert abs(float(mask_density(mb)) - 0.3) < 0.03
+    # A/B: the two modes select overlapping but not identical masks
+    flat_e = np.concatenate([np.asarray(m).ravel() for m, k in zip(
+        jax.tree_util.tree_leaves(me),
+        jax.tree_util.tree_leaves(kernel_flags(me))) if k])
+    flat_b = np.concatenate([np.asarray(m).ravel() for m, k in zip(
+        jax.tree_util.tree_leaves(mb),
+        jax.tree_util.tree_leaves(kernel_flags(mb))) if k])
+    inter = np.sum((flat_e > 0) & (flat_b > 0))
+    union = np.sum((flat_e > 0) | (flat_b > 0))
+    assert 0.3 < inter / union <= 1.0

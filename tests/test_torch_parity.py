@@ -104,3 +104,89 @@ def test_alexnet3d_feature_extents_match_torch_chain():
     assert tuple(out.shape) == (1, ref_shape[2], ref_shape[3], ref_shape[4],
                                 ref_shape[1])
     assert int(np.prod(out.shape[1:])) == 256  # the reference Linear width
+
+
+def test_stratified_snip_fold_scores_match_torch_reference():
+    """Exact-mode stratified SNIP (ops/sparsity.make_snip_fold_score_fn)
+    vs an independent torch replication of the reference procedure
+    (sailentgrads/client.py:32-44 + snip.py:21-74): same weights, same
+    sklearn StratifiedKFold(seed 42) train-side fold batches, per-fold
+    |dL/dmask| (= |w * dL/dw|) of the mean BCE loss, mean over folds,
+    global top-k mask — scores match to float tolerance, masks exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops.sparsity import (
+        make_snip_fold_score_fn,
+        mask_from_scores,
+        stratified_fold_schedule,
+    )
+
+    rng = np.random.RandomState(0)
+    n, d, h = 50, 24, 16
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.array([0, 1] * (n // 2))
+    w1 = (rng.randn(d, h) * 0.3).astype(np.float32)
+    b1 = (rng.randn(h) * 0.1).astype(np.float32)
+    w2 = (rng.randn(h, 1) * 0.3).astype(np.float32)
+    b2 = (rng.randn(1) * 0.1).astype(np.float32)
+    n_splits = 25  # exactly 25 members per class: the reference minimum
+
+    # jax side: params named like flax Dense so kernel_flags fires
+    params = {"Dense_0": {"kernel": jnp.asarray(w1), "bias": jnp.asarray(b1)},
+              "Dense_1": {"kernel": jnp.asarray(w2), "bias": jnp.asarray(b2)}}
+
+    def apply_fn(p, xb, train=False, rng=None):
+        z = jnp.maximum(xb @ p["Dense_0"]["kernel"] + p["Dense_0"]["bias"],
+                        0.0)
+        return z @ p["Dense_1"]["kernel"] + p["Dense_1"]["bias"]
+
+    idx, fw = stratified_fold_schedule(y, n, n_splits=n_splits, seed=42)
+    scorer = make_snip_fold_score_fn(apply_fn, "bce")
+    scores = scorer(params, jnp.asarray(x), jnp.asarray(y),
+                    jnp.asarray(idx), jnp.asarray(fw), jax.random.PRNGKey(0))
+
+    # torch side: independent replication of the reference procedure
+    lin1 = torch.nn.Linear(d, h)
+    lin2 = torch.nn.Linear(h, 1)
+    with torch.no_grad():
+        lin1.weight.copy_(torch.from_numpy(w1.T))
+        lin1.bias.copy_(torch.from_numpy(b1))
+        lin2.weight.copy_(torch.from_numpy(w2.T))
+        lin2.bias.copy_(torch.from_numpy(b2))
+    from sklearn.model_selection import StratifiedKFold
+
+    acc1 = torch.zeros_like(lin1.weight)
+    acc2 = torch.zeros_like(lin2.weight)
+    folds = list(StratifiedKFold(n_splits=n_splits, shuffle=True,
+                                 random_state=42).split(x, y))
+    for tr, _ in folds:
+        xb = torch.from_numpy(x[tr])
+        yb = torch.from_numpy(y[tr].astype(np.float32))
+        lin1.zero_grad(set_to_none=True)
+        lin2.zero_grad(set_to_none=True)
+        logits = lin2(torch.relu(lin1(xb)))[:, 0]
+        loss = torch.nn.functional.binary_cross_entropy_with_logits(
+            logits, yb)
+        loss.backward()
+        acc1 += (lin1.weight * lin1.weight.grad).abs()
+        acc2 += (lin2.weight * lin2.weight.grad).abs()
+    ref1 = (acc1 / n_splits).detach().numpy().T  # torch (out,in) -> (in,out)
+    ref2 = (acc2 / n_splits).detach().numpy().T
+
+    np.testing.assert_allclose(np.asarray(scores["Dense_0"]["kernel"]),
+                               ref1, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(scores["Dense_1"]["kernel"]),
+                               ref2, rtol=1e-4, atol=1e-7)
+
+    # masks: reference top-k rule on the torch scores vs ours
+    mask = mask_from_scores(scores, 0.4)
+    flat = np.concatenate([ref1.ravel(), ref2.ravel()])
+    keep = max(1, int(flat.size * 0.4))
+    thresh = np.sort(flat)[::-1][keep - 1]
+    ref_mask1 = (ref1 >= thresh).astype(np.float32)
+    ref_mask2 = (ref2 >= thresh).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(mask["Dense_0"]["kernel"]), ref_mask1)
+    np.testing.assert_array_equal(
+        np.asarray(mask["Dense_1"]["kernel"]), ref_mask2)
