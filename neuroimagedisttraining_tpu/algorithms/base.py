@@ -26,6 +26,24 @@ from ..models import make_apply_fn
 logger = logging.getLogger(__name__)
 
 
+def _personal_metrics(correct, loss_sum, total):
+    """Per-client eval terms -> the personal-eval protocol metrics
+    (mean of per-client accuracies, total-weighted loss —
+    sailentgrads_api.py:271-283). The ONE definition all three personal
+    eval paths share (full, incremental merge, cache-only re-reduce):
+    the incremental cache's bitwise-identity contract rests on these
+    reductions being literally the same code."""
+    totals = jnp.maximum(total, 1)
+    acc = correct.astype(jnp.float32) / totals
+    return {
+        "acc_per_client": acc,
+        "acc": jnp.mean(acc),
+        "loss": jnp.sum(loss_sum) / jnp.maximum(jnp.sum(total), 1),
+        # raw per-client terms seed/refresh the incremental-eval cache
+        "correct": correct, "loss_sum": loss_sum, "total": total,
+    }
+
+
 def sample_client_indexes(
     round_idx: int, client_num_in_total: int, client_num_per_round: int
 ) -> np.ndarray:
@@ -157,6 +175,7 @@ class FedAlgorithm(abc.ABC):
             channel_inject=channel_inject)
         self.eval_client = make_eval_fn(self.apply_fn, loss_type, eval_batch)
         self._fused_cache: Dict[Any, Any] = {}  # (block, eval_every) -> jit
+        self._personal_cache_reset()
         self._build()
 
     # -- per-algorithm pieces -------------------------------------------------
@@ -426,6 +445,107 @@ class FedAlgorithm(abc.ABC):
 
         return eval_all
 
+    # -- incremental personal eval --------------------------------------------
+    # At frac<1 only the TRAINED clients' personal models change per round
+    # (w_per_mdls semantics), so the per-round personal eval can reuse the
+    # previous per-client (correct, loss_sum, total) for unsampled clients
+    # and re-evaluate only the clients trained since the last eval —
+    # O(rounds_since_eval x clients_per_round) forwards instead of O(C).
+    # The cache lives OUTSIDE the algorithm State (not checkpointed, not in
+    # the fused scan carry): validity is guarded by object identity — the
+    # cache applies only to the exact personal_params object produced by
+    # this algorithm's own run_round chain, so evaluating any other state
+    # (a restored checkpoint, a saved earlier state, a finalize output)
+    # falls back to the full eval and reseeds. Accuracies are bitwise
+    # identical to the full eval (integer counts / totals over identical
+    # params); losses agree to f32 round-off — the subset-width eval
+    # program may reassociate a client's loss-sum reduction vs the
+    # full-width program (measured 1 ulp; the same tolerance the
+    # fused-vs-unfused eval gate carries). tests/test_cost_personal.py
+    # pins both.
+
+    def _personal_cache_reset(self) -> None:
+        self._pers_cache = None       # (correct[C], loss_sum[C], total[C])
+        self._pers_expected = None    # the personal_params object cached
+        self._pers_dirty: List[np.ndarray] = []  # sel draws since last eval
+
+    def _note_personal_update(self, old_pers, new_pers, sel_idx) -> None:
+        """Called by run_round after the round program is dispatched:
+        ``new_pers`` differs from ``old_pers`` only at ``sel_idx``."""
+        if old_pers is None or new_pers is None:
+            return
+        if self._eval_idx is not None:
+            # sampled-eval mode never uses the cache — don't accumulate
+            # an unbounded dirty list for a statically-disabled path
+            return
+        if self._pers_expected is not old_pers:
+            # unknown lineage (fresh state, resume, fused block):
+            # the next eval reseeds from a full pass
+            self._pers_cache = None
+            self._pers_dirty = []
+        self._pers_dirty.append(np.asarray(sel_idx))
+        self._pers_expected = new_pers
+
+    def _personal_eval_cached(self, pers, x_test, y_test, n_test):
+        """Personal-eval protocol result, incrementally when valid."""
+        if (self._pers_cache is None or pers is not self._pers_expected
+                or self._eval_idx is not None):
+            # full pass (also the sampled-eval mode — its subset indexing
+            # composes poorly with the per-client cache)
+            ev = self._eval_personal(pers, x_test, y_test, n_test)
+            if self._eval_idx is None:
+                self._pers_cache = (ev["correct"], ev["loss_sum"],
+                                    ev["total"])
+                self._pers_expected = pers
+                self._pers_dirty = []
+            return ev
+        dirty = np.concatenate(self._pers_dirty) if self._pers_dirty \
+            else np.zeros((0,), np.int32)
+        if dirty.size >= self.num_clients:
+            ev = self._eval_personal(pers, x_test, y_test, n_test)
+        elif dirty.size == 0:
+            # nothing changed since the last eval (e.g. the finalize
+            # re-eval): recompute the protocol means from the cached
+            # per-client terms — same [C]-shaped reductions, no forwards
+            if not hasattr(self, "_pers_metrics_fn"):
+                self._pers_metrics_fn = jax.jit(_personal_metrics)
+            ev = self._pers_metrics_fn(*self._pers_cache)
+        else:
+            if not hasattr(self, "_eval_personal_merge_fn"):
+                self._eval_personal_merge_fn = \
+                    self._make_personal_eval_merge()
+            ev = self._eval_personal_merge_fn(
+                pers, jnp.asarray(dirty.astype(np.int32)),
+                *self._pers_cache, x_test, y_test, n_test)
+        self._pers_cache = (ev["correct"], ev["loss_sum"], ev["total"])
+        self._pers_expected = pers
+        self._pers_dirty = []
+        return ev
+
+    def _make_personal_eval_merge(self):
+        """jit: evaluate ONLY the ``sel`` clients' personal models, merge
+        into the cached per-client arrays, return the protocol metrics
+        (identical reductions to ``_make_personal_eval``). Duplicate
+        entries in ``sel`` recompute identical values — harmless."""
+        eval_client = self.eval_client
+        vmapped = self._vmap_clients(eval_client, in_axes=(0, 0, 0, 0))
+
+        @jax.jit
+        def eval_merge(params_stack, sel, correct, loss_sum, total,
+                       x_test, y_test, n_test):
+            from ..core.state import tree_index
+
+            sub = tree_index(params_stack, sel)
+            c_s, l_s, t_s = vmapped(
+                sub, jnp.take(x_test, sel, axis=0),
+                jnp.take(y_test, sel, axis=0), jnp.take(n_test, sel))
+            correct = correct.at[sel].set(c_s)
+            loss_sum = loss_sum.at[sel].set(l_s)
+            total = total.at[sel].set(t_s)
+            return _personal_metrics(correct, loss_sum, total)
+
+        return eval_merge
+
     def _make_personal_eval(self):
         """Eval stacked per-client params, each on its own client's test
         set. Runs through ``_vmap_clients`` so ``client_chunk`` bounds the
@@ -450,13 +570,7 @@ class FedAlgorithm(abc.ABC):
             correct, loss_sum, total = vmapped(
                 params_stack, x_test, y_test, n_test
             )
-            totals = jnp.maximum(total, 1)
-            acc = correct.astype(jnp.float32) / totals
-            return {
-                "acc_per_client": acc,
-                "acc": jnp.mean(acc),
-                "loss": jnp.sum(loss_sum) / jnp.maximum(jnp.sum(total), 1),
-            }
+            return _personal_metrics(correct, loss_sum, total)
 
         return eval_personal
 

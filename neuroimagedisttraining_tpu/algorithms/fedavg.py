@@ -120,11 +120,15 @@ class FedAvg(FedAlgorithm):
         sel = sample_client_indexes(
             round_idx, self.num_clients, self.clients_per_round
         )
-        state, loss = self._round_jit(
+        new_state, loss = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
         )
-        return state, {"train_loss": loss}
+        # only the trained clients' personal models changed — feed the
+        # incremental personal-eval cache (base._personal_eval_cached)
+        self._note_personal_update(
+            state.personal_params, new_state.personal_params, sel)
+        return new_state, {"train_loss": loss}
 
     def finalize(self, state: FedAvgState):
         if not self.track_personal:
@@ -139,13 +143,26 @@ class FedAvg(FedAlgorithm):
                      if not k.startswith("acc_per")}}
         return state, record
 
-    def eval_metrics(self, state: FedAvgState, x_test, y_test,
-                     n_test) -> Dict[str, Any]:
+    def _eval_impl(self, state, x_test, y_test, n_test,
+                   personal_fn) -> Dict[str, Any]:
         ev = self._eval_global(state.global_params, x_test, y_test, n_test)
         out = {"global_acc": ev["acc"], "global_loss": ev["loss"],
                "acc_per_client": ev["acc_per_client"]}
         if state.personal_params is not None:
-            evp = self._eval_personal(
+            evp = personal_fn(
                 state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
         return out
+
+    def eval_metrics(self, state: FedAvgState, x_test, y_test,
+                     n_test) -> Dict[str, Any]:
+        # traceable (the fused scan's in-graph eval branch): full eval
+        return self._eval_impl(state, x_test, y_test, n_test,
+                               self._eval_personal)
+
+    def evaluate(self, state: FedAvgState) -> Dict[str, Any]:
+        # host path: the personal half re-evaluates only clients trained
+        # since the last eval (bitwise-identical; see base)
+        d = self.data
+        return self._eval_impl(state, d.x_test, d.y_test, d.n_test,
+                               self._personal_eval_cached)

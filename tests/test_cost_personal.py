@@ -159,3 +159,70 @@ def test_fedavg_track_personal_off():
     # finalize (the fine-tune that exists to build personal models) no-ops
     state2, final = algo.finalize(state)
     assert final is None
+
+
+def test_incremental_personal_eval_bitwise_equals_full():
+    """The incremental personal-eval cache (base._personal_eval_cached):
+    at frac<1 the per-round evaluate() re-evaluates only the clients
+    trained since the last eval — ACCURACIES must be bitwise identical
+    to a fresh full personal eval of the same state (integer counts /
+    totals), LOSSES to f32 round-off (the subset-width eval program may
+    reassociate a client's loss-sum reduction vs the full-width program
+    — measured 1 ulp; the same standard the fused-vs-unfused eval gate
+    uses). Covers cadence>1 accumulation with duplicate draws, finalize
+    (empty dirty), and stale-state (identity-miss) fallbacks."""
+
+    def close(a, b):
+        return abs(a - b) <= 4e-7 * max(1.0, abs(b))
+    import jax
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.algorithms import FedAvg, SalientGrads
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = make_synthetic_federated(
+        n_clients=8, samples_per_client=24, test_per_client=8,
+        sample_shape=(8, 8, 8, 1),
+    )
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9, local_epochs=1,
+                     steps_per_epoch=3, batch_size=8)
+
+    for cls, kw in ((SalientGrads, dict(dense_ratio=0.5,
+                                        itersnip_iterations=1)),
+                    (FedAvg, {})):
+        # frac 0.25 (2 of 8 clients/round): cadence-2 evals accumulate a
+        # 4-entry dirty list < C, so the MERGE path (not the >=C full-
+        # eval fallback) is what runs — and the seeded draws for rounds
+        # 1-4 overlap, so duplicate indices in the concatenated dirty are
+        # exercised too
+        algo = cls(model, data, hp, loss_type="bce", frac=0.25, seed=0,
+                   **kw)
+        state = algo.init_state(jax.random.PRNGKey(0))
+        states = []
+        for r in range(5):
+            state, _ = algo.run_round(state, r)
+            states.append(state)
+            if r % 2 == 0:  # cadence 2: accumulated multi-round dirty
+                ev = algo.evaluate(state)
+                full = algo._eval_personal(
+                    state.personal_params, data.x_test, data.y_test,
+                    data.n_test)
+                assert float(ev["personal_acc"]) == float(full["acc"]), \
+                    (cls.__name__, r)
+                assert close(float(ev["personal_loss"]),
+                             float(full["loss"])), (cls.__name__, r)
+        # empty-dirty path: immediate re-eval of the same state
+        ev2 = algo.evaluate(state)
+        full2 = algo._eval_personal(
+            state.personal_params, data.x_test, data.y_test, data.n_test)
+        assert float(ev2["personal_acc"]) == float(full2["acc"])
+        # stale state (identity miss): falls back to a full eval, still
+        # correct for THAT state
+        ev_old = algo.evaluate(states[0])
+        full_old = algo._eval_personal(
+            states[0].personal_params, data.x_test, data.y_test,
+            data.n_test)
+        assert float(ev_old["personal_acc"]) == float(full_old["acc"])
