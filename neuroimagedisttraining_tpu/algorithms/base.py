@@ -194,23 +194,35 @@ class FedAlgorithm(abc.ABC):
     def eval_metrics(self, state: Any, x_test, y_test,
                      n_test) -> Dict[str, Any]:
         """Traceable eval hook (the fused round loop calls it in-graph).
-        Subclasses implement this OR override ``evaluate`` (host-side
-        composition); this guard restores the fail-fast contract that
+        Subclasses implement this, or implement ``_eval_impl(state, x, y,
+        n, personal_fn)`` (the algorithms with a partial-participation
+        personal stack — the shared wrappers below route it), or override
+        ``evaluate``; this guard restores the fail-fast contract that
         de-abstracting ``evaluate`` removed."""
+        impl = getattr(self, "_eval_impl", None)
+        if impl is not None:
+            # traceable: full personal eval in-graph
+            return impl(state, x_test, y_test, n_test, self._eval_personal)
         raise NotImplementedError(
             f"{type(self).__name__} must implement eval_metrics (traceable"
-            " eval over explicit test arrays) or override evaluate")
+            " eval over explicit test arrays), _eval_impl, or override"
+            " evaluate")
 
     def evaluate(self, state: Any) -> Dict[str, Any]:
         """Evaluate per the reference protocol (global and/or personal
         per-client accuracy, mean over clients — sailentgrads_api.py:231-285).
 
-        Default: delegate to the traceable ``eval_metrics(state, x_test,
-        y_test, n_test)`` hook (which the fused round loop also calls
-        in-graph). Algorithms with host-side eval composition (DisPFL's
-        per-round local tests, FedFomo) override ``evaluate`` directly."""
-        return self.eval_metrics(
-            state, self.data.x_test, self.data.y_test, self.data.n_test)
+        Default: algorithms providing ``_eval_impl`` get the host path
+        with the INCREMENTAL personal eval (``_personal_eval_cached``);
+        everyone else delegates to the traceable ``eval_metrics`` hook.
+        Algorithms with host-side eval composition (DisPFL's per-round
+        local tests, FedFomo) override ``evaluate`` directly."""
+        d = self.data
+        impl = getattr(self, "_eval_impl", None)
+        if impl is not None:
+            return impl(state, d.x_test, d.y_test, d.n_test,
+                        self._personal_eval_cached)
+        return self.eval_metrics(state, d.x_test, d.y_test, d.n_test)
 
     def finalize(self, state: Any):
         """Optional end-of-training pass after the last round. Returns

@@ -112,16 +112,23 @@ class Ditto(FedAlgorithm):
         sel = sample_client_indexes(
             round_idx, self.num_clients, self.clients_per_round
         )
-        state, g_loss, p_loss = self._round_jit(
+        new_state, g_loss, p_loss = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
         )
-        return state, {"train_loss": g_loss, "personal_train_loss": p_loss}
+        # only the selected clients' personal legs trained — feed the
+        # incremental personal-eval cache (base._personal_eval_cached)
+        self._note_personal_update(
+            state.personal_params, new_state.personal_params, sel)
+        return new_state, {"train_loss": g_loss,
+                           "personal_train_loss": p_loss}
 
-    def eval_metrics(self, state: DittoState, x_test, y_test,
-                     n_test) -> Dict[str, Any]:
+    def _eval_impl(self, state, x_test, y_test, n_test,
+                   personal_fn) -> Dict[str, Any]:
+        # routed by the base wrappers: eval_metrics passes the traceable
+        # full personal eval, evaluate the incremental cached one
         ev_g = self._eval_global(state.global_params, x_test, y_test, n_test)
-        ev_p = self._eval_personal(
+        ev_p = personal_fn(
             state.personal_params, x_test, y_test, n_test)
         return {
             "global_acc": ev_g["acc"], "global_loss": ev_g["loss"],

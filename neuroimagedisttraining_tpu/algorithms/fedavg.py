@@ -145,6 +145,8 @@ class FedAvg(FedAlgorithm):
 
     def _eval_impl(self, state, x_test, y_test, n_test,
                    personal_fn) -> Dict[str, Any]:
+        # routed by the base wrappers: eval_metrics passes the traceable
+        # full personal eval, evaluate the incremental cached one
         ev = self._eval_global(state.global_params, x_test, y_test, n_test)
         out = {"global_acc": ev["acc"], "global_loss": ev["loss"],
                "acc_per_client": ev["acc_per_client"]}
@@ -153,16 +155,3 @@ class FedAvg(FedAlgorithm):
                 state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
         return out
-
-    def eval_metrics(self, state: FedAvgState, x_test, y_test,
-                     n_test) -> Dict[str, Any]:
-        # traceable (the fused scan's in-graph eval branch): full eval
-        return self._eval_impl(state, x_test, y_test, n_test,
-                               self._eval_personal)
-
-    def evaluate(self, state: FedAvgState) -> Dict[str, Any]:
-        # host path: the personal half re-evaluates only clients trained
-        # since the last eval (bitwise-identical; see base)
-        d = self.data
-        return self._eval_impl(state, d.x_test, d.y_test, d.n_test,
-                               self._personal_eval_cached)

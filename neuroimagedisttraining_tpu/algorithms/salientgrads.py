@@ -230,7 +230,9 @@ class SalientGrads(FedAlgorithm):
 
     def _eval_impl(self, state, x_test, y_test, n_test,
                    personal_fn) -> Dict[str, Any]:
-        # the reference protocol tests the global model AND every client's
+        # routed by the base wrappers (eval_metrics = traceable full
+        # personal eval; evaluate = incremental cached one). The
+        # reference protocol tests the global model AND every client's
         # personal model on its local test set (sailentgrads_api.py:238,
         # 262-283); global params are already masked (the aggregate of
         # masked locals; assert via density)
@@ -246,16 +248,3 @@ class SalientGrads(FedAlgorithm):
                 state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])
         return out
-
-    def eval_metrics(self, state: SalientGradsState, x_test, y_test,
-                     n_test) -> Dict[str, Any]:
-        # traceable (the fused scan's in-graph eval branch): full eval
-        return self._eval_impl(state, x_test, y_test, n_test,
-                               self._eval_personal)
-
-    def evaluate(self, state: SalientGradsState) -> Dict[str, Any]:
-        # host path: the personal half re-evaluates only clients trained
-        # since the last eval (bitwise-identical; see base)
-        d = self.data
-        return self._eval_impl(state, d.x_test, d.y_test, d.n_test,
-                               self._personal_eval_cached)

@@ -174,10 +174,12 @@ def test_incremental_personal_eval_bitwise_equals_full():
 
     def close(a, b):
         return abs(a - b) <= 4e-7 * max(1.0, abs(b))
-    import jax
-    import numpy as np
 
-    from neuroimagedisttraining_tpu.algorithms import FedAvg, SalientGrads
+    from neuroimagedisttraining_tpu.algorithms import (
+        Ditto,
+        FedAvg,
+        SalientGrads,
+    )
     from neuroimagedisttraining_tpu.core.state import HyperParams
     from neuroimagedisttraining_tpu.data import make_synthetic_federated
     from neuroimagedisttraining_tpu.models import create_model
@@ -192,7 +194,8 @@ def test_incremental_personal_eval_bitwise_equals_full():
 
     for cls, kw in ((SalientGrads, dict(dense_ratio=0.5,
                                         itersnip_iterations=1)),
-                    (FedAvg, {})):
+                    (FedAvg, {}),
+                    (Ditto, dict(lamda=0.5))):
         # frac 0.25 (2 of 8 clients/round): cadence-2 evals accumulate a
         # 4-entry dirty list < C, so the MERGE path (not the >=C full-
         # eval fallback) is what runs — and the seeded draws for rounds
