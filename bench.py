@@ -434,7 +434,25 @@ def tracked_config(name: str):
         # largest mesh <= 8 devices that divides the 32-client axis
         # (shard_map needs exact divisibility)
         n_dev = max(d for d in (8, 4, 2, 1) if d <= len(jax.devices()))
-        d = _agg_realparams_probe(make_mesh(n_dev), n_dev, raw=True)
+        mesh = make_mesh(n_dev)
+        d = _agg_realparams_probe(mesh, n_dev, raw=True)
+        # the agg-subsystem micro-bench (parallel/collectives.py): dense
+        # vs bucketed-psum vs low-precision wires vs mask-aware sparse,
+        # same 32-client real-parameter workload (honored 0.5-density
+        # SNIP-style mask) — the before/after behind --agg_impl
+        from neuroimagedisttraining_tpu.parallel.collectives import (
+            agg_microbench,
+        )
+
+        for k, v in agg_microbench(mesh if n_dev > 1 else None).items():
+            # the probe and the microbench share workload-descriptor keys
+            # (n_params/n_clients/n_devices) by construction; if their
+            # defaults ever diverge, keep both instead of silently
+            # relabeling the probe's measurements
+            if k in d and d[k] != v:
+                d[f"microbench_{k}"] = v
+            else:
+                d[k] = v
         result = {
             "metric": "weighted_sum_aggregation_ms_alexnet3d_32clients",
             "value": round(d["gspmd_ms"], 3),

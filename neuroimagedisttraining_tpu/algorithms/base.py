@@ -28,17 +28,21 @@ logger = logging.getLogger(__name__)
 
 def _personal_metrics(correct, loss_sum, total):
     """Per-client eval terms -> the personal-eval protocol metrics
-    (mean of per-client accuracies, total-weighted loss —
-    sailentgrads_api.py:271-283). The ONE definition all three personal
-    eval paths share (full, incremental merge, cache-only re-reduce):
-    the incremental cache's bitwise-identity contract rests on these
+    (mean of per-client accuracies AND mean of per-client MEAN losses —
+    sailentgrads_api.py:276-283 appends each client's ``test_loss`` and
+    reports ``sum/len``, so uneven test shards do NOT reweight the
+    protocol loss; the earlier sample-weighted ``sum(loss_sum)/
+    sum(total)`` here was an unrecorded deviation, fixed per ADVICE r5 —
+    see PARITY.md). The ONE definition all three personal eval paths
+    share (full, incremental merge, cache-only re-reduce): the
+    incremental cache's bitwise-identity contract rests on these
     reductions being literally the same code."""
     totals = jnp.maximum(total, 1)
     acc = correct.astype(jnp.float32) / totals
     return {
         "acc_per_client": acc,
         "acc": jnp.mean(acc),
-        "loss": jnp.sum(loss_sum) / jnp.maximum(jnp.sum(total), 1),
+        "loss": jnp.mean(loss_sum / totals),
         # raw per-client terms seed/refresh the incremental-eval cache
         "correct": correct, "loss_sum": loss_sum, "total": total,
     }
@@ -105,7 +109,11 @@ class FedAlgorithm(abc.ABC):
         remat_local: bool = False,
         eval_clients: int = 0,
         augment="auto",
+        agg_impl: str = "dense",
+        agg_bucket_size: int = 0,
     ):
+        from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
+
         self.model = model
         self.data = data
         self.hp = hp
@@ -125,6 +133,21 @@ class FedAlgorithm(abc.ABC):
         # remat_local: rematerialized local steps (core/trainer.py) — more
         # concurrent clients per chip at the cost of a second forward pass
         self.remat_local = remat_local
+        # agg_impl: the cross-chip aggregation path of the central
+        # weighted mean (parallel/collectives.py). "dense" (default) is
+        # the exact monolithic contraction of weighted_tree_sum;
+        # "bucketed" pipelines fixed-size per-bucket reduces; "bf16"/
+        # "int8" add a low-precision wire with f32 accumulation; "sparse"
+        # (static-mask algorithms only — SalientGrads) reduces on the
+        # mask's live coordinates. Consumed by _aggregate; algorithms
+        # without a central aggregate ignore it.
+        if agg_impl not in AGG_IMPLS:
+            raise ValueError(f"agg_impl {agg_impl!r} not in {AGG_IMPLS}")
+        self.agg_impl = agg_impl
+        self.agg_bucket_size = agg_bucket_size or DEFAULT_BUCKET_SIZE
+        self._agg_sparse_plan = None   # set by static-mask subclasses
+        self._agg_mesh_known = False   # lazily discovered from the data
+        self._agg_mesh_val = None
         # eval_clients: sampled-eval mode (SURVEY §7's O(N^2)-eval
         # hard-part): evaluate a fixed seeded subset of clients instead of
         # the whole cohort; 0 = all. Reported means are over the subset.
@@ -271,6 +294,66 @@ class FedAlgorithm(abc.ABC):
         return params, mask
 
     # -- shared helpers -------------------------------------------------------
+    def _selected_client_indexes(self, round_idx: int) -> np.ndarray:
+        """``sample_client_indexes`` plus the full-participation contract
+        check: ``_train_selected_weighted`` statically SKIPS the sel_idx
+        gathers when ``clients_per_round == num_clients`` (the gathers
+        would materialize a second full cohort copy on TPU), so the draw
+        must be exactly ``arange(C)`` — a future permuted/sorted draw
+        would silently misalign shards, sample weights, and the
+        locals_-to-personal_params scatter. Cheap host-side guard
+        (ADVICE r5); runs before dispatch, never under trace."""
+        sel = sample_client_indexes(
+            round_idx, self.num_clients, self.clients_per_round)
+        if self.clients_per_round == self.num_clients and \
+                not np.array_equal(sel, np.arange(self.num_clients)):
+            raise ValueError(
+                f"{self.name}: full participation requires sel_idx == "
+                f"arange({self.num_clients}) — the round program "
+                "statically skips the client gathers on that invariant; "
+                f"got {sel!r}")
+        return sel
+
+    def _agg_mesh(self):
+        """The ``clients`` mesh the data lives on (None off-mesh), for the
+        shard_map aggregation paths. Resolved once, lazily: the data is
+        placed before the algorithm is built (bench.py / the runner)."""
+        if not self._agg_mesh_known:
+            from ..parallel.mesh import mesh_of
+
+            self._agg_mesh_val = mesh_of(self.data.x_train)
+            self._agg_mesh_known = True
+        return self._agg_mesh_val
+
+    def _aggregate(self, stacked, weights, rng=None):
+        """The central weighted mean over the stacked client axis, routed
+        by ``agg_impl`` (parallel/collectives.py). ``dense`` is bit-for-
+        bit today's ``weighted_tree_sum``; every other impl trades exact
+        association (and, for bf16/int8, wire precision — f32 master
+        weights and accumulation always) for smaller / pipelined
+        cross-chip transfers. Robust defenses already transformed
+        ``stacked`` before this point, so they compose with every impl."""
+        if self.agg_impl == "dense":
+            from ..core.state import weighted_tree_sum
+
+            return weighted_tree_sum(stacked, weights)
+        from ..parallel import collectives
+
+        kw = dict(mesh=self._agg_mesh(),
+                  bucket_size=self.agg_bucket_size, rng=rng)
+        if self.agg_impl == "sparse":
+            if self._agg_sparse_plan is None:
+                raise ValueError(
+                    f"{self.name}: agg_impl='sparse' needs a static-mask "
+                    "gather plan (_agg_sparse_plan) built from the "
+                    "concrete mask before the round traces — only "
+                    "fixed-mask algorithms (SalientGrads) support it")
+            return collectives.sparse_weighted_mean(
+                stacked, weights, self._agg_sparse_plan, **kw)
+        wire = {"bucketed": "f32", "bf16": "bf16", "int8": "int8"}[
+            self.agg_impl]
+        return collectives.weighted_mean(stacked, weights, wire=wire, **kw)
+
     def _full_batches(self, hp: Optional[HyperParams] = None) -> bool:
         """Static guarantee for core.trainer's epoch fast path: every
         client's shard covers steps_per_epoch*batch_size samples, so all
@@ -379,11 +462,7 @@ class FedAlgorithm(abc.ABC):
         models, and return the sample-weighted average, the (pre-defense)
         local models, and the mean loss
         (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227)."""
-        from ..core.state import (
-            broadcast_tree,
-            weighted_tree_sum,
-            zeros_like_tree,
-        )
+        from ..core.state import broadcast_tree, zeros_like_tree
 
         if self.clients_per_round == self.num_clients:
             # full participation: sample_client_indexes always returns
@@ -413,7 +492,12 @@ class FedAlgorithm(abc.ABC):
             defended = defense.apply(params_out, global_params, keys[s])
         weights = n_sel.astype(jnp.float32)
         weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
-        new_global = weighted_tree_sum(defended, weights)
+        agg_rng = None
+        if self.agg_impl == "int8":  # stochastic-rounding draw; folded off
+            # round_key so the client/defense key consumption (and hence
+            # the default path's numerics) is untouched
+            agg_rng = jax.random.fold_in(round_key, 0x616767)  # "agg"
+        new_global = self._aggregate(defended, weights, agg_rng)
         return new_global, params_out, jnp.mean(losses)
 
     def _train_stacked(self, client_update, params_stack, mask_stack,
@@ -603,9 +687,8 @@ class FedAlgorithm(abc.ABC):
     def _fused_host_inputs(self, round_idx: int):
         """The per-round host-side inputs of ``run_round``, to be stacked
         along a leading round axis for the fused scan. Standard centralized
-        algorithms: the seeded client draw."""
-        return (sample_client_indexes(
-            round_idx, self.num_clients, self.clients_per_round),)
+        algorithms: the seeded (contract-checked) client draw."""
+        return (self._selected_client_indexes(round_idx),)
 
     def _fused_data_args(self):
         """Round-invariant device args of ``_round_jit`` after round_idx."""
@@ -645,11 +728,14 @@ class FedAlgorithm(abc.ABC):
                 s, metrics = out[0], out[1:]
                 # fail fast if a subclass's _round_jit outputs drifted from
                 # its _round_metric_names — dict(zip(...)) would silently
-                # drop or mislabel metrics (ADVICE r4)
-                assert len(metrics) == len(self._round_metric_names), (
-                    f"{type(self).__name__}._round_jit returned "
-                    f"{len(metrics)} metrics but _round_metric_names has "
-                    f"{len(self._round_metric_names)}")
+                # drop or mislabel metrics (ADVICE r4). An explicit raise,
+                # not assert: python -O must not strip the trace-time
+                # contract (ADVICE r5)
+                if len(metrics) != len(self._round_metric_names):
+                    raise ValueError(
+                        f"{type(self).__name__}._round_jit returned "
+                        f"{len(metrics)} metrics but _round_metric_names "
+                        f"has {len(self._round_metric_names)}")
                 ys = dict(zip(self._round_metric_names, metrics))
                 if eval_every:
                     do = (r.astype(jnp.int32) + 1) % eval_every == 0
@@ -666,11 +752,14 @@ class FedAlgorithm(abc.ABC):
             # fusion win). CONTRACT: every _round_metric_names /
             # eval_metrics leaf must be an inexact (floating) scalar — the
             # f32 cast is the canonical record dtype, and an int/bool
-            # metric would be silently coerced (asserted here, ADVICE r4)
+            # metric would be silently coerced (raised here, ADVICE r4;
+            # explicit raise so python -O cannot strip it, ADVICE r5)
             for x in jax.tree_util.tree_leaves(ys):
-                assert jnp.issubdtype(x.dtype, jnp.inexact), (
-                    f"per-round metrics must be floating (got {x.dtype}); "
-                    "the packed single-transfer stack records f32")
+                if not jnp.issubdtype(x.dtype, jnp.inexact):
+                    raise TypeError(
+                        f"per-round metrics must be floating (got "
+                        f"{x.dtype}); the packed single-transfer stack "
+                        "records f32")
             packed = jnp.stack([
                 x.astype(jnp.float32)
                 for x in jax.tree_util.tree_leaves(ys)])

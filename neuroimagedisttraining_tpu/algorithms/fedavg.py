@@ -30,7 +30,7 @@ from ..core.state import (
 )
 from ..core.trainer import make_client_update
 from ..models import init_params
-from .base import FedAlgorithm, sample_client_indexes
+from .base import FedAlgorithm
 
 
 @struct.dataclass
@@ -117,9 +117,7 @@ class FedAvg(FedAlgorithm):
         )
 
     def run_round(self, state: FedAvgState, round_idx: int):
-        sel = sample_client_indexes(
-            round_idx, self.num_clients, self.clients_per_round
-        )
+        sel = self._selected_client_indexes(round_idx)
         new_state, loss = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,

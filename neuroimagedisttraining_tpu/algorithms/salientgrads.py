@@ -36,7 +36,7 @@ from ..core.state import broadcast_tree, tree_scatter_update
 from ..core.trainer import make_client_update
 from ..models import init_params
 from ..ops.sparsity import make_snip_score_fn, mask_density, mask_from_scores
-from .base import FedAlgorithm, sample_client_indexes
+from .base import FedAlgorithm
 
 
 @struct.dataclass
@@ -204,10 +204,23 @@ class SalientGrads(FedAlgorithm):
                              if self.track_personal else None),
             rng=s_rng)
 
+    def _ensure_agg_plan(self, state: SalientGradsState) -> None:
+        """Host-side, before the round program traces: build the
+        mask-aware sparse gather plan from the CONCRETE mask. Valid for
+        the whole run — the SNIP mask is fixed after init
+        (``masks_evolve=False``), which is exactly why SalientGrads can
+        run ``agg_impl='sparse'``: the live-coordinate set is static per
+        round-block. With a weak-DP defense the compressed reduce also
+        drops the noise landing on dead kernel coordinates — the same
+        invariant the explicit post-aggregation re-mask enforces."""
+        if self.agg_impl == "sparse" and self._agg_sparse_plan is None:
+            from ..parallel.collectives import build_sparse_plan
+
+            self._agg_sparse_plan = build_sparse_plan(state.mask)
+
     def run_round(self, state: SalientGradsState, round_idx: int):
-        sel = sample_client_indexes(
-            round_idx, self.num_clients, self.clients_per_round
-        )
+        self._ensure_agg_plan(state)
+        sel = self._selected_client_indexes(round_idx)
         new_state, loss = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
@@ -217,6 +230,11 @@ class SalientGrads(FedAlgorithm):
         self._note_personal_update(
             state.personal_params, new_state.personal_params, sel)
         return new_state, {"train_loss": loss}
+
+    def run_rounds_fused(self, state, start_round, n_rounds, eval_every=0):
+        self._ensure_agg_plan(state)  # before the fused program traces
+        return super().run_rounds_fused(state, start_round, n_rounds,
+                                        eval_every=eval_every)
 
     def finalize(self, state: SalientGradsState):
         """One final global+personal eval after the last round — the

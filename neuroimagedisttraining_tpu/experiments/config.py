@@ -160,6 +160,22 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "boundaries instead of every round (lineages stay "
                         "resumable across fused/unfused runs); "
                         "1 = unfused")
+    p.add_argument("--agg_impl", type=str, default="dense",
+                   choices=["dense", "bucketed", "bf16", "int8", "sparse"],
+                   help="cross-chip aggregation path for the central "
+                        "weighted mean (parallel/collectives.py): dense = "
+                        "the exact monolithic contraction (default); "
+                        "bucketed = pipelined fixed-size per-bucket "
+                        "reduces (exact off-mesh); bf16/int8 = low-"
+                        "precision wire with f32 accumulation + master "
+                        "weights; sparse = mask-aware reduce on the SNIP "
+                        "mask's live coordinates (salientgrads only). "
+                        "Centralized algorithms (fedavg/salientgrads/"
+                        "ditto) only")
+    p.add_argument("--agg_bucket_size", type=int, default=0,
+                   help="aggregation bucket size in elements for the "
+                        "non-dense --agg_impl paths (0 = the 256k-element "
+                        "default, 1 MiB f32 per bucket on the wire)")
     p.add_argument("--eval_clients", type=int, default=0,
                    help="sampled-eval mode: evaluate only this many "
                         "(seeded) clients per eval instead of the whole "
@@ -385,6 +401,12 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
                 parts.append("noaug")  # un-augmented CIFAR/tiny ablation
         if getattr(args, "eval_clients", 0):
             parts.append(f"evK{args.eval_clients}")
+        if getattr(args, "agg_impl", "dense") != "dense":
+            # bf16/int8/sparse change the aggregate's numerics (bucketed
+            # only its association on-mesh) — metric lineages must split;
+            # the checkpointed f32 state stays interchangeable, so the
+            # checkpoint identity excludes it (resumable across impls)
+            parts.append(f"agg{args.agg_impl}")
         if getattr(args, "data_dtype", ""):
             parts.append(f"dt{args.data_dtype}")
     if not getattr(args, "final_finetune", 1):

@@ -289,7 +289,20 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         # "auto" applies only to datasets whose loader set aug_pad_value
         # (cifar10/100, tiny) — the reference's always-on train transform
         augment="auto" if getattr(args, "augment", 1) else False,
+        agg_impl=getattr(args, "agg_impl", "dense"),
+        agg_bucket_size=getattr(args, "agg_bucket_size", 0),
     )
+    agg_impl = getattr(args, "agg_impl", "dense")
+    if agg_impl != "dense" and algo_name not in (
+            "fedavg", "salientgrads", "ditto"):
+        raise SystemExit(
+            f"--agg_impl {agg_impl} routes the CENTRAL weighted mean "
+            f"(fedavg/salientgrads/ditto); {algo_name} has no central "
+            "aggregate")
+    if agg_impl == "sparse" and algo_name != "salientgrads":
+        raise SystemExit(
+            "--agg_impl sparse needs a static sparsity mask; only "
+            "salientgrads (fixed SNIP mask) supports it")
     defense = None
     if getattr(args, "defense_type", "none") != "none":
         from ..robust import RobustAggregator

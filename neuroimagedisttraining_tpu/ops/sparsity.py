@@ -457,6 +457,33 @@ def live_counts(mask: Any) -> Any:
     return jax.tree_util.tree_map(lambda m: jnp.sum(m != 0), mask)
 
 
+def host_live_indices(mask: Any, stacked: bool = False) -> list:
+    """Host-side gather plan for mask-aware sparse aggregation
+    (``parallel/collectives.py``): for each leaf, in ``tree_leaves``
+    order, the int32 flat indices of live (nonzero) coordinates — or
+    ``None`` for leaves that stay dense (non-kernel leaves, which the
+    reference never sparsifies, and kernels with no dead coordinate).
+
+    ``stacked=True`` reads [C, ...]-stacked per-client masks and returns
+    the UNION of live coordinates over the client axis — the static
+    shared index superset ("padded to the max live footprint across
+    clients") a cross-client compressed reduce needs. Requires a CONCRETE
+    mask (numpy walk; do not call under trace).
+    """
+    flags = kernel_flags(mask)
+    out = []
+    for m, k in zip(jax.tree_util.tree_leaves(mask),
+                    jax.tree_util.tree_leaves(flags)):
+        a = np.asarray(m)
+        live = (a != 0).any(axis=0).reshape(-1) if stacked \
+            else (a != 0).reshape(-1)
+        if not k or bool(live.all()):
+            out.append(None)
+        else:
+            out.append(np.flatnonzero(live).astype(np.int32))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # SubAvg iterative magnitude pruning
 # ---------------------------------------------------------------------------

@@ -1,5 +1,11 @@
+from .collectives import (
+    build_sparse_plan,
+    masked_weighted_mean,
+    sparse_weighted_mean,
+    weighted_mean,
+)
 from .gossip import ring_mix
-from .mesh import make_mesh, shard_over_clients, replicate
+from .mesh import make_mesh, mesh_of, shard_over_clients, replicate
 from .multihost import (
     initialize_distributed,
     local_client_indices,
@@ -18,8 +24,13 @@ from .spatial import (
 )
 
 __all__ = [
+    "build_sparse_plan",
+    "masked_weighted_mean",
+    "sparse_weighted_mean",
+    "weighted_mean",
     "ring_mix",
     "make_mesh",
+    "mesh_of",
     "shard_over_clients",
     "replicate",
     "initialize_distributed",

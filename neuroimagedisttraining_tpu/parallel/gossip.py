@@ -17,7 +17,10 @@ from typing import Any, Tuple
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:  # jax >= 0.7 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax ships it in experimental
+    from jax.experimental.shard_map import shard_map
 
 
 def ring_mix(

@@ -54,6 +54,20 @@ def fit_client_devices(n_clients: int, available: int) -> int:
     return n
 
 
+def mesh_of(tree: Any) -> Optional[Mesh]:
+    """The live :class:`Mesh` behind any ``NamedSharding`` leaf of
+    ``tree`` (None when the pytree is unsharded / single-device). Lets the
+    aggregation collectives (``parallel/collectives.py``) discover the
+    ``clients`` mesh the data was placed on without threading a mesh
+    handle through every algorithm constructor."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if isinstance(mesh, Mesh) and mesh.axis_names:
+            return mesh
+    return None
+
+
 def shard_over_clients(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree whose leaves have a leading client axis onto the mesh,
     sharded over ``clients``."""

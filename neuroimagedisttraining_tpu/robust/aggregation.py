@@ -8,6 +8,13 @@ whole defense runs inside the jitted round program.
 The reference's ``is_weight_param`` filter (:28-29) exists to skip BN running
 stats; this framework uses GroupNorm (no running stats), so every parameter
 leaf participates — ``vectorize_weights`` keeps the name for parity.
+
+Composition with the aggregation subsystem (``parallel/collectives.py``):
+defenses transform the [C, ...]-stacked LOCAL models before the central
+weighted mean runs, so every ``agg_impl`` (dense / bucketed / bf16 / int8 /
+sparse) consumes defended trees unchanged — the defense never sees, and
+never needs to see, the wire format. The flattening both layers use is one
+definition (``collectives.tree_to_vec``).
 """
 from __future__ import annotations
 
@@ -16,12 +23,14 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from ..parallel.collectives import tree_to_vec
+
 
 def vectorize_weights(tree: Any) -> jax.Array:
     """Flatten a parameter pytree into one vector
-    (robust_aggregation.py:4-9)."""
-    leaves = jax.tree_util.tree_leaves(tree)
-    return jnp.concatenate([x.reshape(-1) for x in leaves])
+    (robust_aggregation.py:4-9; shared with the aggregation buckets —
+    ``parallel.collectives.tree_to_vec``)."""
+    return tree_to_vec(tree)
 
 
 def norm_diff_clipping(local: Any, global_: Any, norm_bound: float) -> Any:

@@ -1,0 +1,79 @@
+"""Standalone aggregation micro-bench (parallel/collectives.py).
+
+One-liner for the agg subsystem's dense / bucketed / bf16 / int8 / sparse
+weighted-mean timings at real parameter scale (the 2.57M-param AlexNet3D
+tree stacked over 32 clients, honored 0.5-density SNIP-style mask):
+
+    python scripts/bench_agg.py                 # 8-device virtual CPU mesh
+    python scripts/bench_agg.py --devices 4
+    JAX_PLATFORMS='' python scripts/bench_agg.py  # real accelerator(s)
+
+Prints ONE JSON line with agg_ms_* per impl — the same fields
+``BENCH_CONFIG=agg python bench.py`` folds into its ``extra``. CPU-mesh
+absolute times are proxies (the real-chip numbers come from the bench);
+the dense-vs-bucketed-vs-sparse RATIOS are the datapoint.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=8,
+                   help="clients-mesh width (CPU runs force this many "
+                        "virtual devices)")
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--dense_ratio", type=float, default=0.5)
+    p.add_argument("--bucket_size", type=int, default=0,
+                   help="elements per bucket (0 = 256k default)")
+    p.add_argument("--model", type=str, default="3dcnn",
+                   help="param-tree source model (3dcnn = the 2.57M-param "
+                        "flagship; small3dcnn for a quick smoke)")
+    args = p.parse_args(argv)
+
+    # default to a virtual CPU mesh (the dryrun convention) unless the
+    # caller explicitly selected a platform
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.devices}").strip()
+
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from neuroimagedisttraining_tpu.parallel.collectives import (
+        DEFAULT_BUCKET_SIZE,
+        agg_microbench,
+    )
+    from neuroimagedisttraining_tpu.parallel.mesh import (
+        fit_client_devices,
+        make_mesh,
+    )
+
+    n_dev = fit_client_devices(args.clients, min(args.devices,
+                                                 len(jax.devices())))
+    mesh = make_mesh(n_dev) if n_dev > 1 else None
+    sample_shape = (8, 8, 8, 1) if args.model == "small3dcnn" \
+        else (121, 145, 121, 1)
+    out = agg_microbench(
+        mesh, n_clients=args.clients, iters=args.iters,
+        dense_ratio=args.dense_ratio,
+        bucket_size=args.bucket_size or DEFAULT_BUCKET_SIZE,
+        model_key=args.model, sample_shape=sample_shape)
+    out = {k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in out.items()}
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()
