@@ -49,14 +49,27 @@ def _personal_metrics(correct, loss_sum, total):
 
 
 def sample_client_indexes(
-    round_idx: int, client_num_in_total: int, client_num_per_round: int
+    round_idx: int, client_num_in_total: int, client_num_per_round: int,
+    retry: int = 0,
 ) -> np.ndarray:
     """Seeded per-round client sampling (fedavg_api.py:92-100 semantics:
     reseed numpy with the round index so every algorithm draws the same
-    subsets — the reference's intentional comparability contract)."""
+    subsets — the reference's intentional comparability contract).
+
+    ``retry`` re-samples the cohort for a watchdog rollback-retry
+    (robust/recovery.py): the draw stays a pure function of
+    (round_idx, retry) — no host RNG state — so a killed-and-resumed run
+    replays the identical retry cohorts. ``retry=0`` is bit-compatible
+    with the reference contract. Full participation is arange regardless
+    (there is no alternative cohort to draw)."""
     if client_num_in_total == client_num_per_round:
         return np.arange(client_num_in_total, dtype=np.int32)
-    np.random.seed(round_idx)
+    if retry:
+        # golden-ratio stride keeps retry seeds disjoint from every round
+        # index a realistic run can reach
+        np.random.seed((round_idx + 0x9E3779B1 * retry) % (2 ** 32))
+    else:
+        np.random.seed(round_idx)
     return np.random.choice(
         range(client_num_in_total), client_num_per_round, replace=False
     ).astype(np.int32)
@@ -111,6 +124,8 @@ class FedAlgorithm(abc.ABC):
         augment="auto",
         agg_impl: str = "dense",
         agg_bucket_size: int = 0,
+        fault_spec: str = "",
+        guard: Optional[bool] = None,
     ):
         from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
 
@@ -148,6 +163,40 @@ class FedAlgorithm(abc.ABC):
         self._agg_sparse_plan = None   # set by static-mask subclasses
         self._agg_mesh_known = False   # lazily discovered from the data
         self._agg_mesh_val = None
+        # fault_spec: deterministic PRNG-keyed fault injection on the
+        # stacked local updates (robust/faults.py) — per-round dropout,
+        # stragglers, NaN poison, Byzantine scaling, all derived from the
+        # run seed so a resumed run replays the identical trace. guard:
+        # the in-jit non-finite quarantine before _aggregate
+        # (robust/guard.py); None = auto (on exactly when faults are
+        # injected). Both live in the shared central-aggregate round body
+        # (_train_selected_weighted) — algorithms without one ignore them
+        # (and the CLI runner refuses the flags for those).
+        from ..robust.faults import make_fault_fn, parse_fault_spec
+
+        self.fault_spec = parse_fault_spec(fault_spec)
+        self.fault_fn = (make_fault_fn(self.fault_spec, seed)
+                         if self.fault_spec is not None
+                         and self.fault_spec.any_active else None)
+        self.guard_enabled = (bool(guard) if guard is not None
+                              else self.fault_fn is not None)
+        if self.fault_fn is not None and not self.guard_enabled \
+                and self.fault_spec.drop > 0:
+            # nan/scale/straggle without the guard is a legitimate
+            # undefended-chaos ablation (the poison really propagates);
+            # drop WITHOUT the guard is silently inert — the 'dropped'
+            # client's untouched update still aggregates at full weight
+            raise ValueError(
+                "fault_spec drop=... requires the guard (it is what "
+                "excludes dropped clients from the aggregate); don't "
+                "pass guard=False, or remove drop from the spec")
+        if self.guard_enabled and self.guard_metrics_supported:
+            # instance override: the guarded round also reports its
+            # per-round quarantine counters (floats — the fused packed-
+            # metric contract)
+            self._round_metric_names = tuple(self._round_metric_names) + (
+                "clients_dropped", "clients_quarantined")
+        self._retry_nonce = 0  # watchdog rollback-retry cohort re-draw
         # eval_clients: sampled-eval mode (SURVEY §7's O(N^2)-eval
         # hard-part): evaluate a fixed seeded subset of clients instead of
         # the whole cohort; 0 = all. Reported means are over the subset.
@@ -259,6 +308,12 @@ class FedAlgorithm(abc.ABC):
     # the runner reuses it instead of pulling params to host every round
     masks_evolve: bool = False
 
+    #: whether this algorithm's _round_jit threads the guard's per-round
+    #: quarantine counters into its metric outputs (FedAvg/SalientGrads).
+    #: Algorithms sharing _train_selected_weighted without threading the
+    #: counters (Ditto's global leg) still get the guard itself.
+    guard_metrics_supported: bool = False
+
     def cost_trained_clients_per_round(self) -> int:
         """Client training passes one round actually runs (cost accounting).
         Default: the sampled subset. Decentralized/personalized algorithms
@@ -303,8 +358,13 @@ class FedAlgorithm(abc.ABC):
         would silently misalign shards, sample weights, and the
         locals_-to-personal_params scatter. Cheap host-side guard
         (ADVICE r5); runs before dispatch, never under trace."""
+        # retry passed only when set: the 3-arg call stays the reference
+        # contract's exact signature (and test monkeypatch surface)
         sel = sample_client_indexes(
-            round_idx, self.num_clients, self.clients_per_round)
+            round_idx, self.num_clients, self.clients_per_round,
+            retry=self._retry_nonce) if self._retry_nonce else \
+            sample_client_indexes(
+                round_idx, self.num_clients, self.clients_per_round)
         if self.clients_per_round == self.num_clients and \
                 not np.array_equal(sel, np.arange(self.num_clients)):
             raise ValueError(
@@ -313,6 +373,14 @@ class FedAlgorithm(abc.ABC):
                 "statically skips the client gathers on that invariant; "
                 f"got {sel!r}")
         return sel
+
+    def set_retry_nonce(self, nonce: int) -> None:
+        """Watchdog rollback-retry hook (robust/recovery.py): subsequent
+        ``_selected_client_indexes`` draws re-sample the cohort with this
+        nonce (0 = the reference draw). The fused path never retries —
+        ``_fused_host_inputs`` precomputes draws with whatever nonce is
+        set, which the runner pins to 0."""
+        self._retry_nonce = int(nonce)
 
     def _agg_mesh(self):
         """The ``clients`` mesh the data lives on (None off-mesh), for the
@@ -460,8 +528,25 @@ class FedAlgorithm(abc.ABC):
         global model (and mask) along the client axis, run vmapped local
         SGD, optionally apply a robust-aggregation defense to the local
         models, and return the sample-weighted average, the (pre-defense)
-        local models, and the mean loss
-        (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227)."""
+        local models, the mean loss, and the fault/guard stats
+        (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227).
+
+        Fault tolerance (robust/faults.py + robust/guard.py): when a
+        ``fault_spec`` is set, the deterministic injector corrupts the
+        stacked local models AFTER training (they model wire/client
+        faults); when the guard is on, a single [S] finite-screen plus
+        the injector's dropout flags quarantine the unusable clients —
+        their rows are select-zeroed, the weights renormalize over the
+        survivors, and a survivor count of 0 carries the previous global
+        model. Both are pure selects when no client faults, so a guarded
+        clean round is bit-identical to the unguarded one — and the
+        sanitized tree feeds ``_aggregate`` unchanged, so quarantine
+        composes with every ``agg_impl`` wire and the clip/DP defenses.
+
+        The 4th return value is ``None`` when the guard is off, else a
+        dict with ``ok`` ([S] survivor flags — callers use it to keep
+        quarantined clients' previous personal models) and the f32
+        ``clients_dropped`` / ``clients_quarantined`` counters."""
         from ..core.state import broadcast_tree, zeros_like_tree
 
         if self.clients_per_round == self.num_clients:
@@ -484,6 +569,13 @@ class FedAlgorithm(abc.ABC):
             client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
         )(params0, mom0, mask_b, keys[:s], x_sel, y_sel, n_sel, round_idx,
           params0)
+        dropped = None
+        if self.fault_fn is not None:
+            # inject AFTER training: faults model what leaves the client
+            # (dropout, partial work, NaN poison, Byzantine scaling), so
+            # the faulted tree is also what the personal stack would see
+            params_out, dropped = self.fault_fn(
+                params_out, global_params, sel_idx, round_idx)
         # the defense guards the *aggregate*; each client's own (personal)
         # model stays its locally-trained weights, as in the reference where
         # w_per_mdls is set before any server-side processing
@@ -497,8 +589,60 @@ class FedAlgorithm(abc.ABC):
             # round_key so the client/defense key consumption (and hence
             # the default path's numerics) is untouched
             agg_rng = jax.random.fold_in(round_key, 0x616767)  # "agg"
-        new_global = self._aggregate(defended, weights, agg_rng)
-        return new_global, params_out, jnp.mean(losses)
+        fstats = None
+        if self.guard_enabled:
+            from ..robust import guard as _guard
+
+            finite = _guard.finite_screen(defended)
+            if dropped is not None:
+                ok = jnp.logical_and(finite, jnp.logical_not(dropped))
+                n_dropped = jnp.sum(dropped.astype(jnp.float32))
+                # quarantined = screened by the finite guard among the
+                # clients that did report (dropouts counted separately)
+                n_quar = jnp.sum(jnp.logical_and(
+                    jnp.logical_not(finite), jnp.logical_not(dropped)
+                ).astype(jnp.float32))
+            else:
+                ok = finite
+                n_dropped = jnp.asarray(0.0, jnp.float32)
+                n_quar = jnp.sum(
+                    jnp.logical_not(finite).astype(jnp.float32))
+            new_global = _guard.guarded_aggregate(
+                defended, weights, ok,
+                lambda st, wv: self._aggregate(st, wv, agg_rng),
+                global_params)
+            fstats = {"ok": ok, "clients_dropped": n_dropped,
+                      "clients_quarantined": n_quar}
+        else:
+            new_global = self._aggregate(defended, weights, agg_rng)
+        return new_global, params_out, jnp.mean(losses), fstats
+
+    def _guarded_personal_update(self, personal, locals_, sel_idx, fstats):
+        """Scatter the selected clients' trained models into the [C, ...]
+        personal stack (w_per_mdls semantics), guard-aware: quarantined /
+        dropped clients never delivered an update, so their previous
+        personal rows are kept (and NaN poison stays out of the stack).
+        Shared by every round_fn that carries a personal stack."""
+        if personal is None:
+            return None
+        from ..core.state import tree_scatter_update
+
+        upd = locals_
+        if fstats is not None:
+            from ..robust import guard as _guard
+
+            upd = _guard.merge_updates(
+                fstats["ok"], locals_, personal, sel_idx)
+        return tree_scatter_update(personal, sel_idx, upd)
+
+    def _round_outputs(self, state, mean_loss, fstats):
+        """A round_fn's return tuple, matching ``_round_metric_names``:
+        ``(state, train_loss)`` plus the guard's per-round counters when
+        this algorithm threads them (guard_metrics_supported)."""
+        if fstats is None or not self.guard_metrics_supported:
+            return state, mean_loss
+        return (state, mean_loss, fstats["clients_dropped"],
+                fstats["clients_quarantined"])
 
     def _train_stacked(self, client_update, params_stack, mask_stack,
                        round_idx, round_key, x, y, n, prox_target=None):

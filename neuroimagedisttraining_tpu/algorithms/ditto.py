@@ -69,8 +69,10 @@ class Ditto(FedAlgorithm):
         def round_fn(state: DittoState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, k_global, k_personal = jax.random.split(state.rng, 3)
-            # (a) global leg: standard FedAvg round
-            new_global, _, mean_loss = self._train_selected_weighted(
+            # (a) global leg: standard FedAvg round (the guard, when on,
+            # protects this aggregate too; Ditto does not thread the
+            # quarantine counters into its metrics — guard_metrics_supported)
+            new_global, _, mean_loss, _fstats = self._train_selected_weighted(
                 self.client_update, state.global_params, state.global_params,
                 sel_idx, round_idx, k_global, x_train, y_train, n_train,
             )

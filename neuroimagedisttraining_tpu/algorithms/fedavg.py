@@ -25,7 +25,6 @@ from flax import struct
 
 from ..core.state import (
     broadcast_tree,
-    tree_scatter_update,
     zeros_like_tree,
 )
 from ..core.trainer import make_client_update
@@ -48,6 +47,7 @@ class FedAvgState:
 class FedAvg(FedAlgorithm):
     name = "fedavg"
     supports_fused = True
+    guard_metrics_supported = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
                  **kwargs):
@@ -69,21 +69,19 @@ class FedAvg(FedAlgorithm):
         def round_fn(state: FedAvgState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, locals_, mean_loss = self._train_selected_weighted(
-                self.client_update, state.global_params,
-                state.global_params,  # dense path: mask unused, DCE'd
-                sel_idx, round_idx, round_key, x_train, y_train, n_train,
-                defense=self.defense,
-            )
-            new_personal = state.personal_params
-            if new_personal is not None:
-                new_personal = tree_scatter_update(
-                    new_personal, sel_idx, locals_)
-            return (
+            new_global, locals_, mean_loss, fstats = \
+                self._train_selected_weighted(
+                    self.client_update, state.global_params,
+                    state.global_params,  # dense path: mask unused, DCE'd
+                    sel_idx, round_idx, round_key, x_train, y_train,
+                    n_train, defense=self.defense,
+                )
+            new_personal = self._guarded_personal_update(
+                state.personal_params, locals_, sel_idx, fstats)
+            return self._round_outputs(
                 FedAvgState(global_params=new_global,
                             personal_params=new_personal, rng=rng),
-                mean_loss,
-            )
+                mean_loss, fstats)
 
         self._round_jit = jax.jit(round_fn)
 
@@ -118,15 +116,16 @@ class FedAvg(FedAlgorithm):
 
     def run_round(self, state: FedAvgState, round_idx: int):
         sel = self._selected_client_indexes(round_idx)
-        new_state, loss = self._round_jit(
+        out = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
         )
+        new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
         self._note_personal_update(
             state.personal_params, new_state.personal_params, sel)
-        return new_state, {"train_loss": loss}
+        return new_state, dict(zip(self._round_metric_names, out[1:]))
 
     def finalize(self, state: FedAvgState):
         if not self.track_personal:

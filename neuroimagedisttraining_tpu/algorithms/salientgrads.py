@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..core.state import broadcast_tree, tree_scatter_update
+from ..core.state import broadcast_tree
 from ..core.trainer import make_client_update
 from ..models import init_params
 from ..ops.sparsity import make_snip_score_fn, mask_density, mask_from_scores
@@ -55,6 +55,7 @@ class SalientGradsState:
 class SalientGrads(FedAlgorithm):
     name = "salientgrads"
     supports_fused = True
+    guard_metrics_supported = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
@@ -157,27 +158,26 @@ class SalientGrads(FedAlgorithm):
         def round_fn(state: SalientGradsState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, locals_, mean_loss = self._train_selected_weighted(
-                self.client_update, state.global_params, state.mask,
-                sel_idx, round_idx, round_key, x_train, y_train, n_train,
-                defense=self.defense,
-            )
+            new_global, locals_, mean_loss, fstats = \
+                self._train_selected_weighted(
+                    self.client_update, state.global_params, state.mask,
+                    sel_idx, round_idx, round_key, x_train, y_train,
+                    n_train, defense=self.defense,
+                )
             if self.defense is not None:
                 # weak-DP noise lands on every leaf; re-mask so the global
                 # model keeps the SNIP sparsity invariant
                 new_global = jax.tree_util.tree_map(
                     lambda p, m: p * m, new_global, state.mask)
-            new_personal = state.personal_params
-            if new_personal is not None:
-                # w_per_mdls[cur_clnt] = the client's (pre-defense) locally
-                # trained weights (sailentgrads_api.py:133)
-                new_personal = tree_scatter_update(
-                    new_personal, sel_idx, locals_)
-            return (
-                SalientGradsState(global_params=new_global, mask=state.mask,
+            # w_per_mdls[cur_clnt] = the client's (pre-defense) locally
+            # trained weights (sailentgrads_api.py:133), guard-aware
+            new_personal = self._guarded_personal_update(
+                state.personal_params, locals_, sel_idx, fstats)
+            return self._round_outputs(
+                SalientGradsState(global_params=new_global,
+                                  mask=state.mask,
                                   personal_params=new_personal, rng=rng),
-                mean_loss,
-            )
+                mean_loss, fstats)
 
         self._round_jit = jax.jit(round_fn)
         self._eval_global = self._make_global_eval()
@@ -221,15 +221,16 @@ class SalientGrads(FedAlgorithm):
     def run_round(self, state: SalientGradsState, round_idx: int):
         self._ensure_agg_plan(state)
         sel = self._selected_client_indexes(round_idx)
-        new_state, loss = self._round_jit(
+        out = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
         )
+        new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
         self._note_personal_update(
             state.personal_params, new_state.personal_params, sel)
-        return new_state, {"train_loss": loss}
+        return new_state, dict(zip(self._round_metric_names, out[1:]))
 
     def run_rounds_fused(self, state, start_round, n_rounds, eval_every=0):
         self._ensure_agg_plan(state)  # before the fused program traces

@@ -111,6 +111,54 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="weak-DP Gaussian noise stddev "
                         "(robust_aggregation.py:52-55)")
 
+    # -- fault tolerance (new: no reference equivalent — the reference has
+    # no fault path at all; see README "Fault tolerance")
+    p.add_argument("--fault_spec", type=str, default="",
+                   help="deterministic per-round fault injection on the "
+                        "central-aggregate round (fedavg/salientgrads), "
+                        "e.g. 'drop=0.2,straggle=0.1,nan=0.05,"
+                        "scale=0.02:100x' (robust/faults.py). All draws "
+                        "derive from --seed, so a --resume'd run replays "
+                        "the identical fault trace")
+    p.add_argument("--guard", type=int, default=None,
+                   help="in-jit non-finite quarantine before aggregation "
+                        "(robust/guard.py): screens the stacked client "
+                        "updates, zero-weights NaN/Inf/dropped clients, "
+                        "renormalizes over survivors (0 survivors = carry "
+                        "the previous global model). None = auto: on "
+                        "exactly when --fault_spec is set. A guarded clean "
+                        "round is bit-identical to the unguarded one")
+    p.add_argument("--watchdog", type=int, default=None,
+                   help="host-side divergence watchdog with rollback-retry "
+                        "(robust/recovery.py): an unhealthy round (non-"
+                        "finite train loss, or over the --watchdog_loss/"
+                        "--watchdog_norm thresholds) is rolled back to the "
+                        "last-good state and retried with a re-sampled "
+                        "cohort, --max_round_retries times with backoff; "
+                        "then the round is skipped. None = auto: on "
+                        "exactly when --fault_spec is set. Requires "
+                        "--fuse_rounds 1 (per-round host control)")
+    p.add_argument("--watchdog_loss", type=float, default=0.0,
+                   help="watchdog train-loss threshold (0 = non-finite "
+                        "check only)")
+    p.add_argument("--watchdog_norm", type=float, default=0.0,
+                   help="watchdog global-update L2-norm threshold "
+                        "(0 = off)")
+    p.add_argument("--max_round_retries", type=int, default=2,
+                   help="watchdog rollback-retry budget per round")
+    p.add_argument("--retry_backoff_s", type=float, default=0.0,
+                   help="linear backoff between watchdog retries (seconds "
+                        "x retry number)")
+    p.add_argument("--multihost_timeout_s", type=float, default=0.0,
+                   help="jax.distributed.initialize timeout (0 = jax "
+                        "default); a slow coordinator fails fast instead "
+                        "of hanging the SLURM allocation")
+    p.add_argument("--multihost_retries", type=int, default=2,
+                   help="bounded retries for the multihost init handshake "
+                        "(parallel/multihost.py; mid-run collectives are "
+                        "deliberately never retried per-process — that "
+                        "would break SPMD collective matching)")
+
     # -- runtime (new: TPU-native knobs, no reference equivalent)
     p.add_argument("--layout", type=str, default="channels",
                    choices=["channels", "flat", "s2d"],
@@ -328,6 +376,25 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         getattr(args, "track_personal", None) is not None
     if getattr(args, "track_personal", None) is None:
         args.track_personal = 1
+    # fault tolerance: validate the spec at parse time (a typo'd chaos
+    # config must die here, not silently inject nothing) and resolve the
+    # guard/watchdog auto sentinels — both default to ON exactly when
+    # faults are injected
+    fault_spec = getattr(args, "fault_spec", "")
+    if fault_spec:
+        from ..robust.faults import parse_fault_spec
+
+        parse_fault_spec(fault_spec)  # raises ValueError on bad specs
+    if getattr(args, "guard", None) is None:
+        args.guard = 1 if fault_spec else 0
+    if getattr(args, "watchdog", None) is None:
+        # the watchdog needs per-round host control, which --fuse_rounds
+        # removes; fused fault injection is supported WITHOUT it (the
+        # in-jit guard still runs), so the auto-sentinel resolves to off
+        # there instead of tripping the runner's explicit-combination
+        # refusal
+        args.watchdog = 1 if (
+            fault_spec and getattr(args, "fuse_rounds", 1) <= 1) else 0
     return args
 
 
@@ -383,6 +450,23 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         parts.append(f"nb{args.norm_bound:g}")
         if args.defense_type == "weak_dp":
             parts.append(f"sd{args.stddev:g}")
+    if getattr(args, "fault_spec", ""):
+        # fault injection changes the state trajectory, so it splits BOTH
+        # log/stat_info and checkpoint lineages (unlike the guard alone,
+        # which is bit-identical on clean rounds and splits nothing)
+        parts.append("flt" + args.fault_spec.replace("=", "")
+                     .replace(",", "-").replace(":", "x")
+                     .replace(".", "p"))
+    if getattr(args, "watchdog", 0):
+        # the watchdog also changes the trajectory when it fires (retried
+        # rounds train a re-sampled cohort; skipped rounds carry state),
+        # and its thresholds/retry budget determine WHICH rounds those
+        # are — same lineage-split rule as fault_spec. retry_backoff_s
+        # only changes timing, not state, so it stays out.
+        parts.append(
+            f"wdl{getattr(args, 'watchdog_loss', 0.0):g}"
+            f"n{getattr(args, 'watchdog_norm', 0.0):g}"
+            f"r{getattr(args, 'max_round_retries', 2)}")
     if not for_checkpoint:
         # these knobs change the metric protocol / training draw, so log
         # and stat_info lineages must split — but the checkpointed STATE

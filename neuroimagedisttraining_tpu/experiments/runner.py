@@ -291,7 +291,18 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         augment="auto" if getattr(args, "augment", 1) else False,
         agg_impl=getattr(args, "agg_impl", "dense"),
         agg_bucket_size=getattr(args, "agg_bucket_size", 0),
+        fault_spec=getattr(args, "fault_spec", ""),
+        # None = let the algorithm auto-resolve (on iff faults injected);
+        # parse_args always resolves the sentinel in derive()
+        guard=(bool(args.guard)
+               if getattr(args, "guard", None) is not None else None),
     )
+    if (getattr(args, "fault_spec", "") or getattr(args, "guard", 0)) \
+            and algo_name not in ("fedavg", "salientgrads", "ditto"):
+        raise SystemExit(
+            "--fault_spec/--guard protect the CENTRAL aggregation round "
+            f"(fedavg/salientgrads/ditto); {algo_name} has no central "
+            "aggregate to guard")
     agg_impl = getattr(args, "agg_impl", "dense")
     if agg_impl != "dense" and algo_name not in (
             "fedavg", "salientgrads", "ditto"):
@@ -458,7 +469,8 @@ def maybe_shard(algo, args: argparse.Namespace):
 def save_stat_info(args: argparse.Namespace, identity: str,
                    history, final_eval, extras=None,
                    cost=None, eval_client_ids=None,
-                   avg_inference_flops: float = 0.0) -> Optional[str]:
+                   avg_inference_flops: float = 0.0,
+                   fault_counters=None) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -486,6 +498,11 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         "sum_comm_params": getattr(cost, "sum_comm_params", 0),
         "avg_inference_flops": avg_inference_flops,
     }
+    if fault_counters is not None:
+        # run-level fault/recovery totals (clients_dropped,
+        # clients_quarantined, rounds_retried/skipped,
+        # checkpoint_save_failures)
+        stat_info["fault_recovery"] = dict(fault_counters)
     if eval_client_ids is not None:
         # sampled-eval mode: per-client eval outputs are indexed by subset
         # position; persist the client-id mapping alongside them
@@ -529,7 +546,7 @@ def _cost_round_record(algo, cost, samples_per_client, state):
 
 def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                       ev_every, cost, samples_per_client, history,
-                      ckpt_mgr=None, args=None):
+                      ckpt_mgr=None, args=None, counters=None):
     """The runner's fused round loop (--fuse_rounds K): the shared
     block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
     accounting. Masks are static here (evolving-mask algorithms are
@@ -549,6 +566,8 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
         if crec is not None:
             rec["sum_training_flops"] = crec["sum_training_flops"]
             rec["sum_comm_params"] = crec["sum_comm_params"]
+        if counters is not None:
+            counters.update(rec)
         history.append(rec)
         logger.info("%s round %d: %s", algo_name, r, rec)
 
@@ -603,7 +622,10 @@ def run_experiment(args: argparse.Namespace,
             pid = getattr(args, "process_id", -1)
             if initialize_distributed(
                     coordinator_address=coord, num_processes=nproc,
-                    process_id=pid if pid >= 0 else None):
+                    process_id=pid if pid >= 0 else None,
+                    timeout_s=getattr(args, "multihost_timeout_s", 0.0)
+                    or None,
+                    max_retries=getattr(args, "multihost_retries", 2)):
                 mh_mesh, gdata = build_multihost_data(args)
             else:
                 # --multihost was explicit; training alone while believing
@@ -688,13 +710,53 @@ def run_experiment(args: argparse.Namespace,
         # record is floated+logged only after round r+1's programs are
         # dispatched, so the per-round eval costs its ~21 ms of device
         # time instead of a ~110 ms tunnel sync
-        from ..utils.records import DeferredRecords, to_float
+        from ..utils.records import DeferredRecords, RunCounters, to_float
 
-        deferred = DeferredRecords(
-            log=lambda rec: logger.info(
-                "%s round %s: %s", algo_name, rec["round"], rec))
+        # fault/recovery accounting: per-round counters accumulated into
+        # stat_info (clients_dropped / clients_quarantined)
+        counters = RunCounters()
+
+        def _emit(rec):
+            # counters accumulate at FLUSH time, when DeferredRecords has
+            # already materialized the record's device scalars — counting
+            # in the round loop would host-sync the guard counters every
+            # round and defeat the one-round-deferred pipelining
+            counters.update(rec)
+            logger.info("%s round %s: %s", algo_name, rec["round"], rec)
+
+        deferred = DeferredRecords(log=_emit)
 
         fuse = max(1, getattr(args, "fuse_rounds", 1) or 1)
+        watchdog = None
+        if getattr(args, "watchdog", 0):
+            # host-side divergence watchdog with rollback-retry
+            # (robust/recovery.py). Per-round host control is exactly what
+            # fusion removes, so the combination is refused outright.
+            if fuse > 1:
+                raise SystemExit(
+                    "--watchdog rolls rounds back and retries them — "
+                    "per-round host control that --fuse_rounds removes; "
+                    "use --fuse_rounds 1 (or --watchdog 0)")
+            from ..robust.recovery import RoundWatchdog
+
+            retries = getattr(args, "max_round_retries", 2)
+            if algo.clients_per_round == algo.num_clients and retries:
+                # full participation has no alternative cohort to
+                # re-sample, and run_round is deterministic in
+                # (state, round) — a retry would re-run the identical
+                # failed computation; go straight to the skip verdict
+                logger.info(
+                    "watchdog: full participation — retries are "
+                    "deterministic re-runs, short-circuiting to skip")
+                retries = 0
+            watchdog = RoundWatchdog(
+                max_retries=retries,
+                backoff_s=getattr(args, "retry_backoff_s", 0.0),
+                loss_threshold=getattr(args, "watchdog_loss", 0.0),
+                norm_threshold=getattr(args, "watchdog_norm", 0.0),
+                ckpt_mgr=ckpt_mgr,
+                template_fn=lambda: algo.init_state(
+                    jax.random.PRNGKey(args.seed)))
         if fuse > 1:
             # K-round fused programs (FedAlgorithm.run_rounds_fused): one
             # dispatch + one metric fetch per block. Per-round host
@@ -718,15 +780,41 @@ def run_experiment(args: argparse.Namespace,
                 max(start_round, args.comm_round), fuse,
                 args.frequency_of_the_test or 0, cost,
                 samples_per_client, history,
-                ckpt_mgr=ckpt_mgr, args=args)
+                ckpt_mgr=ckpt_mgr, args=args, counters=counters)
             final_eval = None  # re-evaluated once below
 
         try:
-            for r in ([] if fuse > 1 else
-                      range(start_round, max(start_round,
-                                             args.comm_round))):
-                state, rec = algo.run_round(state, r)
+            from ..robust import recovery as _recovery
+
+            r = start_round
+            end_round = (start_round if fuse > 1
+                         else max(start_round, args.comm_round))
+            while r < end_round:
+                if watchdog is not None:
+                    # retry attempts re-sample the cohort (nonce 0 = the
+                    # reference's seeded draw, bit-compatible)
+                    algo.set_retry_nonce(watchdog.retries_at(r))
+                new_state, rec = algo.run_round(state, r)
                 record = {"round": r, **dict(rec)}
+                if watchdog is not None:
+                    verdict = watchdog.judge(r, record, new_state, state)
+                    if verdict == _recovery.RETRY:
+                        # faults observed in the discarded attempt still
+                        # happened — count them here (the record never
+                        # reaches the deferred emitter); the watchdog
+                        # already host-synced this attempt's metrics, so
+                        # this adds no extra sync
+                        counters.update(record)
+                        # the pre-round state in hand IS last-good; the
+                        # checkpoint lineage (saved only after OK/SKIP
+                        # verdicts) backs it for cross-process recovery
+                        state = watchdog.rollback(state)
+                        continue
+                    if verdict == _recovery.SKIP:
+                        new_state = state  # degrade: carry last-good
+                        record["round_skipped"] = 1.0
+                    record.update(watchdog.round_counters())
+                state = new_state
                 crec = _cost_round_record(
                     algo, cost, samples_per_client, state)
                 if crec is not None:
@@ -740,10 +828,13 @@ def run_experiment(args: argparse.Namespace,
                         k: v for k, v in final_eval.items()
                         if not k.startswith("acc_per")})
                 history.append(record)
-                deferred.push(record)
+                deferred.push(record)  # counters accumulate at flush
                 if ckpt_mgr is not None:
                     ckpt_mgr.save(r + 1, state,
                                   metadata=_ckpt_metadata(args, algo, cost))
+                r += 1
+            if watchdog is not None:
+                algo.set_retry_nonce(0)
         except BaseException:
             deferred.flush_safely()  # emit the last completed round
             raise
@@ -804,11 +895,18 @@ def run_experiment(args: argparse.Namespace,
             except Exception:  # cost model unavailable on exotic models
                 logger.debug("inference-FLOPs counting skipped",
                              exc_info=True)
+        fault_totals = counters.summary()
+        if watchdog is not None:
+            fault_totals.update(watchdog.totals())
+        if ckpt_mgr is not None:
+            fault_totals["checkpoint_save_failures"] = float(
+                ckpt_mgr.save_failures)
         stat_path = save_stat_info(
             args, identity, history, final_eval, extras, cost=cost,
             eval_client_ids=(np.asarray(algo._eval_idx)
                              if algo._eval_idx is not None else None),
-            avg_inference_flops=avg_inf)
+            avg_inference_flops=avg_inf,
+            fault_counters=fault_totals)
         return {
             "identity": identity,
             "history": history,

@@ -22,7 +22,8 @@ here is exercised by the CPU test mesh too.
 from __future__ import annotations
 
 import logging
-from typing import Any, Optional
+import time
+from typing import Any, Callable, Optional, TypeVar
 
 import jax
 import numpy as np
@@ -30,11 +31,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
 
+_T = TypeVar("_T")
+
+
+def _with_retries(what: str, fn: Callable[[], _T],
+                  max_retries: int = 2,
+                  backoff_s: float = 5.0) -> _T:
+    """Bounded retries for STARTUP host-sync points (the jax.distributed
+    coordinator handshake, where every process retries in lockstep until
+    the coordinator appears): transient runtime/IO errors retry with
+    linear backoff, the final failure propagates. Mid-run collectives
+    are NEVER retried per-process (see host_client_counts) — that would
+    break the SPMD collective-matching invariant."""
+    retries = max(0, int(max_retries))
+    delay = float(backoff_s)
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except (RuntimeError, OSError, TimeoutError) as e:
+            if attempt >= retries:
+                raise
+            logger.warning(
+                "%s failed (%s: %s); retry %d/%d in %.1fs", what,
+                type(e).__name__, e, attempt + 1, retries,
+                delay * (attempt + 1))
+            time.sleep(delay * (attempt + 1))
+    raise RuntimeError(f"unreachable: {what} retry loop")  # pragma: no cover
+
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+    max_retries: Optional[int] = None,
 ) -> bool:
     """Idempotent ``jax.distributed.initialize`` wrapper.
 
@@ -42,21 +72,71 @@ def initialize_distributed(
     environment; pass them explicitly for CPU/GPU clusters. Returns True if
     a multi-process runtime is active after the call.
 
+    ``timeout_s`` bounds the coordinator handshake (older jax without the
+    ``initialization_timeout`` parameter falls back to its default), and
+    transient init failures retry under ``max_retries`` bounded retries
+    with linear backoff — a slow coordinator degrades to a few logged
+    retries instead of hanging the whole SLURM allocation.
+
     MUST run before anything initializes the XLA backend (even
     ``jax.devices()``/``jax.process_count()`` counts) — which is also why
     this function itself touches no backend state before calling
     ``jax.distributed.initialize``.
     """
     explicit = not (coordinator_address is None and num_processes is None)
-    try:
+
+    class _Permanent(Exception):
+        """Non-transient init outcome — bypasses the retry loop."""
+
+    def _init_once() -> None:
+        kw = {}
         if explicit:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
-        else:
-            jax.distributed.initialize()
+            kw = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+        try:
+            if timeout_s:
+                try:
+                    # ceil, floor 1: int() truncation would turn a
+                    # sub-second --multihost_timeout_s into an instant
+                    # zero-second handshake timeout
+                    jax.distributed.initialize(
+                        initialization_timeout=max(
+                            1, int(-(-float(timeout_s) // 1))), **kw)
+                    return
+                except TypeError:
+                    logger.warning(
+                        "this jax has no initialization_timeout parameter;"
+                        " using its default handshake timeout")
+            jax.distributed.initialize(**kw)
+        except (RuntimeError, OSError, TimeoutError) as e:
+            msg = str(e)
+            if isinstance(e, RuntimeError) and (
+                    ("already" in msg and "initialize" in msg) or
+                    ("before" in msg and "XLA backend" in msg) or
+                    "only be called once" in msg):
+                raise _Permanent() from e  # retrying cannot change these
+            # transient failure (connect timeout, coordinator refused —
+            # any of the retryable error types): jax assigns
+            # global_state.client BEFORE the connect, so without a
+            # shutdown the re-attempt would die with 'initialize should
+            # only be called once' instead of retrying the handshake
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # never-connected client; nothing to undo
+                logger.debug("post-failure distributed shutdown noop",
+                             exc_info=True)
+            raise
+
+    try:
+        try:
+            _with_retries(
+                "jax.distributed.initialize", _init_once,
+                # auto-detect mode never retries (a missing cluster env
+                # is not transient); None = the default budget
+                max_retries=(max_retries if max_retries is not None
+                             else 2) if explicit else 0)
+        except _Permanent as p:
+            raise p.__cause__  # classified below exactly as before
     except RuntimeError as e:
         msg = str(e)
         if "already" in msg and "initialize" in msg:
@@ -198,6 +278,14 @@ def host_client_counts(n) -> np.ndarray:
     shards = sorted(n.addressable_shards,
                     key=lambda s: (s.index[0].start or 0))
     local = np.concatenate([np.asarray(s.data).ravel() for s in shards])
+    # NOTE deliberately NOT retried: a mid-run collective must execute in
+    # lockstep across processes — one host re-issuing its allgather while
+    # peers (which succeeded) have moved on would hang against no
+    # counterpart or pair with a LATER collective and garble data. The
+    # bounded-retry policy (_with_retries) applies only to the startup
+    # handshake (initialize_distributed), where every process is retrying
+    # until the coordinator appears; mid-run sync points are protected by
+    # the init-time timeout instead (a failure here fails fast).
     gathered = multihost_utils.process_allgather(local)
     return np.asarray(gathered).ravel()
 

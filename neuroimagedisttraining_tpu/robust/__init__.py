@@ -4,10 +4,29 @@ from .aggregation import (
     norm_diff_clipping,
     vectorize_weights,
 )
+from .faults import FaultSpec, make_fault_fn, parse_fault_spec
+from .guard import (
+    carry_if_empty,
+    finite_screen,
+    guarded_aggregate,
+    merge_updates,
+    quarantine,
+)
+from .recovery import RoundWatchdog, tree_finite
 
 __all__ = [
     "RobustAggregator",
     "add_gaussian_noise",
     "norm_diff_clipping",
     "vectorize_weights",
+    "FaultSpec",
+    "make_fault_fn",
+    "parse_fault_spec",
+    "carry_if_empty",
+    "finite_screen",
+    "guarded_aggregate",
+    "merge_updates",
+    "quarantine",
+    "RoundWatchdog",
+    "tree_finite",
 ]

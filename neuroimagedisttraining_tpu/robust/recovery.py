@@ -1,0 +1,186 @@
+"""Round-granular recovery: the divergence watchdog + rollback-retry loop.
+
+The in-jit guard (``robust.guard``) catches *non-finite* poison; this
+host-side watchdog catches the faults the guard cannot see — a finite but
+diverging aggregate (Byzantine scaled updates that slip under the clip
+bound, a genuinely unstable round) — and recovers at round granularity:
+
+1. after every round the driver asks the watchdog to ``judge`` the round's
+   metrics (train loss finiteness, optional loss / update-norm
+   thresholds);
+2. an unhealthy round is NOT adopted: the driver rolls back to the
+   last-good state (the pre-round state it still holds; after a process
+   loss, the checkpoint lineage — which only ever contains
+   watchdog-approved states, because the runner saves AFTER the verdict)
+   and retries the round with a re-sampled cohort
+   (``sample_client_indexes(..., retry=k)``) under bounded retries with
+   linear backoff;
+3. a round still unhealthy after ``max_retries`` is SKIPPED: the
+   last-good state carries forward (training degrades to a no-op round
+   instead of dying), and the skip is counted.
+
+Determinism: verdicts are pure functions of deterministic round metrics,
+and retry cohorts are seeded by (round, retry) — so a killed-and-resumed
+run replays the identical retry/skip sequence and lands on bit-identical
+parameters (tests/test_faults.py pins it).
+
+Detection-lag caveat: ``train_loss`` is measured DURING round r's local
+training, i.e. against the round r-1 aggregate — so the default
+loss-only checks flag a poisoned aggregate one round LATE, after it has
+already been adopted (and checkpointed) as last-good; rollback then
+re-trains from the poisoned state and cannot recover. To catch a
+finite-divergent (Byzantine-scaled) aggregate in the SAME round it is
+produced — before adoption — set ``--watchdog_norm``: the global-update
+L2 norm is a property of the candidate aggregate itself. The non-finite
+case needs no threshold: the in-jit guard (robust/guard.py) quarantines
+it before aggregation ever sees it.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+OK = "ok"
+RETRY = "retry"
+SKIP = "skip"
+
+
+def _global_update_norm(new_state: Any, prev_state: Any) -> Optional[float]:
+    """L2 norm of the global-model update, or None when the state has no
+    ``global_params`` (decentralized algorithms)."""
+    new = getattr(new_state, "global_params", None)
+    old = getattr(prev_state, "global_params", None)
+    if new is None or old is None:
+        return None
+    import jax
+
+    sq = sum(jnp.sum(jnp.square(a - b)) for a, b in zip(
+        jax.tree_util.tree_leaves(new), jax.tree_util.tree_leaves(old)))
+    return float(jnp.sqrt(sq))
+
+
+class RoundWatchdog:
+    """Divergence watchdog with bounded rollback-retry.
+
+    ``loss_threshold`` / ``norm_threshold`` of 0 disable the magnitude
+    checks; non-finite train loss (or update norm, when the norm check is
+    on) always trips. ``sleep`` is injectable for tests.
+    """
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.0,
+                 loss_threshold: float = 0.0, norm_threshold: float = 0.0,
+                 ckpt_mgr=None,
+                 template_fn: Optional[Callable[[], Any]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.loss_threshold = float(loss_threshold)
+        self.norm_threshold = float(norm_threshold)
+        self.ckpt_mgr = ckpt_mgr
+        self.template_fn = template_fn
+        self._sleep = sleep
+        # cumulative run counters (flow into records / stat_info)
+        self.rounds_retried = 0
+        self.rounds_skipped = 0
+        # per-round retry state
+        self._round: Optional[int] = None
+        self._retries = 0
+
+    def retries_at(self, round_idx: int) -> int:
+        """Retry nonce for this attempt of ``round_idx`` (0 on the first
+        attempt); resets when the driver moves to a new round."""
+        if round_idx != self._round:
+            self._round = round_idx
+            self._retries = 0
+        return self._retries
+
+    def healthy(self, record: Dict[str, Any], new_state: Any,
+                prev_state: Any) -> bool:
+        """Whether the round's outcome passes every enabled check. Reads
+        ``record['train_loss']`` (materializes the device scalar — the
+        watchdog deliberately trades the deferred-fetch pipelining for
+        per-round verdicts; it is opt-in)."""
+        loss = record.get("train_loss")
+        if loss is not None:
+            loss = float(loss)
+            record["train_loss"] = loss  # already materialized; keep it
+            if not math.isfinite(loss):
+                return False
+            if self.loss_threshold and loss > self.loss_threshold:
+                return False
+        if self.norm_threshold:
+            norm = _global_update_norm(new_state, prev_state)
+            if norm is not None and (
+                    not math.isfinite(norm) or norm > self.norm_threshold):
+                return False
+        return True
+
+    def judge(self, round_idx: int, record: Dict[str, Any], new_state: Any,
+              prev_state: Any) -> str:
+        """Verdict for this attempt of ``round_idx``: OK (adopt), RETRY
+        (roll back, re-sample, re-run), or SKIP (retries exhausted — carry
+        the last-good state)."""
+        self.retries_at(round_idx)  # (re)initialize per-round state
+        if self.healthy(record, new_state, prev_state):
+            return OK
+        if self._retries < self.max_retries:
+            self._retries += 1
+            self.rounds_retried += 1
+            logger.warning(
+                "watchdog: round %d unhealthy (train_loss=%s); rolling "
+                "back and retrying with a re-sampled cohort (%d/%d)",
+                round_idx, record.get("train_loss"), self._retries,
+                self.max_retries)
+            if self.backoff_s:
+                self._sleep(self.backoff_s * self._retries)
+            return RETRY
+        self.rounds_skipped += 1
+        logger.error(
+            "watchdog: round %d still unhealthy after %d retries; "
+            "carrying the last-good state (round skipped)",
+            round_idx, self.max_retries)
+        return SKIP
+
+    def rollback(self, prev_state: Any) -> Any:
+        """The state to retry from. The driver normally still holds the
+        pre-round (last-good) state — rolling back is then free. When it
+        does not (``None`` — e.g. recovery after a device loss), restore
+        the newest checkpoint: the lineage only ever contains
+        watchdog-approved states, so 'latest checkpoint' IS 'last good'."""
+        if prev_state is not None:
+            return prev_state
+        if self.ckpt_mgr is None or self.template_fn is None:
+            raise RuntimeError(
+                "watchdog rollback: no in-memory last-good state and no "
+                "checkpoint manager to restore from")
+        restored = self.ckpt_mgr.restore_latest(self.template_fn())
+        if restored is None:
+            raise RuntimeError(
+                "watchdog rollback: checkpoint directory is empty")
+        state, step = restored
+        logger.warning("watchdog: rolled back to checkpoint step %d", step)
+        return state
+
+    def round_counters(self) -> Dict[str, float]:
+        """Per-round record fields (float — the packed-metric contract)."""
+        return {"rounds_retried": float(self._retries)}
+
+    def totals(self) -> Dict[str, float]:
+        return {"rounds_retried": float(self.rounds_retried),
+                "rounds_skipped": float(self.rounds_skipped)}
+
+
+def tree_finite(tree: Any) -> bool:
+    """Host-side convenience: every leaf of ``tree`` all-finite (used by
+    chaos tooling to assert a final state is clean)."""
+    import jax
+
+    return all(bool(np.all(np.isfinite(np.asarray(x))))
+               for x in jax.tree_util.tree_leaves(tree))

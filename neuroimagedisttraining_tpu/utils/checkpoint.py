@@ -31,6 +31,10 @@ class CheckpointManager:
         os.makedirs(path, exist_ok=True)
         self.directory = path
         self.save_every = max(1, save_every)
+        #: best-effort save failures so far (``checkpoint_save_failures``
+        #: in stat_info) — a disk hiccup must not kill the run this
+        #: manager exists to protect
+        self.save_failures = 0
         self.mgr = ocp.CheckpointManager(
             path,
             options=ocp.CheckpointManagerOptions(
@@ -40,7 +44,11 @@ class CheckpointManager:
 
     def save(self, round_idx: int, state: Any, force: bool = False,
              metadata: Optional[dict] = None) -> bool:
-        """Save ``state`` under step ``round_idx`` (respects save_every).
+        """Best-effort save of ``state`` under step ``round_idx``
+        (respects save_every): an orbax/disk failure (ENOSPC, a flaky
+        network filesystem, a GC race) logs a warning, bumps
+        ``save_failures``, and lets training continue — the previous
+        retained steps still cover a later resume.
 
         ``metadata``: small JSON-serializable sidecar saved next to the
         step (e.g. cumulative cost counters — for evolving-mask algorithms
@@ -49,36 +57,48 @@ class CheckpointManager:
         final density)."""
         if not force and round_idx % self.save_every:
             return False
-        self.mgr.save(
-            round_idx, args=self._ocp.args.StandardSave(state))
-        self.mgr.wait_until_finished()
-        if metadata is not None:
-            import json
-            import os
-
-            path = os.path.join(self.directory, f"meta_{round_idx}.json")
-            tmp = path + ".tmp"
-            # atomic publish: a SIGKILL mid-write (the SLURM-preemption case
-            # this checkpointing exists for) must not leave a truncated
-            # sidecar that breaks every subsequent --resume
-            with open(tmp, "w") as f:
-                json.dump(metadata, f)
-            os.replace(tmp, path)
-            # prune sidecars whose orbax step was garbage-collected
-            # (max_to_keep), so a long run doesn't accumulate thousands of
-            # orphaned meta files
-            alive = set(self.mgr.all_steps())
-            import glob as _glob
-            import re as _re
-
-            for p in _glob.glob(os.path.join(self.directory, "meta_*.json")):
-                m = _re.match(r"meta_(\d+)\.json$", os.path.basename(p))
-                if m and int(m.group(1)) not in alive:
-                    try:
-                        os.unlink(p)
-                    except OSError:
-                        pass
+        try:
+            self.mgr.save(
+                round_idx, args=self._ocp.args.StandardSave(state))
+            self.mgr.wait_until_finished()
+            if metadata is not None:
+                self._save_metadata(round_idx, metadata)
+        except Exception:
+            self.save_failures += 1
+            logger.warning(
+                "checkpoint save at step %d failed "
+                "(checkpoint_save_failures=%d); training continues on the "
+                "previously retained steps", round_idx, self.save_failures,
+                exc_info=True)
+            return False
         return True
+
+    def _save_metadata(self, round_idx: int, metadata: dict) -> None:
+        import json
+        import os
+
+        path = os.path.join(self.directory, f"meta_{round_idx}.json")
+        tmp = path + ".tmp"
+        # atomic publish: a SIGKILL mid-write (the SLURM-preemption case
+        # this checkpointing exists for) must not leave a truncated
+        # sidecar that breaks every subsequent --resume
+        with open(tmp, "w") as f:
+            json.dump(metadata, f)
+        os.replace(tmp, path)
+        # prune sidecars whose orbax step was garbage-collected
+        # (max_to_keep), so a long run doesn't accumulate thousands of
+        # orphaned meta files
+        alive = set(self.mgr.all_steps())
+        import glob as _glob
+        import re as _re
+
+        for p in _glob.glob(os.path.join(self.directory, "meta_*.json")):
+            m = _re.match(r"meta_(\d+)\.json$", os.path.basename(p))
+            if m and int(m.group(1)) not in alive:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
     def load_metadata(self, round_idx: int) -> Optional[dict]:
         import json
@@ -99,32 +119,49 @@ class CheckpointManager:
         return self.mgr.latest_step()
 
     def restore_latest(self, template: Any) -> Optional[Tuple[Any, int]]:
-        """Restore the newest checkpoint, shaped like ``template`` (an
-        ``algo.init_state(...)`` pytree); returns (state, round_idx) or
-        None when the directory is empty."""
-        step = self.mgr.latest_step()
-        if step is None:
+        """Restore the newest restorable checkpoint, shaped like
+        ``template`` (an ``algo.init_state(...)`` pytree); returns
+        (state, round_idx) or None when the directory is empty.
+
+        An unrestorable newest step (partial write from a SIGKILL
+        mid-commit — exactly the preemption case checkpointing exists
+        for) falls back to the next older retained step, logging which
+        step was skipped; only when EVERY retained step fails does the
+        error propagate (with the schema-mismatch diagnosis, its most
+        common cause)."""
+        steps = sorted(self.mgr.all_steps(), reverse=True)
+        if not steps:
             return None
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
             if hasattr(x, "shape") else x,
             template,
         )
-        try:
-            state = self.mgr.restore(
-                step, args=self._ocp.args.StandardRestore(abstract))
-        except (KeyError, ValueError, TypeError) as e:
-            # most common cause: the state schema changed between framework
-            # versions (e.g. a new field on an algorithm's State dataclass)
-            raise RuntimeError(
-                f"checkpoint at {self.directory} step {step} does not match "
-                "the current state structure — it was likely written by an "
-                "older framework version. Restart without --resume (or point "
-                "--checkpoint_dir elsewhere) to begin a fresh lineage."
-            ) from e
-        logger.info("restored checkpoint step %d from %s", step,
-                    self.directory)
-        return state, step
+        last_err: Optional[Exception] = None
+        for step in steps:
+            try:
+                state = self.mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract))
+            except Exception as e:
+                last_err = e
+                logger.warning(
+                    "checkpoint step %d at %s is unrestorable (%s: %s); "
+                    "falling back to the next older retained step",
+                    step, self.directory, type(e).__name__, e)
+                continue
+            logger.info("restored checkpoint step %d from %s", step,
+                        self.directory)
+            return state, step
+        # every retained step failed: most common cause is a state-schema
+        # change between framework versions (e.g. a new field on an
+        # algorithm's State dataclass)
+        raise RuntimeError(
+            f"no retained checkpoint at {self.directory} is restorable "
+            f"(tried steps {steps}) — if every step fails the same way, "
+            "the lineage was likely written by an older framework version "
+            "whose state structure no longer matches. Restart without "
+            "--resume (or point --checkpoint_dir elsewhere) to begin a "
+            "fresh lineage.") from last_err
 
     def close(self) -> None:
         self.mgr.close()

@@ -67,3 +67,33 @@ class DeferredRecords:
             self.flush()
         except Exception:  # pragma: no cover - device-loss path
             self._pending = None
+
+
+class RunCounters:
+    """Run-level fault/recovery totals, accumulated from per-round records.
+
+    The fault-tolerance subsystem (robust/faults.py, robust/guard.py)
+    emits its per-round counters as ordinary float record fields
+    (``clients_dropped``, ``clients_quarantined``); both round-loop
+    drivers feed records through :meth:`update` — including attempts the
+    watchdog rolled back, so totals cover every fault that occurred —
+    and :meth:`summary` lands in stat_info as ``fault_recovery``
+    (alongside the watchdog's own ``rounds_retried``/``rounds_skipped``
+    totals, which are authoritative for retry accounting). Values may
+    still be device scalars when a record is pushed (DeferredRecords
+    materializes late) — ``to_float`` handles both."""
+
+    FIELDS = ("clients_dropped", "clients_quarantined")
+
+    def __init__(self) -> None:
+        self._totals: Dict[str, float] = {}
+
+    def update(self, record: Dict[str, Any]) -> None:
+        for field in self.FIELDS:
+            v = record.get(field)
+            if v is not None:
+                self._totals[field] = self._totals.get(field, 0.0) + \
+                    float(to_float(v))
+
+    def summary(self) -> Dict[str, float]:
+        return dict(self._totals)

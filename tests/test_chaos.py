@@ -1,0 +1,221 @@
+"""Fast tier-1 chaos coverage: the chaos smoke path at CI scale, the
+divergence watchdog's rollback-retry-skip ladder, and the checkpoint
+satellites (best-effort save, corrupt-step restore fallback)."""
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.experiments import parse_args, run_experiment
+from neuroimagedisttraining_tpu.robust.recovery import (
+    OK,
+    RETRY,
+    SKIP,
+    RoundWatchdog,
+)
+from neuroimagedisttraining_tpu.utils.checkpoint import CheckpointManager
+
+
+def _argv(tmp_path, **over):
+    base = {
+        "--model": "small3dcnn", "--dataset": "synthetic",
+        "--client_num_in_total": "4", "--batch_size": "8",
+        "--epochs": "1", "--comm_round": "3", "--lr": "0.05",
+        "--log_dir": str(tmp_path / "LOG"),
+        "--results_dir": str(tmp_path / "results"),
+        "--final_finetune": "0",
+    }
+    base.update(over)
+    argv = []
+    for k, v in base.items():
+        argv += [k, v]
+    return argv
+
+
+def test_chaos_smoke_ci_scale(tmp_path):
+    """The scripts/chaos_smoke.py contract at CI scale: injected dropout
+    + NaN, run completes, final loss finite, counters recorded."""
+    args = parse_args(_argv(
+        tmp_path, **{"--fault_spec": "drop=0.25,straggle=0.1,nan=0.2"}),
+        algo="fedavg")
+    out = run_experiment(args, "fedavg")
+    hist = [h for h in out["history"] if "train_loss" in h]
+    assert len(hist) == 3
+    assert all(math.isfinite(float(h["train_loss"])) for h in hist)
+    assert math.isfinite(float(out["final_eval"]["global_loss"]))
+    for x in jax.tree_util.tree_leaves(out["state"].global_params):
+        assert np.all(np.isfinite(np.asarray(x)))
+    assert all("clients_dropped" in h and "clients_quarantined" in h
+               and "rounds_retried" in h for h in hist)
+    assert sum(float(h["clients_dropped"])
+               + float(h["clients_quarantined"]) for h in hist) > 0
+
+
+def test_watchdog_recovers_genuine_divergence(tmp_path):
+    """A deliberately divergent config (huge lr, loss explodes to
+    non-finite): the watchdog retries then skips every bad round, the
+    run COMPLETES with finite recorded metrics — degrade, don't die."""
+    args = parse_args(_argv(tmp_path, **{
+        "--lr": "1e8", "--frac": "0.5", "--client_num_in_total": "8",
+        "--watchdog": "1", "--watchdog_loss": "10.0",
+        "--max_round_retries": "1",
+        "--comm_round": "2"}), algo="fedavg")
+    out = run_experiment(args, "fedavg")
+    hist = [h for h in out["history"] if "rounds_retried" in h]
+    assert len(hist) == 2
+    # every round was retried once then skipped (divergence is global)
+    assert all(float(h["rounds_retried"]) == 1.0 for h in hist)
+    assert all(h.get("round_skipped") == 1.0 for h in hist)
+    # the carried last-good state is the (finite) init state
+    for x in jax.tree_util.tree_leaves(out["state"].global_params):
+        assert np.all(np.isfinite(np.asarray(x)))
+    fr = None
+    import pickle
+
+    with open(out["stat_path"], "rb") as f:
+        fr = pickle.load(f)["fault_recovery"]
+    assert fr["rounds_retried"] == 2.0
+    assert fr["rounds_skipped"] == 2.0
+
+
+def test_watchdog_judge_ladder():
+    """OK -> RETRY x max -> SKIP, with deterministic counters."""
+    naps = []
+    wd = RoundWatchdog(max_retries=2, backoff_s=1.0, sleep=naps.append)
+    good = {"train_loss": 0.5}
+
+    class S:
+        global_params = None
+
+    assert wd.judge(0, dict(good), S(), S()) == OK
+    bad = {"train_loss": float("nan")}
+    assert wd.judge(1, dict(bad), S(), S()) == RETRY
+    assert wd.judge(1, dict(bad), S(), S()) == RETRY
+    assert wd.judge(1, dict(bad), S(), S()) == SKIP
+    assert naps == [1.0, 2.0]  # linear backoff
+    assert wd.rounds_retried == 2 and wd.rounds_skipped == 1
+    # threshold checks
+    wd2 = RoundWatchdog(max_retries=0, loss_threshold=1.0)
+    assert wd2.judge(0, {"train_loss": 2.0}, S(), S()) == SKIP
+    assert wd2.judge(1, {"train_loss": 0.9}, S(), S()) == OK
+
+
+def test_watchdog_retry_resamples_cohort():
+    from neuroimagedisttraining_tpu.algorithms.base import (
+        sample_client_indexes,
+    )
+
+    base = sample_client_indexes(5, 100, 10)
+    again = sample_client_indexes(5, 100, 10)
+    assert np.array_equal(base, again)  # reference contract intact
+    r1 = sample_client_indexes(5, 100, 10, retry=1)
+    r2 = sample_client_indexes(5, 100, 10, retry=2)
+    assert not np.array_equal(base, r1)
+    assert not np.array_equal(r1, r2)
+    # deterministic per (round, retry) — the resume-replay property
+    assert np.array_equal(r1, sample_client_indexes(5, 100, 10, retry=1))
+    # full participation has no alternative cohort
+    assert np.array_equal(sample_client_indexes(3, 8, 8, retry=2),
+                          np.arange(8))
+
+
+def test_watchdog_rollback_prefers_memory_then_checkpoint(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "wd")
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mgr.save(1, state, force=True)
+    wd = RoundWatchdog(ckpt_mgr=mgr, template_fn=lambda: state)
+    # in-memory last-good wins
+    sentinel = object()
+    assert wd.rollback(sentinel) is sentinel
+    # no in-memory state: restore the checkpoint lineage
+    restored = wd.rollback(None)
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    mgr.close()
+
+
+# -- checkpoint satellites ---------------------------------------------------
+
+def test_checkpoint_save_is_best_effort(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "be")
+    state = {"w": np.ones((3,), np.float32)}
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    orig = mgr.mgr.save
+    mgr.mgr.save = boom
+    assert mgr.save(1, state, force=True) is False  # no raise
+    assert mgr.save_failures == 1
+    mgr.mgr.save = orig
+    assert mgr.save(2, state, force=True) is True  # recovered
+    assert mgr.save_failures == 1
+    restored = mgr.restore_latest(state)
+    assert restored is not None and restored[1] == 2
+    mgr.close()
+
+
+def test_restore_latest_falls_back_to_older_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "fb", save_every=1)
+    state1 = {"w": np.full((3,), 1.0, np.float32)}
+    state2 = {"w": np.full((3,), 2.0, np.float32)}
+    assert mgr.save(1, state1, force=True)
+    assert mgr.save(2, state2, force=True)
+
+    orig = mgr.mgr.restore
+
+    def corrupt_newest(step, *a, **k):
+        if step == 2:
+            raise ValueError("partial write: missing array chunk")
+        return orig(step, *a, **k)
+
+    mgr.mgr.restore = corrupt_newest
+    restored = mgr.restore_latest(state1)
+    assert restored is not None
+    state, step = restored
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]), state1["w"])
+    mgr.close()
+
+
+def test_restore_latest_raises_when_every_step_corrupt(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "allbad")
+    state = {"w": np.ones((2,), np.float32)}
+    mgr.save(1, state, force=True)
+
+    def boom(step, *a, **k):
+        raise ValueError("corrupt")
+
+    mgr.mgr.restore = boom
+    with pytest.raises(RuntimeError, match="no retained checkpoint"):
+        mgr.restore_latest(state)
+    mgr.close()
+
+
+def test_restore_latest_survives_on_disk_corruption(tmp_path):
+    """Real on-disk damage (every file of the newest step overwritten —
+    a torn write): resume falls back to the older step instead of
+    dying."""
+    import os
+
+    mgr = CheckpointManager(str(tmp_path), "disk", save_every=1)
+    state1 = {"w": np.full((3,), 1.0, np.float32)}
+    state2 = {"w": np.full((3,), 2.0, np.float32)}
+    mgr.save(1, state1, force=True)
+    mgr.save(2, state2, force=True)
+    mgr.close()
+
+    step_dir = os.path.join(str(tmp_path), "disk", "2")
+    assert os.path.isdir(step_dir)
+    for dp, _, fs in os.walk(step_dir):
+        for name in fs:
+            with open(os.path.join(dp, name), "wb") as fh:
+                fh.write(b"CORRUPT")
+
+    mgr2 = CheckpointManager(str(tmp_path), "disk", save_every=1)
+    restored = mgr2.restore_latest(state1)
+    assert restored is not None
+    state, step = restored
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]), state1["w"])
+    mgr2.close()

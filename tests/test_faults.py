@@ -1,0 +1,329 @@
+"""Fault injection (robust/faults.py): spec parsing, trace determinism,
+fused/unfused parity, and kill-and-resume replay (ISSUE 2 acceptance:
+the resumed run's fault trace and final parameters are bit-identical to
+an uninterrupted run's)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import FedAvg
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.experiments import parse_args, run_experiment
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.robust.faults import (
+    FaultSpec,
+    make_fault_fn,
+    parse_fault_spec,
+)
+
+CHAOS = "drop=0.25,straggle=0.2,nan=0.25"
+
+
+def _hp(steps=3):
+    return HyperParams(lr=0.05, lr_decay=1.0, momentum=0.0,
+                       weight_decay=0.0, grad_clip=10.0, local_epochs=1,
+                       steps_per_epoch=steps, batch_size=8)
+
+
+def _data(n_clients=4):
+    return make_synthetic_federated(
+        n_clients=n_clients, samples_per_client=24, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2,
+    )
+
+
+def _leaves_equal(t0, t1):
+    # equal_nan: injected NaN poison must compare equal to itself when
+    # pinning trace determinism
+    return all(
+        np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+        for a, b in zip(jax.tree_util.tree_leaves(t0),
+                        jax.tree_util.tree_leaves(t1)))
+
+
+# -- spec parsing ------------------------------------------------------------
+
+def test_parse_fault_spec():
+    assert parse_fault_spec("") is None
+    assert parse_fault_spec(None) is None
+    s = parse_fault_spec("drop=0.2,straggle=0.1,nan=0.05,scale=0.02:100x")
+    assert s == FaultSpec(drop=0.2, straggle=0.1, nan=0.05, scale=0.02,
+                          scale_factor=100.0)
+    assert parse_fault_spec("scale=0.5:7").scale_factor == 7.0
+    assert parse_fault_spec("drop=1").drop == 1.0
+    assert not parse_fault_spec("drop=0").any_active
+
+
+@pytest.mark.parametrize("bad", [
+    "drop", "boom=0.5", "drop=1.5", "drop=-0.1", "drop=0.1,drop=0.2",
+    "scale=0.1:-3x",
+])
+def test_parse_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+# -- injector determinism ----------------------------------------------------
+
+def test_fault_fn_trace_is_seed_and_client_keyed():
+    """Same (seed, round, client) -> same fault, independent of cohort
+    composition — the property resume/retry replay rests on."""
+    spec = parse_fault_spec("drop=0.5,nan=0.3")
+    fn = make_fault_fn(spec, seed=0)
+    tree = {"w": jnp.ones((4, 3)), "b": jnp.zeros((4,))}
+    glob = {"w": jnp.zeros((3,)), "b": jnp.zeros(())}
+    out_a, drop_a = fn(tree, glob, jnp.arange(4), jnp.float32(2))
+    out_b, drop_b = fn(tree, glob, jnp.arange(4), jnp.float32(2))
+    assert np.array_equal(np.asarray(drop_a), np.asarray(drop_b))
+    assert _leaves_equal(out_a, out_b)
+    # client 2's fault is the same whether it sits at row 2 of a 4-cohort
+    # or row 0 of a singleton cohort
+    sub = {"w": jnp.ones((1, 3)), "b": jnp.zeros((1,))}
+    out_c, drop_c = fn(sub, glob, jnp.asarray([2]), jnp.float32(2))
+    assert bool(drop_c[0]) == bool(drop_a[2])
+    assert np.array_equal(np.asarray(out_c["w"][0]),
+                          np.asarray(out_a["w"][2]), equal_nan=True)
+    # a different seed gives a different trace somewhere over many rounds
+    fn2 = make_fault_fn(spec, seed=1)
+    diff = False
+    for r in range(8):
+        _, d0 = fn(tree, glob, jnp.arange(4), jnp.float32(r))
+        _, d1 = fn2(tree, glob, jnp.arange(4), jnp.float32(r))
+        diff = diff or not np.array_equal(np.asarray(d0), np.asarray(d1))
+    assert diff
+
+
+def test_fault_kinds_apply():
+    """Each kind at p=1: nan poisons everything, scale multiplies the
+    delta, straggle shrinks it into [0.25, 0.75), drop only flags."""
+    tree = {"w": jnp.full((2, 3), 2.0)}
+    glob = {"w": jnp.ones((3,))}
+
+    out, dropped = make_fault_fn(FaultSpec(nan=1.0), 0)(
+        tree, glob, jnp.arange(2), jnp.float32(0))
+    assert np.all(np.isnan(np.asarray(out["w"])))
+    assert not np.any(np.asarray(dropped))
+
+    out, _ = make_fault_fn(FaultSpec(scale=1.0, scale_factor=50.0), 0)(
+        tree, glob, jnp.arange(2), jnp.float32(0))
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0 + 1.0 * 50.0,
+                               rtol=1e-6)
+
+    out, _ = make_fault_fn(FaultSpec(straggle=1.0), 0)(
+        tree, glob, jnp.arange(2), jnp.float32(0))
+    frac = np.asarray(out["w"]) - 1.0  # delta was 1.0
+    assert np.all((frac >= 0.25) & (frac < 0.75))
+
+    out, dropped = make_fault_fn(FaultSpec(drop=1.0), 0)(
+        tree, glob, jnp.arange(2), jnp.float32(0))
+    assert np.all(np.asarray(dropped))
+    # drop flags only — the payload passes through BIT-EXACT (no
+    # g + (p - g) round-off smear over unfaulted/dropped clients)
+    assert np.array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+
+
+# -- algorithm-level determinism --------------------------------------------
+
+def test_chaos_run_deterministic_and_finite():
+    data = _data()
+    model = create_model("small3dcnn", num_classes=1)
+
+    def run():
+        algo = FedAvg(model, data, _hp(), loss_type="bce", frac=1.0,
+                      seed=0, fault_spec=CHAOS)
+        s = algo.init_state(jax.random.PRNGKey(0))
+        recs = []
+        for r in range(3):
+            s, rec = algo.run_round(s, r)
+            recs.append({k: float(v) for k, v in rec.items()})
+        return s, recs
+
+    s1, r1 = run()
+    s2, r2 = run()
+    assert r1 == r2
+    assert _leaves_equal(s1.global_params, s2.global_params)
+    assert sum(r["clients_dropped"] + r["clients_quarantined"]
+               for r in r1) > 0  # the spec actually fired
+    for x in jax.tree_util.tree_leaves(s1.global_params):
+        assert np.all(np.isfinite(np.asarray(x)))
+    for x in jax.tree_util.tree_leaves(s1.personal_params):
+        assert np.all(np.isfinite(np.asarray(x)))
+
+
+def test_fused_rounds_replay_identical_fault_trace():
+    """Fused lax.scan blocks and the unfused loop produce the same fault
+    trace and parameters bit-for-bit (fault keys derive from the traced
+    round index, not host state)."""
+    data = _data()
+    model = create_model("small3dcnn", num_classes=1)
+    kw = dict(loss_type="bce", frac=1.0, seed=0, fault_spec=CHAOS)
+
+    a = FedAvg(model, data, _hp(), **kw)
+    sa = a.init_state(jax.random.PRNGKey(0))
+    recs = []
+    for r in range(4):
+        sa, rec = a.run_round(sa, r)
+        recs.append({k: float(v) for k, v in rec.items()})
+
+    b = FedAvg(model, data, _hp(), **kw)
+    sb = b.init_state(jax.random.PRNGKey(0))
+    sb, ys = b.run_rounds_fused(sb, 0, 4, eval_every=0)
+    ys = ys.materialize()
+    for i, rec in enumerate(recs):
+        for k, v in rec.items():
+            assert v == float(ys[k][i]), (i, k)
+    assert _leaves_equal(sa.global_params, sb.global_params)
+    assert _leaves_equal(sa.personal_params, sb.personal_params)
+
+
+def test_no_fault_spec_is_bit_identical_to_plain():
+    """--fault_spec off must leave today's fault-free path untouched
+    (acceptance criterion: bit-identical)."""
+    data = _data()
+    model = create_model("small3dcnn", num_classes=1)
+    plain = FedAvg(model, data, _hp(), loss_type="bce", frac=1.0, seed=0)
+    off = FedAvg(model, data, _hp(), loss_type="bce", frac=1.0, seed=0,
+                 fault_spec="", guard=None)
+    assert off.fault_fn is None and not off.guard_enabled
+    s0 = plain.init_state(jax.random.PRNGKey(0))
+    s1 = off.init_state(jax.random.PRNGKey(0))
+    for r in range(2):
+        s0, m0 = plain.run_round(s0, r)
+        s1, m1 = off.run_round(s1, r)
+        assert float(m0["train_loss"]) == float(m1["train_loss"])
+    assert _leaves_equal(s0.global_params, s1.global_params)
+
+
+# -- the acceptance gate: kill-and-resume mid-chaos --------------------------
+
+def test_resume_mid_chaos_replays_trace_and_params(tmp_path):
+    """Inject faults, 'kill' at round 2 (run with comm_round=2), --resume
+    to 4, and require the replayed trace and final params bit-identical
+    to the uninterrupted 4-round run."""
+    base = ["--model", "small3dcnn", "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--batch_size", "8",
+            "--epochs", "1", "--comm_round", "4", "--lr", "0.05",
+            "--fault_spec", CHAOS,
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results"),
+            "--final_finetune", "0"]
+
+    out_full = run_experiment(parse_args(
+        base + ["--checkpoint_dir", str(tmp_path / "ck_full")],
+        algo="fedavg"), "fedavg")
+
+    ck = str(tmp_path / "ck_kill")
+    run_experiment(parse_args(
+        base[:base.index("4", base.index("--comm_round"))] + ["2"]
+        + base[base.index("4", base.index("--comm_round")) + 1:]
+        + ["--checkpoint_dir", ck], algo="fedavg"), "fedavg")
+    out_res = run_experiment(parse_args(
+        base + ["--checkpoint_dir", ck, "--resume"], algo="fedavg"),
+        "fedavg")
+
+    assert _leaves_equal(out_full["state"].global_params,
+                         out_res["state"].global_params)
+    assert _leaves_equal(out_full["state"].personal_params,
+                         out_res["state"].personal_params)
+    full = {h["round"]: h for h in out_full["history"]}
+    for h in out_res["history"]:
+        ref = full[h["round"]]
+        for k in ("train_loss", "clients_dropped", "clients_quarantined"):
+            assert float(h[k]) == float(ref[k]), (h["round"], k)
+    # the replayed rounds really injected something across the run
+    assert sum(float(h.get("clients_dropped", 0))
+               + float(h.get("clients_quarantined", 0))
+               for h in out_full["history"]) > 0
+
+
+def test_salientgrads_chaos_every_wire_keeps_mask_invariant():
+    """SalientGrads under chaos on each central wire: the fault trace is
+    wire-independent (injection precedes aggregation), the global model
+    stays finite, and the SNIP sparsity invariant survives quarantine
+    (dead coordinates exactly zero) — the guard composes with the
+    sparse compressed reduce unchanged."""
+    from neuroimagedisttraining_tpu.algorithms import SalientGrads
+    from neuroimagedisttraining_tpu.ops.sparsity import mask_density
+
+    data = _data()
+    model = create_model("small3dcnn", num_classes=1)
+    traces = {}
+    for impl in ("dense", "bucketed", "sparse"):
+        algo = SalientGrads(model, data, _hp(2), loss_type="bce",
+                            frac=1.0, seed=0, dense_ratio=0.3,
+                            agg_impl=impl, fault_spec="drop=0.3,nan=0.3")
+        s = algo.init_state(jax.random.PRNGKey(0))
+        trace = []
+        for r in range(3):
+            s, rec = algo.run_round(s, r)
+            trace.append((float(rec["clients_dropped"]),
+                          float(rec["clients_quarantined"])))
+        traces[impl] = trace
+        for p, m in zip(jax.tree_util.tree_leaves(s.global_params),
+                        jax.tree_util.tree_leaves(s.mask)):
+            p = np.asarray(p)
+            assert np.all(np.isfinite(p))
+            assert np.all(p[np.asarray(m) == 0] == 0)
+        assert float(mask_density(s.mask)) < 0.5
+    assert traces["dense"] == traces["bucketed"] == traces["sparse"]
+    assert sum(d + q for d, q in traces["dense"]) > 0
+
+
+def test_drop_faults_without_guard_refused():
+    """drop=... with guard=False would be silently inert (the 'dropped'
+    client's untouched update still aggregates at full weight) — refused
+    at construction. nan without the guard stays legal: that is the
+    undefended-chaos ablation, and the poison really propagates."""
+    data = _data()
+    model = create_model("small3dcnn", num_classes=1)
+    with pytest.raises(ValueError, match="drop"):
+        FedAvg(model, data, _hp(), loss_type="bce", frac=1.0, seed=0,
+               fault_spec="drop=0.5", guard=False)
+    FedAvg(model, data, _hp(), loss_type="bce", frac=1.0, seed=0,
+           fault_spec="nan=0.5", guard=False)  # legal ablation
+
+
+def test_fault_spec_refused_for_decentralized(tmp_path):
+    argv = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "4", "--comm_round", "1",
+            "--fault_spec", "drop=0.5",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="dispfl")
+    with pytest.raises(SystemExit):
+        run_experiment(args, "dispfl")
+
+
+def test_explicit_watchdog_refused_with_fused_rounds(tmp_path):
+    argv = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "4", "--comm_round", "2",
+            "--fault_spec", "drop=0.5", "--fuse_rounds", "2",
+            "--watchdog", "1",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="fedavg")
+    with pytest.raises(SystemExit):
+        run_experiment(args, "fedavg")
+
+
+def test_fused_fault_injection_runs_without_watchdog(tmp_path):
+    """--fault_spec + --fuse_rounds is a supported combination: the
+    watchdog auto-sentinel resolves to off (fusion removes its per-round
+    control) while the in-jit guard still protects every round."""
+    argv = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "4", "--batch_size", "8",
+            "--epochs", "1", "--comm_round", "4", "--lr", "0.05",
+            "--fault_spec", CHAOS, "--fuse_rounds", "2",
+            "--final_finetune", "0",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    args = parse_args(argv, algo="fedavg")
+    assert args.watchdog == 0 and args.guard == 1
+    out = run_experiment(args, "fedavg")
+    hist = [h for h in out["history"] if "train_loss" in h]
+    assert len(hist) == 4
+    assert all(np.isfinite(h["train_loss"]) for h in hist)
+    assert sum(h["clients_dropped"] + h["clients_quarantined"]
+               for h in hist) > 0

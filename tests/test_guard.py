@@ -1,0 +1,293 @@
+"""Non-finite quarantine (robust/guard.py): screen/quarantine semantics,
+clean-path bit-identity, and the ISSUE 2 parity gate — with NaN-poisoned
+clients, EVERY agg_impl wire (dense/bucketed/bf16/int8/sparse) produces a
+finite global model equal to aggregating the survivor subset directly."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.core.state import weighted_tree_sum
+from neuroimagedisttraining_tpu.parallel.collectives import (
+    build_sparse_plan,
+    sparse_weighted_mean,
+    weighted_mean,
+)
+from neuroimagedisttraining_tpu.robust import guard
+
+
+def _stacked_tree(c=6, seed=0, mask=None):
+    """[C, ...]-stacked param-like tree (optionally honored-mask)."""
+    key = jax.random.PRNGKey(seed)
+    tree = {
+        "conv": {"kernel": jax.random.normal(
+            jax.random.fold_in(key, 0), (c, 3, 3, 4, 8)) * 0.01},
+        "dense": {"kernel": jax.random.normal(
+            jax.random.fold_in(key, 1), (c, 64, 2)) * 0.01,
+            "bias": jax.random.normal(jax.random.fold_in(key, 2),
+                                      (c, 2)) * 0.01},
+    }
+    if mask is not None:
+        tree = jax.tree_util.tree_map(lambda x, m: x * m[None], tree, mask)
+    return tree
+
+
+def _poison(tree, rows, value=jnp.nan):
+    return jax.tree_util.tree_map(
+        lambda x: x.at[jnp.asarray(rows)].set(value), tree)
+
+
+def _weights(c=6, seed=3):
+    w = jax.random.uniform(jax.random.PRNGKey(seed), (c,)) + 0.1
+    return w / jnp.sum(w)
+
+
+def _tree_index(tree, idx):
+    return jax.tree_util.tree_map(lambda x: x[np.asarray(idx)], tree)
+
+
+# -- primitives --------------------------------------------------------------
+
+def test_finite_screen_flags_poisoned_clients():
+    tree = _poison(_stacked_tree(), [1], jnp.nan)
+    tree = _poison(tree, [4], jnp.inf)
+    ok = np.asarray(guard.finite_screen(tree))
+    assert ok.tolist() == [True, False, True, True, False, True]
+
+
+def test_quarantine_clean_is_bitwise_noop():
+    tree = _stacked_tree()
+    w = _weights()
+    ok = jnp.ones((6,), bool)
+    sanitized, w2, survivors = guard.quarantine(tree, w, ok)
+    assert int(survivors) == 6
+    assert np.array_equal(np.asarray(w2), np.asarray(w))
+    for a, b in zip(jax.tree_util.tree_leaves(sanitized),
+                    jax.tree_util.tree_leaves(tree)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quarantine_renormalizes_over_survivors():
+    tree = _poison(_stacked_tree(), [0, 2])
+    w = _weights()
+    ok = guard.finite_screen(tree)
+    sanitized, w2, survivors = guard.quarantine(tree, w, ok)
+    assert int(survivors) == 4
+    w2 = np.asarray(w2)
+    assert w2[0] == 0.0 and w2[2] == 0.0
+    np.testing.assert_allclose(w2.sum(), 1.0, rtol=1e-6)
+    for x in jax.tree_util.tree_leaves(sanitized):
+        assert np.all(np.isfinite(np.asarray(x)))
+
+
+def test_carry_if_empty():
+    agg = {"w": jnp.full((3,), 7.0)}
+    prev = {"w": jnp.full((3,), 2.0)}
+    out = guard.carry_if_empty(agg, prev, jnp.asarray(0))
+    assert np.all(np.asarray(out["w"]) == 2.0)
+    out = guard.carry_if_empty(agg, prev, jnp.asarray(1))
+    assert np.all(np.asarray(out["w"]) == 7.0)
+
+
+def test_merge_updates_keeps_quarantined_rows():
+    upd = {"w": jnp.ones((3, 4))}
+    pers = {"w": jnp.zeros((8, 4))}
+    sel = jnp.asarray([2, 5, 6])
+    ok = jnp.asarray([True, False, True])
+    merged = guard.merge_updates(ok, upd, pers, sel)
+    w = np.asarray(merged["w"])
+    assert np.all(w[0] == 1.0) and np.all(w[2] == 1.0)
+    assert np.all(w[1] == 0.0)  # client 5 kept its previous (zero) row
+    # all-ok path returns the updates untouched
+    merged = guard.merge_updates(jnp.ones((3,), bool), upd, pers, sel)
+    assert np.all(np.asarray(merged["w"]) == 1.0)
+
+
+# -- the parity gate: quarantine x every agg_impl wire -----------------------
+
+def _survivor_parity(agg_fn, tree, w, atol=1e-9):
+    """guarded full-set aggregate vs aggregating the survivor subset
+    directly with the same renormalized weights. The f32 wires agree to
+    f32 round-off: the zero-weighted zero rows contribute exactly 0, but
+    the [C]- and [S]-width contractions may reassociate the same nonzero
+    terms (measured 1 ulp — the same tolerance the fused-vs-unfused eval
+    gate carries); int8 passes a quantization-error tolerance instead."""
+    ok = guard.finite_screen(tree)
+    fallback = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x[0], jnp.pi), tree)  # sentinel
+    full = jax.jit(lambda st, wv: guard.guarded_aggregate(
+        st, wv, guard.finite_screen(st), agg_fn, fallback))(tree, w)
+    surv = np.flatnonzero(np.asarray(ok))
+    wm = jnp.where(ok, w, 0.0)
+    w2 = wm / jnp.sum(wm)
+    sub = agg_fn(_tree_index(tree, surv),
+                 jnp.asarray(np.asarray(w2)[surv]))
+    for a, b in zip(jax.tree_util.tree_leaves(full),
+                    jax.tree_util.tree_leaves(sub)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.all(np.isfinite(a))
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=atol)
+    return full
+
+
+def test_quarantine_dense_parity():
+    tree = _poison(_stacked_tree(), [1, 3])
+    _survivor_parity(lambda st, wv: weighted_tree_sum(st, wv),
+                     tree, _weights())
+
+
+def test_quarantine_bucketed_parity():
+    tree = _poison(_stacked_tree(), [1, 3])
+    _survivor_parity(
+        lambda st, wv: weighted_mean(st, wv, wire="f32", bucket_size=64),
+        tree, _weights())
+
+
+def test_quarantine_bf16_parity():
+    tree = _poison(_stacked_tree(), [0, 5], jnp.inf)
+    # bf16 casts per client BEFORE the f32 accumulation: zero rows cast to
+    # zero, so the survivor subset is still bit-equal
+    _survivor_parity(
+        lambda st, wv: weighted_mean(st, wv, wire="bf16", bucket_size=64),
+        tree, _weights())
+
+
+def test_quarantine_int8_parity():
+    tree = _poison(_stacked_tree(), [2])
+    rng = jax.random.PRNGKey(7)
+    # int8 stochastic rounding draws differ between the [C]- and
+    # [S]-shaped programs; parity holds to the quantization error bound
+    _survivor_parity(
+        lambda st, wv: weighted_mean(st, wv, wire="int8", bucket_size=64,
+                                     rng=rng),
+        tree, _weights(), atol=5e-3)
+
+
+def test_quarantine_sparse_parity():
+    c = 6
+    key = jax.random.PRNGKey(9)
+    mask = {
+        "conv": {"kernel": (jax.random.uniform(
+            jax.random.fold_in(key, 0), (3, 3, 4, 8)) < 0.5).astype(
+                jnp.float32)},
+        "dense": {"kernel": (jax.random.uniform(
+            jax.random.fold_in(key, 1), (64, 2)) < 0.5).astype(
+                jnp.float32),
+            "bias": jnp.ones((2,), jnp.float32)},
+    }
+    tree = _poison(_stacked_tree(c=c, mask=mask), [1, 4])
+    plan = build_sparse_plan(mask)
+    _survivor_parity(
+        lambda st, wv: sparse_weighted_mean(st, wv, plan, bucket_size=64),
+        tree, _weights(c))
+
+
+def test_guarded_aggregate_all_quarantined_carries_fallback():
+    tree = _poison(_stacked_tree(), [0, 1, 2, 3, 4, 5])
+    w = _weights()
+    ok = guard.finite_screen(tree)
+    fallback = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x[0], 3.25), tree)
+    out = guard.guarded_aggregate(
+        tree, w, ok, lambda st, wv: weighted_tree_sum(st, wv), fallback)
+    for x in jax.tree_util.tree_leaves(out):
+        assert np.all(np.asarray(x) == 3.25)
+
+
+def test_guarded_aggregate_clean_is_bitwise_plain():
+    tree = _stacked_tree()
+    w = _weights()
+    ok = guard.finite_screen(tree)
+    fallback = jax.tree_util.tree_map(lambda x: x[0], tree)
+    out = guard.guarded_aggregate(
+        tree, w, ok, lambda st, wv: weighted_tree_sum(st, wv), fallback)
+    ref = weighted_tree_sum(tree, w)
+    for a, b in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_guarded_aggregate_on_mesh_bucketed(eight_devices):
+    """shard_map collectives inside the guard's lax.cond: the bucketed
+    wire on a clients mesh with poisoned rows still matches the survivor
+    subset (the chaos + --agg_impl bucketed + mesh composition)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(4, 1)
+    sh = NamedSharding(mesh, P("clients"))
+    c = 8
+    tree = _poison(_stacked_tree(c=c), [3, 6])
+    tree = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    w = _weights(c)
+    ok = guard.finite_screen(tree)
+    fallback = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]), tree)
+
+    def agg_fn(st, wv):
+        return weighted_mean(st, wv, wire="f32", mesh=mesh, bucket_size=64)
+
+    out = jax.jit(lambda st, wv: guard.guarded_aggregate(
+        st, wv, guard.finite_screen(st), agg_fn, fallback))(tree, w)
+    surv = np.flatnonzero(np.asarray(ok))
+    wm = jnp.where(ok, w, 0.0)
+    w2 = np.asarray(wm / jnp.sum(wm))
+    sub = weighted_tree_sum(_tree_index(tree, surv), jnp.asarray(w2[surv]))
+    for a, b in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(sub)):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=1e-8)
+
+
+# -- algorithm-level composition --------------------------------------------
+
+def test_guard_composes_with_defense_and_personal_stack():
+    """A deterministic injected fault (stubbed fault_fn): client 0
+    dropped, client 1 NaN — the aggregate matches the survivor mean
+    under the clip defense, and the personal stack keeps rows 0/1."""
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.robust import RobustAggregator
+
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.0, weight_decay=0.0,
+                     grad_clip=10.0, local_epochs=1, steps_per_epoch=2,
+                     batch_size=8)
+    algo = FedAvg(model, data, hp, loss_type="bce", frac=1.0, seed=0,
+                  guard=True,
+                  defense=RobustAggregator("norm_diff_clipping",
+                                           norm_bound=5.0))
+
+    def stub_fault(stacked, global_params, sel_idx, round_idx):
+        poisoned = jax.tree_util.tree_map(
+            lambda x: x.at[1].set(jnp.nan), stacked)
+        dropped = jnp.asarray([True, False, False, False])
+        return poisoned, dropped
+
+    algo.fault_fn = stub_fault
+    algo._build()  # rebuild the round program around the stub
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    s1, rec = algo.run_round(s0, 0)
+    assert float(rec["clients_dropped"]) == 1.0
+    assert float(rec["clients_quarantined"]) == 1.0
+    for x in jax.tree_util.tree_leaves(s1.global_params):
+        assert np.all(np.isfinite(np.asarray(x)))
+    # rows 0 (dropped) and 1 (NaN) kept their previous personal models
+    for p0, p1 in zip(jax.tree_util.tree_leaves(s0.personal_params),
+                      jax.tree_util.tree_leaves(s1.personal_params)):
+        p0, p1 = np.asarray(p0), np.asarray(p1)
+        assert np.array_equal(p0[0], p1[0])
+        assert np.array_equal(p0[1], p1[1])
+        assert np.all(np.isfinite(p1))
+    # rows 2/3 actually trained (changed)
+    changed = any(
+        not np.array_equal(np.asarray(p0)[2], np.asarray(p1)[2])
+        for p0, p1 in zip(jax.tree_util.tree_leaves(s0.personal_params),
+                          jax.tree_util.tree_leaves(s1.personal_params)))
+    assert changed

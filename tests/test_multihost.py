@@ -180,3 +180,37 @@ def test_abcd_client_filter_val_membership_matches_full(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(sub.x_train[local_i, :nt]),
             np.asarray(full.x_train[gid, :nt]))
+
+
+def test_sync_retry_wrapper_retries_transient_then_succeeds():
+    """Bounded-retry host-sync wrapper (ISSUE 2 multihost hardening):
+    transient failures retry with backoff; the budget is bounded."""
+    from neuroimagedisttraining_tpu.parallel import multihost as mh
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient DCN hiccup")
+        return "ok"
+
+    assert mh._with_retries("probe", flaky, max_retries=3,
+                            backoff_s=0.0) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+    try:
+        mh._with_retries("probe", flaky, max_retries=1, backoff_s=0.0)
+        raise AssertionError("expected the bounded budget to propagate")
+    except RuntimeError:
+        pass
+    assert len(calls) == 2  # initial try + 1 retry, then gave up
+
+
+def test_initialize_distributed_single_process_still_degrades():
+    """The hardened wrapper keeps the auto-detect degradation contract:
+    no cluster environment -> False, no retry storm, no raise."""
+    from neuroimagedisttraining_tpu.parallel import initialize_distributed
+
+    assert initialize_distributed(timeout_s=5, max_retries=2) is False

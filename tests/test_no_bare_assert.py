@@ -1,0 +1,53 @@
+"""Lint gate: no bare ``assert`` on contract paths (the recurring
+``python -O`` hazard, ADVICE r5 — ``-O`` strips asserts, so a contract
+check spelled as one silently vanishes in optimized deployments).
+
+Contract paths are the modules whose runtime checks gate correctness or
+data integrity: the fault-tolerance subsystem, checkpointing, the round
+machinery, the aggregation wires, the multihost sync points, and the
+runner/config surface. Their checks must be explicit raises. Everything
+else (tests, benches, visualization) may keep asserts."""
+import ast
+import os
+
+import pytest
+
+PKG = os.path.join(os.path.dirname(__file__), "..",
+                   "neuroimagedisttraining_tpu")
+
+#: contract-path modules where ``assert`` is forbidden (extend as modules
+#: become load-bearing; a new bare assert in any of these fails CI)
+CONTRACT_PATHS = [
+    "robust/faults.py",
+    "robust/guard.py",
+    "robust/recovery.py",
+    "robust/aggregation.py",
+    "utils/checkpoint.py",
+    "utils/records.py",
+    "utils/flops.py",
+    "algorithms/base.py",
+    "algorithms/fedavg.py",
+    "algorithms/salientgrads.py",
+    "parallel/collectives.py",
+    "parallel/multihost.py",
+    "parallel/mesh.py",
+    "core/state.py",
+    "core/trainer.py",
+    "experiments/runner.py",
+    "experiments/config.py",
+]
+
+
+@pytest.mark.parametrize("rel", CONTRACT_PATHS)
+def test_no_bare_assert_on_contract_path(rel):
+    path = os.path.normpath(os.path.join(PKG, rel))
+    assert os.path.exists(path), f"contract path moved/removed: {rel}"
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=rel)
+    offenders = [
+        f"{rel}:{node.lineno}" for node in ast.walk(tree)
+        if isinstance(node, ast.Assert)
+    ]
+    assert not offenders, (
+        f"bare assert on a contract path (python -O strips it; raise "
+        f"ValueError/RuntimeError instead): {offenders}")
