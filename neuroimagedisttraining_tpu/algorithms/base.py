@@ -22,6 +22,7 @@ from ..core.state import HyperParams
 from ..core.trainer import make_eval_fn
 from ..data.types import FederatedData
 from ..models import make_apply_fn
+from ..obs import trace as obs_trace
 
 logger = logging.getLogger(__name__)
 
@@ -360,11 +361,12 @@ class FedAlgorithm(abc.ABC):
         (ADVICE r5); runs before dispatch, never under trace."""
         # retry passed only when set: the 3-arg call stays the reference
         # contract's exact signature (and test monkeypatch surface)
-        sel = sample_client_indexes(
-            round_idx, self.num_clients, self.clients_per_round,
-            retry=self._retry_nonce) if self._retry_nonce else \
-            sample_client_indexes(
-                round_idx, self.num_clients, self.clients_per_round)
+        with obs_trace.span("sample"):
+            sel = sample_client_indexes(
+                round_idx, self.num_clients, self.clients_per_round,
+                retry=self._retry_nonce) if self._retry_nonce else \
+                sample_client_indexes(
+                    round_idx, self.num_clients, self.clients_per_round)
         if self.clients_per_round == self.num_clients and \
                 not np.array_equal(sel, np.arange(self.num_clients)):
             raise ValueError(
@@ -401,26 +403,29 @@ class FedAlgorithm(abc.ABC):
         weights and accumulation always) for smaller / pipelined
         cross-chip transfers. Robust defenses already transformed
         ``stacked`` before this point, so they compose with every impl."""
-        if self.agg_impl == "dense":
-            from ..core.state import weighted_tree_sum
+        with jax.named_scope("aggregate"):
+            if self.agg_impl == "dense":
+                from ..core.state import weighted_tree_sum
 
-            return weighted_tree_sum(stacked, weights)
-        from ..parallel import collectives
+                return weighted_tree_sum(stacked, weights)
+            from ..parallel import collectives
 
-        kw = dict(mesh=self._agg_mesh(),
-                  bucket_size=self.agg_bucket_size, rng=rng)
-        if self.agg_impl == "sparse":
-            if self._agg_sparse_plan is None:
-                raise ValueError(
-                    f"{self.name}: agg_impl='sparse' needs a static-mask "
-                    "gather plan (_agg_sparse_plan) built from the "
-                    "concrete mask before the round traces — only "
-                    "fixed-mask algorithms (SalientGrads) support it")
-            return collectives.sparse_weighted_mean(
-                stacked, weights, self._agg_sparse_plan, **kw)
-        wire = {"bucketed": "f32", "bf16": "bf16", "int8": "int8"}[
-            self.agg_impl]
-        return collectives.weighted_mean(stacked, weights, wire=wire, **kw)
+            kw = dict(mesh=self._agg_mesh(),
+                      bucket_size=self.agg_bucket_size, rng=rng)
+            if self.agg_impl == "sparse":
+                if self._agg_sparse_plan is None:
+                    raise ValueError(
+                        f"{self.name}: agg_impl='sparse' needs a "
+                        "static-mask gather plan (_agg_sparse_plan) built "
+                        "from the concrete mask before the round traces — "
+                        "only fixed-mask algorithms (SalientGrads) "
+                        "support it")
+                return collectives.sparse_weighted_mean(
+                    stacked, weights, self._agg_sparse_plan, **kw)
+            wire = {"bucketed": "f32", "bf16": "bf16", "int8": "int8"}[
+                self.agg_impl]
+            return collectives.weighted_mean(
+                stacked, weights, wire=wire, **kw)
 
     def _full_batches(self, hp: Optional[HyperParams] = None) -> bool:
         """Static guarantee for core.trainer's epoch fast path: every
@@ -565,10 +570,14 @@ class FedAlgorithm(abc.ABC):
         mask_b = broadcast_tree(mask, s)
         mom0 = zeros_like_tree(params0)
         keys = jax.random.split(round_key, s + 1)
-        params_out, _, losses = self._vmap_clients(
-            client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
-        )(params0, mom0, mask_b, keys[:s], x_sel, y_sel, n_sel, round_idx,
-          params0)
+        # named_scope: trace-time HLO metadata only (zero runtime cost,
+        # numerics untouched) — labels the round's phases on the XLA
+        # device trace so they line up with the obs host spans
+        with jax.named_scope("local_train"):
+            params_out, _, losses = self._vmap_clients(
+                client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
+            )(params0, mom0, mask_b, keys[:s], x_sel, y_sel, n_sel,
+              round_idx, params0)
         dropped = None
         if self.fault_fn is not None:
             # inject AFTER training: faults model what leaves the client
@@ -593,20 +602,21 @@ class FedAlgorithm(abc.ABC):
         if self.guard_enabled:
             from ..robust import guard as _guard
 
-            finite = _guard.finite_screen(defended)
-            if dropped is not None:
-                ok = jnp.logical_and(finite, jnp.logical_not(dropped))
-                n_dropped = jnp.sum(dropped.astype(jnp.float32))
-                # quarantined = screened by the finite guard among the
-                # clients that did report (dropouts counted separately)
-                n_quar = jnp.sum(jnp.logical_and(
-                    jnp.logical_not(finite), jnp.logical_not(dropped)
-                ).astype(jnp.float32))
-            else:
-                ok = finite
-                n_dropped = jnp.asarray(0.0, jnp.float32)
-                n_quar = jnp.sum(
-                    jnp.logical_not(finite).astype(jnp.float32))
+            with jax.named_scope("guard"):
+                finite = _guard.finite_screen(defended)
+                if dropped is not None:
+                    ok = jnp.logical_and(finite, jnp.logical_not(dropped))
+                    n_dropped = jnp.sum(dropped.astype(jnp.float32))
+                    # quarantined = screened by the finite guard among the
+                    # clients that did report (dropouts counted separately)
+                    n_quar = jnp.sum(jnp.logical_and(
+                        jnp.logical_not(finite), jnp.logical_not(dropped)
+                    ).astype(jnp.float32))
+                else:
+                    ok = finite
+                    n_dropped = jnp.asarray(0.0, jnp.float32)
+                    n_quar = jnp.sum(
+                        jnp.logical_not(finite).astype(jnp.float32))
             new_global = _guard.guarded_aggregate(
                 defended, weights, ok,
                 lambda st, wv: self._aggregate(st, wv, agg_rng),
@@ -978,7 +988,13 @@ class FedAlgorithm(abc.ABC):
         def flush(p):
             nonlocal mark
             r0, k, ys, state_out = p
-            host = dict(ys.materialize())  # blocks until block complete
+            # obs span at the ONE place the fused path already syncs
+            # (per-round spans would force device syncs inside the
+            # block); whole-block timing is the documented degradation
+            with obs_trace.span("fused_block_flush") as sp:
+                sp.add("start_round", r0)
+                sp.add("rounds", k)
+                host = dict(ys.materialize())  # blocks until complete
             now = time.perf_counter()
             wall, mark = now - mark, now
             ev = host.pop("eval", None)
@@ -999,8 +1015,10 @@ class FedAlgorithm(abc.ABC):
         try:
             for r0 in range(start_round, total, block):
                 k = min(block, total - r0)
-                state, ys = self.run_rounds_fused(
-                    state, r0, k, eval_every=eval_every)
+                with obs_trace.span("fused_block_dispatch") as sp:
+                    sp.add("start_round", r0)
+                    state, ys = self.run_rounds_fused(
+                        state, r0, k, eval_every=eval_every)
                 if pending is not None:
                     # clear BEFORE flushing: if flush raises mid-way
                     # (e.g. on_block checkpoint save), the finally must

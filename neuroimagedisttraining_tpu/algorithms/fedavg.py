@@ -29,6 +29,7 @@ from ..core.state import (
 )
 from ..core.trainer import make_client_update
 from ..models import init_params
+from ..obs import trace as obs_trace
 from .base import FedAlgorithm
 
 
@@ -116,10 +117,14 @@ class FedAvg(FedAlgorithm):
 
     def run_round(self, state: FedAvgState, round_idx: int):
         sel = self._selected_client_indexes(round_idx)
-        out = self._round_jit(
-            state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
-            self.data.x_train, self.data.y_train, self.data.n_train,
-        )
+        # dispatch-time span (async): the round's device phases are
+        # labeled by named_scope inside the jitted body instead
+        with obs_trace.span("dispatch_round"):
+            out = self._round_jit(
+                state, jnp.asarray(sel),
+                jnp.asarray(round_idx, jnp.float32),
+                self.data.x_train, self.data.y_train, self.data.n_train,
+            )
         new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
@@ -132,8 +137,10 @@ class FedAvg(FedAlgorithm):
             # the fine-tune pass exists to produce the personal models
             # (fedavg_api.py:79-88); nothing to produce when untracked
             return state, None
-        state = self._finetune_jit(
-            state, self.data.x_train, self.data.y_train, self.data.n_train)
+        with obs_trace.span("finetune"):
+            state = self._finetune_jit(
+                state, self.data.x_train, self.data.y_train,
+                self.data.n_train)
         ev = self.evaluate(state)
         record = {"round": -1, "finetune": True,
                   **{k: v for k, v in ev.items()

@@ -35,6 +35,7 @@ from flax import struct
 from ..core.state import broadcast_tree
 from ..core.trainer import make_client_update
 from ..models import init_params
+from ..obs import trace as obs_trace
 from ..ops.sparsity import make_snip_score_fn, mask_density, mask_from_scores
 from .base import FedAlgorithm
 
@@ -191,10 +192,11 @@ class SalientGrads(FedAlgorithm):
             # (sailentgrads/client.py:95-103)
             mask = jax.tree_util.tree_map(jnp.ones_like, params)
         else:
-            mask = self._global_mask_jit(
-                params, self.data.x_train, self.data.y_train,
-                self.data.n_train, m_rng,
-            )
+            with obs_trace.span("snip_mask"):
+                mask = self._global_mask_jit(
+                    params, self.data.x_train, self.data.y_train,
+                    self.data.n_train, m_rng,
+                )
         return SalientGradsState(
             global_params=params, mask=mask,
             # w_per_mdls init: dense copies of the initial global model —
@@ -221,10 +223,14 @@ class SalientGrads(FedAlgorithm):
     def run_round(self, state: SalientGradsState, round_idx: int):
         self._ensure_agg_plan(state)
         sel = self._selected_client_indexes(round_idx)
-        out = self._round_jit(
-            state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
-            self.data.x_train, self.data.y_train, self.data.n_train,
-        )
+        # dispatch-time span (async): the round's device phases are
+        # labeled by named_scope inside the jitted body instead
+        with obs_trace.span("dispatch_round"):
+            out = self._round_jit(
+                state, jnp.asarray(sel),
+                jnp.asarray(round_idx, jnp.float32),
+                self.data.x_train, self.data.y_train, self.data.n_train,
+            )
         new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)

@@ -270,6 +270,30 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="stat_info pickle dir (subavg_api.py:218-221)")
     p.add_argument("--profile_dir", type=str, default="",
                    help="write a jax.profiler trace of one round here")
+    # -- observability (obs/; telemetry NEVER forks run/checkpoint
+    # lineage — none of these enter run_identity)
+    p.add_argument("--obs", type=int, default=0,
+                   help="enable the observability subsystem (obs/): "
+                        "per-round JSONL telemetry + metrics registry + "
+                        "host span tracer + memory watermarks. Off (the "
+                        "default) is bit-identical to pre-obs behavior")
+    p.add_argument("--obs_jsonl", type=str, default="",
+                   help="per-round JSONL stream path (default: "
+                        "<results_dir>/<dataset>/<identity>.obs.jsonl). "
+                        "Only process 0 exports; per-host streams merge "
+                        "with obs.export.merge_host_jsonl")
+    p.add_argument("--trace_dir", type=str, default="",
+                   help="write the host span trace (Chrome trace-event "
+                        "JSON, Perfetto-loadable) here at end of run; "
+                        "pair with --profile_dir to line host spans up "
+                        "with the XLA device trace")
+    p.add_argument("--obs_sample_every", type=int, default=1,
+                   help="memory-watermark sampling cadence in rounds "
+                        "(obs/memory.py; the live-arrays fallback walk "
+                        "is O(arrays), so big runs may want >1)")
+    p.add_argument("--obs_tb_dir", type=str, default="",
+                   help="optional TensorBoard scalar export dir (no-op "
+                        "unless a TB writer is importable)")
     p.add_argument("--tag", type=str, default="", help="identity suffix")
 
     if algo is not None:

@@ -470,7 +470,7 @@ def save_stat_info(args: argparse.Namespace, identity: str,
                    history, final_eval, extras=None,
                    cost=None, eval_client_ids=None,
                    avg_inference_flops: float = 0.0,
-                   fault_counters=None) -> Optional[str]:
+                   fault_counters=None, obs_metrics=None) -> Optional[str]:
     """End-of-run artifact: stat_info pickle under
     ``<results_dir>/<dataset>/<identity>`` (subavg_api.py:218-221)."""
     if not args.results_dir:
@@ -503,6 +503,11 @@ def save_stat_info(args: argparse.Namespace, identity: str,
         # clients_quarantined, rounds_retried/skipped,
         # checkpoint_save_failures)
         stat_info["fault_recovery"] = dict(fault_counters)
+    if obs_metrics is not None:
+        # end-of-run obs registry snapshot (obs/export.py metrics.json
+        # payload) — merged into stat_info so one artifact carries both
+        # the learning curves and the run's telemetry
+        stat_info["obs_metrics"] = obs_metrics
     if eval_client_ids is not None:
         # sampled-eval mode: per-client eval outputs are indexed by subset
         # position; persist the client-id mapping alongside them
@@ -546,7 +551,8 @@ def _cost_round_record(algo, cost, samples_per_client, state):
 
 def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                       ev_every, cost, samples_per_client, history,
-                      ckpt_mgr=None, args=None, counters=None):
+                      ckpt_mgr=None, args=None, counters=None,
+                      obs_session=None):
     """The runner's fused round loop (--fuse_rounds K): the shared
     block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
     accounting. Masks are static here (evolving-mask algorithms are
@@ -569,6 +575,10 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
         if counters is not None:
             counters.update(rec)
         history.append(rec)
+        if obs_session is not None:
+            # fused records arrive at the block flush point, already
+            # materialized — the JSONL write forces no device sync
+            obs_session.record_round(rec)
         logger.info("%s round %d: %s", algo_name, r, rec)
 
     def on_block(end_round, state_out):
@@ -588,6 +598,8 @@ def run_experiment(args: argparse.Namespace,
     algo_name = algo_name or getattr(args, "algo", "fedavg")
     ckpt_mgr = None
     log_handler = None
+    obs_session = None
+    from ..obs import trace as obs_trace
     try:
         # Reconcile batching/augment semantics with any existing checkpoint
         # lineage FIRST: an adapted knob (e.g. a defaulted resume flipping
@@ -636,12 +648,35 @@ def run_experiment(args: argparse.Namespace,
                     "pod runtime; elsewhere pass --coordinator_address/"
                     "--num_processes/--process_id explicitly.")
 
-        if mh_mesh is not None:
-            algo, data = build_algorithm(args, algo_name, data=gdata)
-            mesh = mh_mesh
-        else:
-            algo, data = build_algorithm(args, algo_name)
-            mesh = maybe_shard(algo, args)
+        if getattr(args, "obs", 0):
+            # telemetry session: registry + tracer + sinks (obs/). Built
+            # AFTER identity is fixed (obs knobs never enter the
+            # identity, so telemetry cannot fork a lineage) and AFTER
+            # any jax.distributed init — ObsSession reads
+            # jax.process_index() for the only-process-0-exports rule,
+            # and touching the backend BEFORE initialize_distributed
+            # would both abort the multihost handshake and mis-rank
+            # every host as 0
+            from ..obs.export import ObsSession
+
+            jsonl = getattr(args, "obs_jsonl", "") or os.path.join(
+                args.results_dir or ".", args.dataset,
+                identity + ".obs.jsonl")
+            obs_session = ObsSession(
+                jsonl_path=jsonl,
+                trace_dir=getattr(args, "trace_dir", ""),
+                identity=identity,
+                sample_every=getattr(args, "obs_sample_every", 1),
+                tb_dir=getattr(args, "obs_tb_dir", ""))
+            logger.info("obs: per-round JSONL -> %s", jsonl)
+
+        with obs_trace.span("build"):
+            if mh_mesh is not None:
+                algo, data = build_algorithm(args, algo_name, data=gdata)
+                mesh = mh_mesh
+            else:
+                algo, data = build_algorithm(args, algo_name)
+                mesh = maybe_shard(algo, args)
         if mesh is not None:
             logger.info("sharding clients over mesh %s", dict(mesh.shape))
         _check_augment_consistency(args, algo)
@@ -656,7 +691,8 @@ def run_experiment(args: argparse.Namespace,
                 logger.info("resumed from round %d", start_round)
 
         if state is None:
-            state = algo.init_state(jax.random.PRNGKey(args.seed))
+            with obs_trace.span("init_state"):
+                state = algo.init_state(jax.random.PRNGKey(args.seed))
 
         if args.profile_dir:
             from ..utils.profiling import trace_one_round
@@ -713,18 +749,28 @@ def run_experiment(args: argparse.Namespace,
         from ..utils.records import DeferredRecords, RunCounters, to_float
 
         # fault/recovery accounting: per-round counters accumulated into
-        # stat_info (clients_dropped / clients_quarantined)
-        counters = RunCounters()
+        # stat_info (clients_dropped / clients_quarantined), mirrored
+        # into the obs registry when a session is live
+        counters = RunCounters(
+            registry=obs_session.registry if obs_session else None)
 
         def _emit(rec):
             # counters accumulate at FLUSH time, when DeferredRecords has
             # already materialized the record's device scalars — counting
             # in the round loop would host-sync the guard counters every
-            # round and defeat the one-round-deferred pipelining
+            # round and defeat the one-round-deferred pipelining. The obs
+            # JSONL write shares the same flush point for the same reason.
             counters.update(rec)
+            if obs_session is not None:
+                obs_session.record_round(rec)
             logger.info("%s round %s: %s", algo_name, rec["round"], rec)
 
-        deferred = DeferredRecords(log=_emit)
+        # with obs on, records also get round_time_s stamped at flush
+        # boundaries (sum over the run = wall time, attribution ±1 round
+        # — the honest semantics under deferred fetching); off keeps the
+        # pre-obs record shape exactly
+        deferred = DeferredRecords(log=_emit,
+                                   timed=obs_session is not None)
 
         fuse = max(1, getattr(args, "fuse_rounds", 1) or 1)
         watchdog = None
@@ -780,7 +826,8 @@ def run_experiment(args: argparse.Namespace,
                 max(start_round, args.comm_round), fuse,
                 args.frequency_of_the_test or 0, cost,
                 samples_per_client, history,
-                ckpt_mgr=ckpt_mgr, args=args, counters=counters)
+                ckpt_mgr=ckpt_mgr, args=args, counters=counters,
+                obs_session=obs_session)
             final_eval = None  # re-evaluated once below
 
         try:
@@ -794,7 +841,11 @@ def run_experiment(args: argparse.Namespace,
                     # retry attempts re-sample the cohort (nonce 0 = the
                     # reference's seeded draw, bit-compatible)
                     algo.set_retry_nonce(watchdog.retries_at(r))
-                new_state, rec = algo.run_round(state, r)
+                with obs_trace.step_span("round", r):
+                    # NOTE: dispatch-time span (the round program is
+                    # async); wall attribution lives in round_time_s at
+                    # the deferred flush — see obs/trace.py caveat
+                    new_state, rec = algo.run_round(state, r)
                 record = {"round": r, **dict(rec)}
                 if watchdog is not None:
                     verdict = watchdog.judge(r, record, new_state, state)
@@ -823,7 +874,8 @@ def run_experiment(args: argparse.Namespace,
                 final_eval = None  # state changed; any cached eval is stale
                 if args.frequency_of_the_test and \
                         (r + 1) % args.frequency_of_the_test == 0:
-                    final_eval = algo.evaluate(state)
+                    with obs_trace.span("eval"):
+                        final_eval = algo.evaluate(state)
                     record.update({
                         k: v for k, v in final_eval.items()
                         if not k.startswith("acc_per")})
@@ -846,12 +898,16 @@ def run_experiment(args: argparse.Namespace,
         # from the same pre-finalize state and reproduces the original
         # metrics; no double fine-tune is possible
         if getattr(args, "final_finetune", 1):
-            state, fin_rec = algo.finalize(state)
+            with obs_trace.span("finalize"):
+                state, fin_rec = algo.finalize(state)
         if fin_rec is not None:
             # the reference's final fine-tune record (round -1)
             record = {k: v if k in ("round", "finetune") else to_float(v)
                       for k, v in fin_rec.items()}
             history.append(record)
+            if obs_session is not None:
+                # the round=-1 final record joins the JSONL stream too
+                obs_session.record_round(record)
             logger.info("%s final: %s", algo_name, record)
             # only a finalize that actually TRAINED counts toward the
             # FLOPs/comm counters (FedAvg's fine-tune marks its record
@@ -901,12 +957,26 @@ def run_experiment(args: argparse.Namespace,
         if ckpt_mgr is not None:
             fault_totals["checkpoint_save_failures"] = float(
                 ckpt_mgr.save_failures)
+        obs_snapshot = None
+        if obs_session is not None:
+            for k, v in fault_totals.items():
+                # run-level totals (incl. watchdog/checkpoint counters
+                # that never flow through per-round records) land in the
+                # registry before the final snapshot
+                obs_session.registry.gauge("fault_recovery_" + k).set(v)
+            obs_snapshot = obs_session.finish()
+            if obs_session.metrics_json_path:
+                logger.info("obs: metrics.json -> %s",
+                            obs_session.metrics_json_path)
+            if obs_session.trace_path:
+                logger.info("obs: Perfetto trace -> %s",
+                            obs_session.trace_path)
         stat_path = save_stat_info(
             args, identity, history, final_eval, extras, cost=cost,
             eval_client_ids=(np.asarray(algo._eval_idx)
                              if algo._eval_idx is not None else None),
             avg_inference_flops=avg_inf,
-            fault_counters=fault_totals)
+            fault_counters=fault_totals, obs_metrics=obs_snapshot)
         return {
             "identity": identity,
             "history": history,
@@ -915,6 +985,11 @@ def run_experiment(args: argparse.Namespace,
             "state": state,
         }
     finally:
+        if obs_session is not None:
+            # idempotent: restores the null tracer + closes the JSONL
+            # sink even when the run died mid-round (every flushed round
+            # is already on disk — the writer flushes per line)
+            obs_session.close()
         if ckpt_mgr is not None:
             ckpt_mgr.close()
         from .logging_utils import remove_run_file_logger

@@ -1,0 +1,26 @@
+"""Observability subsystem: tracing, metrics registry, per-round telemetry.
+
+The third leg after ``parallel/`` (comm-efficient aggregation) and
+``robust/`` (fault tolerance): the layer every perf PR is measured with.
+
+* :mod:`~.trace` — hierarchical host-side span tracer emitting Chrome
+  trace-event JSON (Perfetto-viewable), each span mirrored into
+  ``jax.profiler.TraceAnnotation`` so host spans line up with the XLA
+  device trace. Module-level null tracer = zero-cost when disabled.
+* :mod:`~.metrics` — typed registry: counters, gauges, streaming
+  distributions (count/sum/min/max/p50/p99), labeled children behind a
+  bounded-cardinality guard.
+* :mod:`~.export` — sinks: per-round JSONL stream, end-of-run
+  ``metrics.json`` merged into ``save_stat_info``, optional TensorBoard
+  scalars. Multihost-aware: every process records, only process 0
+  exports; ``merge_host_jsonl`` folds per-host streams into one timeline.
+* :mod:`~.memory` — device-HBM watermark + host-RSS sampling at round
+  boundaries, surfaced as gauges.
+
+Nothing here enters run/checkpoint identity: telemetry never forks a
+lineage, and with ``--obs`` off every hook is a no-op (bit-identical to
+the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it).
+"""
+from . import export, memory, metrics, trace
+
+__all__ = ["export", "memory", "metrics", "trace"]

@@ -1,0 +1,256 @@
+"""Telemetry sinks: per-round JSONL, end-of-run metrics.json, TensorBoard.
+
+* :class:`RoundLogWriter` — one JSON line per round under the run dir
+  (timings, losses, fault-recovery counters, agg wire stats — whatever
+  the round record carries). Multihost rule mirrors the checkpoint
+  lineage rules: EVERY process records (registry, tracer), only
+  process 0 exports files; per-host streams (explicitly host-tagged
+  paths) fold into one timeline with :func:`merge_host_jsonl`.
+* :func:`write_metrics_json` — the registry snapshot as ``metrics.json``
+  (the runner also merges it into ``save_stat_info``'s JSON).
+* :func:`maybe_tensorboard_writer` — optional TB scalar export, gated on
+  an importable writer (no hard dependency; returns None when absent).
+* :class:`ObsSession` — the runner's per-run faceplate tying registry +
+  tracer + memory sampler + sinks together behind one
+  ``record_round``/``finish``/``close`` lifecycle.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+from . import metrics as obs_metrics, trace as obs_trace
+from .memory import MemoryWatermark
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ObsSession", "RoundLogWriter", "maybe_tensorboard_writer",
+    "merge_host_jsonl", "write_metrics_json",
+]
+
+
+def _process_index() -> int:
+    """Rank for the only-process-0-exports rule (0 when jax.distributed
+    is not initialized; patchable in tests)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # pragma: no cover - pre-init edge
+        return 0
+
+
+def _json_default(v: Any) -> Any:
+    """Round records may still carry numpy scalars (DeferredRecords
+    materializes floats, but fused/eval extras can be np types)."""
+    try:
+        import numpy as np
+
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, np.ndarray) and v.ndim == 0:
+            return v.item()
+    except ImportError:  # pragma: no cover
+        pass
+    return str(v)
+
+
+class RoundLogWriter:
+    """Append-mode JSONL sink, flushed per line so a crashed run keeps
+    every completed round — and a ``--resume``d run continues its own
+    stream (a FRESH rerun under the same identity appends too; remove
+    the file, or tag the run, for a clean stream). Opens lazily on the
+    first write; does nothing on non-zero processes unless ``force``
+    (the host-tagged multi-stream mode merge_host_jsonl exists for)."""
+
+    def __init__(self, path: str, force: bool = False):
+        self.path = path
+        self._force = force
+        self._fh = None
+        self._exports = force or _process_index() == 0
+        self.lines = 0
+
+    @property
+    def exports(self) -> bool:
+        return self._exports
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if not self._exports:
+            return
+        if self._fh is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(record, default=_json_default) + "\n")
+        self._fh.flush()
+        self.lines += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL stream; a malformed line raises with its number
+    (a telemetry file that silently drops rounds is worse than none)."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{i + 1}: malformed JSONL line: {e}") from e
+    return out
+
+
+def merge_host_jsonl(paths: List[str]) -> List[Dict[str, Any]]:
+    """Fold per-host round streams into one timeline: records gain a
+    ``host`` field (their stream's position in ``paths``) and sort by
+    ``(round, host)`` — a stable global view of a multi-process run."""
+    merged: List[Dict[str, Any]] = []
+    for host, p in enumerate(paths):
+        for rec in read_jsonl(p):
+            rec = dict(rec)
+            rec.setdefault("host", host)
+            merged.append(rec)
+    merged.sort(key=lambda r: (r.get("round", -1), r.get("host", 0)))
+    return merged
+
+
+def write_metrics_json(registry: "obs_metrics.MetricsRegistry",
+                       path: str) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(registry.snapshot(), f, indent=1,
+                  default=_json_default)
+    return path
+
+
+def maybe_tensorboard_writer(log_dir: str):
+    """A TensorBoard SummaryWriter when one is importable
+    (tensorboardX, or flax's TF-backed writer), else None — TB export is
+    optional, never a dependency."""
+    try:
+        from tensorboardX import SummaryWriter  # type: ignore
+
+        return SummaryWriter(log_dir)
+    except ImportError:
+        pass
+    try:
+        from flax.metrics.tensorboard import (  # type: ignore
+            SummaryWriter,
+        )
+
+        return SummaryWriter(log_dir)
+    except Exception:
+        return None
+
+
+class ObsSession:
+    """Per-run telemetry lifecycle for the experiment runner.
+
+    Owns a fresh registry (per-run metrics never mix across sequential
+    runs in one process), a :class:`~.trace.Tracer` installed as the
+    module-active tracer (so library spans flow), a round-boundary
+    memory sampler, and the sinks. ``record_round`` is called from the
+    runner's deferred-record emit hook — i.e. at the flush point where
+    the record's device scalars are already materialized, so the JSONL
+    write forces no extra device sync.
+
+    None of this exists unless ``--obs`` is on; the off path never
+    constructs a session (bit-identical pre-obs behavior, enforced by
+    ``scripts/obs_smoke.py``).
+    """
+
+    def __init__(self, jsonl_path: str = "", trace_dir: str = "",
+                 identity: str = "run", sample_every: int = 1,
+                 tb_dir: str = ""):
+        self.identity = identity
+        self.registry = obs_metrics.MetricsRegistry()
+        self.tracer = obs_trace.Tracer()
+        self._prev_tracer = obs_trace.get_tracer()
+        obs_trace.set_tracer(self.tracer)
+        self.exports = _process_index() == 0
+        self.jsonl_path = jsonl_path
+        self.writer = RoundLogWriter(jsonl_path) if jsonl_path else None
+        self.trace_dir = trace_dir
+        self.memory = MemoryWatermark(self.registry,
+                                      sample_every=sample_every)
+        self._tb = maybe_tensorboard_writer(tb_dir) if tb_dir else None
+        self.metrics_json_path: Optional[str] = None
+        self.trace_path: Optional[str] = None
+        self._closed = False
+
+    # -- per-round hook --------------------------------------------------
+    def record_round(self, record: Dict[str, Any]) -> None:
+        """Record one round's (already materialized) record: JSONL line,
+        loss/time distributions, memory watermark sample."""
+        r = record.get("round")
+        reg = self.registry
+        reg.counter("rounds_recorded").inc()
+        for key in ("train_loss", "round_time_s", "global_loss",
+                    "personal_loss"):
+            v = record.get(key)
+            if v is not None and isinstance(v, (int, float)):
+                reg.distribution(key).observe(v)
+        # fault counters are deliberately NOT re-counted here: per-round
+        # values live on each JSONL line, and the registry totals come
+        # from the RunCounters mirror (fault_<field>_total, which also
+        # sees watchdog-discarded attempts) plus the runner's end-of-run
+        # fault_recovery_* gauges (the stat_info-authoritative block)
+        if isinstance(r, int):
+            self.memory.maybe_sample(r)
+        if self.writer is not None:
+            self.writer.write(record)
+        if self._tb is not None and isinstance(r, int):
+            for k, v in record.items():
+                if isinstance(v, (int, float)) and k != "round":
+                    try:
+                        self._tb.add_scalar(k, v, r)
+                    except Exception:  # pragma: no cover - TB quirk
+                        logger.debug("TB scalar export failed",
+                                     exc_info=True)
+
+    # -- end-of-run ------------------------------------------------------
+    def finish(self) -> Dict[str, Any]:
+        """Final memory sample, write sinks, return the registry
+        snapshot (the runner merges it into stat_info)."""
+        self.memory.sample()
+        if self.exports:
+            if self.jsonl_path:
+                self.metrics_json_path = write_metrics_json(
+                    self.registry,
+                    os.path.join(os.path.dirname(self.jsonl_path) or ".",
+                                 self.identity + ".metrics.json"))
+            if self.trace_dir:
+                self.trace_path = self.tracer.write(os.path.join(
+                    self.trace_dir, self.identity + ".trace.json"))
+        snap = self.registry.snapshot()
+        self.close()
+        return snap
+
+    def close(self) -> None:
+        """Idempotent teardown (the runner's ``finally`` path — a crash
+        must still restore the null tracer and release the file)."""
+        if self._closed:
+            return
+        self._closed = True
+        obs_trace.set_tracer(self._prev_tracer)
+        if self.writer is not None:
+            self.writer.close()
+        if self._tb is not None:
+            try:
+                self._tb.close()
+            except Exception:  # pragma: no cover
+                pass

@@ -1,0 +1,145 @@
+"""Device-memory watermark + host-RSS sampling.
+
+Makes the HBM budget observable instead of inferred (SURVEY §7: the
+~97 GB full-cohort footprint was derived by hand from array shapes).
+Two sources, best-effort per backend:
+
+* ``device.memory_stats()`` — TPU/GPU backends report
+  ``bytes_in_use`` / ``peak_bytes_in_use`` directly.
+* ``jax.live_arrays()`` fallback — the CPU backend returns no
+  ``memory_stats``; summing live-array ``nbytes`` per device gives the
+  framework-visible watermark (undercounts XLA temp buffers, which is
+  why ``source`` is recorded alongside the number).
+
+Host RSS comes from ``psutil`` when present, else
+``resource.getrusage`` (``ru_maxrss`` is a peak, noted in ``source``).
+
+Sampling runs at round BOUNDARIES only (the runner's record hook, every
+``--obs_sample_every`` rounds) — never inside a jitted region, and the
+fallback walk is O(live arrays), so the cadence knob exists for runs
+with huge array counts.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MemoryWatermark", "device_memory", "host_rss"]
+
+
+def device_memory() -> List[Dict[str, Any]]:
+    """Per-local-device memory snapshot: ``{device, platform, bytes_in_use,
+    peak_bytes_in_use?, source}``."""
+    import jax
+
+    out: List[Dict[str, Any]] = []
+    devices = jax.local_devices()
+    stats_by_dev = {}
+    fallback_needed = False
+    for d in devices:
+        s = None
+        try:
+            s = d.memory_stats()
+        except Exception:  # backend without the API at all
+            s = None
+        stats_by_dev[d] = s
+        if not s:
+            fallback_needed = True
+    live: Dict[Any, int] = {}
+    if fallback_needed:
+        for arr in jax.live_arrays():
+            try:
+                nbytes = int(arr.nbytes)
+                for d in arr.devices():
+                    # sharded arrays: attribute the per-device shard size
+                    live[d] = live.get(d, 0) + nbytes // max(
+                        1, len(arr.devices()))
+            except Exception:  # deleted/donated buffers mid-walk
+                continue
+    for i, d in enumerate(devices):
+        s = stats_by_dev[d]
+        if s:
+            rec: Dict[str, Any] = {
+                "device": i, "platform": d.platform,
+                "bytes_in_use": int(s.get("bytes_in_use", 0)),
+                "source": "memory_stats",
+            }
+            if "peak_bytes_in_use" in s:
+                rec["peak_bytes_in_use"] = int(s["peak_bytes_in_use"])
+            if "bytes_limit" in s:
+                rec["bytes_limit"] = int(s["bytes_limit"])
+        else:
+            rec = {"device": i, "platform": d.platform,
+                   "bytes_in_use": int(live.get(d, 0)),
+                   "source": "live_arrays"}
+        out.append(rec)
+    return out
+
+
+def host_rss() -> Dict[str, Any]:
+    """Host resident-set size in bytes (+ which API produced it)."""
+    try:
+        import psutil
+
+        return {"rss_bytes": int(psutil.Process().memory_info().rss),
+                "source": "psutil"}
+    except ImportError:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux (bytes on macOS); this repo targets
+        # Linux TPU hosts — and it is a PEAK, not current, hence source
+        return {"rss_bytes":
+                int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+                * 1024,
+                "source": "getrusage_peak"}
+    except Exception:  # pragma: no cover - exotic host
+        return {"rss_bytes": 0, "source": "unavailable"}
+
+
+class MemoryWatermark:
+    """Round-boundary sampler surfacing memory as registry gauges:
+    ``mem_device_bytes_in_use`` (labeled per device, plus the unlabeled
+    max over devices), ``mem_device_peak_bytes`` where the backend
+    reports it, ``mem_host_rss_bytes``."""
+
+    def __init__(self, registry, sample_every: int = 1):
+        self._registry = registry
+        self._every = max(1, int(sample_every))
+        self.samples = 0
+
+    def maybe_sample(self, round_idx: int) -> None:
+        if round_idx % self._every:
+            return
+        self.sample()
+
+    def sample(self) -> None:
+        reg = self._registry
+        try:
+            devs = device_memory()
+        except Exception:  # never let telemetry kill the run
+            logger.debug("device memory sampling failed", exc_info=True)
+            devs = []
+        in_use_max = 0
+        peak_max = None
+        for rec in devs:
+            g = reg.gauge("mem_device_bytes_in_use").labels(
+                device=rec["device"])
+            g.set(rec["bytes_in_use"])
+            in_use_max = max(in_use_max, rec["bytes_in_use"])
+            if "peak_bytes_in_use" in rec:
+                reg.gauge("mem_device_peak_bytes").labels(
+                    device=rec["device"]).set(rec["peak_bytes_in_use"])
+                peak_max = max(peak_max or 0, rec["peak_bytes_in_use"])
+        if devs:
+            reg.gauge("mem_device_bytes_in_use").set(in_use_max)
+            reg.gauge("mem_device_source").labels(
+                source=devs[0]["source"]).set(1)
+        if peak_max is not None:
+            reg.gauge("mem_device_peak_bytes").set(peak_max)
+        rss = host_rss()
+        reg.gauge("mem_host_rss_bytes").set(rss["rss_bytes"])
+        self.samples += 1

@@ -610,8 +610,19 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
         float(jax.tree_util.tree_leaves(out)[0].sum())
         return (time.perf_counter() - t0) / iters
 
-    result = {f"agg_ms_{name}": time_agg(agg_fns[name]) * 1e3
-              for name in impls if name in agg_fns}
+    # timings flow through the PROCESS-GLOBAL obs registry (labeled by
+    # impl) and the bench dict is read back from it — the bench/tooling
+    # surface; note an ObsSession snapshots its own per-run registry,
+    # so these do NOT land in a run's metrics.json
+    from ..obs import metrics as obs_metrics
+
+    agg_dist = obs_metrics.get_registry().distribution("agg_ms")
+    result = {}
+    for name in impls:
+        if name not in agg_fns:
+            continue
+        agg_dist.labels(impl=name).observe(time_agg(agg_fns[name]) * 1e3)
+        result[f"agg_ms_{name}"] = agg_dist.labels(impl=name).last
     result.update(
         n_params=n_params, n_clients=n_clients,
         n_devices=(int(mesh.shape["clients"]) if mesh is not None

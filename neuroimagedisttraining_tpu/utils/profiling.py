@@ -1,14 +1,16 @@
 """Runtime profiling hooks (the reference has none — SURVEY §5.1).
 
 Wraps ``jax.profiler`` so any federated round can be captured as an XLA
-trace viewable in TensorBoard/Perfetto, plus a lightweight wall-clock timer
-used by the benchmark harness.
+trace viewable in TensorBoard/Perfetto. The wall-clock ``Timer`` that
+lived here is deprecated in favor of the observability subsystem
+(``obs.metrics.SectionTimer`` / ``MetricsRegistry.timer``); a shim
+remains so old imports keep working. Host-side span tracing (Chrome
+trace events aligned with the XLA trace) lives in ``obs.trace``.
 """
 from __future__ import annotations
 
 import contextlib
 import logging
-import time
 from typing import Any, Dict
 
 import jax
@@ -38,25 +40,24 @@ def trace_one_round(algo, state, log_dir: str, round_idx: int = 0) -> None:
 
 
 class Timer:
-    """Accumulating wall-clock timer with named sections."""
+    """DEPRECATED shim over ``obs.metrics.SectionTimer`` — same
+    ``section``/``summary`` surface, now backed by a registry
+    distribution per section. Import ``SectionTimer`` (or use
+    ``MetricsRegistry.timer``) directly in new code."""
 
     def __init__(self):
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+        import warnings
 
-    @contextlib.contextmanager
+        from ..obs.metrics import SectionTimer
+
+        warnings.warn(
+            "utils.profiling.Timer is deprecated; use "
+            "obs.metrics.SectionTimer (or MetricsRegistry.timer)",
+            DeprecationWarning, stacklevel=2)
+        self._impl = SectionTimer()
+
     def section(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+        return self._impl.section(name)
 
     def summary(self) -> Dict[str, Any]:
-        return {
-            name: {"total_s": tot, "count": self.counts[name],
-                   "mean_s": tot / self.counts[name]}
-            for name, tot in self.totals.items()
-        }
+        return self._impl.summary()

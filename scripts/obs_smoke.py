@@ -1,0 +1,168 @@
+"""Obs smoke: the observability subsystem's end-to-end CI gate.
+
+Runs the scale-8 synthetic dry-run twice — obs off and obs on — and
+asserts the obs acceptance contract:
+
+  1. the final global model is BIT-IDENTICAL between the two runs
+     (telemetry never touches the training trajectory),
+  2. the obs run produced a valid per-round JSONL stream (every round
+     present, every line parseable, round indices strictly monotone),
+     a metrics.json snapshot merged into stat_info, and a
+     Perfetto-loadable trace file,
+  3. obs-on marginal per-round wall-clock overhead is ≤ 3% (N-vs-2N
+     wall subtraction per config, cancelling compile/setup — the same
+     methodology as chaos_smoke's guard probe).
+
+    python scripts/obs_smoke.py                     # CI gate
+    python scripts/obs_smoke.py --clients 8 --rounds 8
+    python scripts/obs_smoke.py --model 3dcnn       # dry-run-sized rounds
+
+Prints ONE JSON line; exits nonzero on any failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _build(argv_extra, clients, rounds, tmp, model="small3dcnn",
+           epochs=1):
+    from neuroimagedisttraining_tpu.experiments import parse_args
+
+    argv = [
+        "--model", model, "--dataset", "synthetic",
+        "--client_num_in_total", str(clients), "--batch_size", "8",
+        "--epochs", str(epochs), "--comm_round", str(rounds),
+        "--lr", "0.05",
+        "--log_dir", os.path.join(tmp, "LOG"),
+        "--results_dir", os.path.join(tmp, "results"),
+        "--final_finetune", "0",
+    ]
+    return parse_args(argv + list(argv_extra), algo="fedavg")
+
+
+def _check_artifacts(out, tmp, trace_dir, rounds) -> dict:
+    """The obs run's JSONL/metrics/trace artifact contract."""
+    from neuroimagedisttraining_tpu.obs.export import read_jsonl
+
+    jsonl = os.path.join(tmp, "results", "synthetic",
+                         out["identity"] + ".obs.jsonl")
+    if not os.path.exists(jsonl):
+        raise SystemExit(f"obs run wrote no JSONL stream at {jsonl}")
+    recs = read_jsonl(jsonl)  # raises on any malformed line
+    idx = [r.get("round") for r in recs]
+    if idx != sorted(idx) or len(set(idx)) != len(idx):
+        raise SystemExit(f"JSONL round indices not strictly monotone: {idx}")
+    if idx != list(range(rounds)):
+        raise SystemExit(
+            f"JSONL missing rounds: got {idx}, expected 0..{rounds - 1}")
+    for r in recs:
+        if "train_loss" not in r or "round_time_s" not in r:
+            raise SystemExit(f"JSONL record missing timing/loss keys: {r}")
+    stat = json.load(open(out["stat_path"] + ".json"))
+    if "obs_metrics" not in stat:
+        raise SystemExit("stat_info JSON missing the obs_metrics merge")
+    if stat["obs_metrics"]["rounds_recorded"]["value"] != rounds:
+        raise SystemExit("obs registry recorded a different round count")
+    trace_path = os.path.join(trace_dir, out["identity"] + ".trace.json")
+    doc = json.load(open(trace_path))
+    if not doc.get("traceEvents"):
+        raise SystemExit(f"trace file has no events: {trace_path}")
+    return {"jsonl_rounds": len(recs),
+            "trace_events": len(doc["traceEvents"]),
+            "metrics_keys": len(stat["obs_metrics"])}
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--model", type=str, default="small3dcnn",
+                   help="3dcnn sizes rounds closer to the dry-run "
+                        "workload (the smoke model's rounds are nearly "
+                        "compute-free, which inflates the overhead pct)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--max_overhead_pct", type=float, default=3.0)
+    p.add_argument("--tmp", type=str, default="",
+                   help="scratch dir (default: a fresh tempdir)")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import logging
+    import tempfile
+
+    import numpy as np
+
+    logging.getLogger().setLevel(logging.WARNING)
+    tmp = args.tmp or tempfile.mkdtemp(prefix="obs_smoke_")
+
+    from neuroimagedisttraining_tpu.experiments import run_experiment
+
+    trace_dir = os.path.join(tmp, "trace")
+    obs_flags = ["--obs", "1", "--trace_dir", trace_dir]
+
+    def timed_wall(extra, sub, n):
+        t0 = time.perf_counter()
+        out = run_experiment(
+            _build(extra + ["--frequency_of_the_test", "0"],
+                   args.clients, n, os.path.join(tmp, sub),
+                   model=args.model, epochs=args.epochs),
+            "fedavg")
+        return time.perf_counter() - t0, out
+
+    def per_round(extra, sub):
+        """Marginal per-round seconds via N-vs-2N wall subtraction: each
+        run pays its own compile (fresh jitted closures per
+        FedAlgorithm), the subtraction cancels that fixed cost."""
+        w1, _ = timed_wall(extra, sub + "_n", args.rounds)
+        w2, out2 = timed_wall(extra, sub + "_2n", 2 * args.rounds)
+        return max(w2 - w1, 1e-9) / args.rounds, out2
+
+    # process-level warmup per config (page cache / BLAS pools), then the
+    # measured N and 2N runs
+    timed_wall([], "warm_off", 1)
+    timed_wall(obs_flags, "warm_on", 1)
+    off_s, out_off = per_round([], "off")
+    on_s, out_on = per_round(obs_flags, "on")
+    overhead_pct = 100.0 * (on_s - off_s) / max(off_s, 1e-9)
+
+    # 1. bit-identical final model
+    import jax
+
+    for a, b in zip(
+            jax.tree_util.tree_leaves(out_off["state"].global_params),
+            jax.tree_util.tree_leaves(out_on["state"].global_params)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise SystemExit(
+                "obs-on run is not bit-identical to obs-off")
+
+    # 2. artifact contract (on the 2N obs run)
+    art = _check_artifacts(out_on, os.path.join(tmp, "on_2n"), trace_dir,
+                           2 * args.rounds)
+
+    # 3. overhead budget
+    if overhead_pct > args.max_overhead_pct:
+        raise SystemExit(
+            f"obs-on per-round overhead {overhead_pct:.2f}% exceeds the "
+            f"{args.max_overhead_pct:g}% budget "
+            f"(off {off_s * 1e3:.1f} ms, on {on_s * 1e3:.1f} ms)")
+
+    result = {
+        "obs_ok": True, "clients": args.clients, "rounds": args.rounds,
+        "model": args.model,
+        "round_s_obs_off": off_s, "round_s_obs_on": on_s,
+        "obs_overhead_pct": round(overhead_pct, 2),
+        "bit_identical": True, **art,
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,11 @@ cross-silo deployment path — it must round-trip ANY pytree shape/dtype/
 nesting we ship, and any mask pattern for the sparse encoding.
 """
 import numpy as np
+import pytest
+
+# hypothesis is an optional test extra (pyproject `test`); environments
+# without it must SKIP these property tests, not die at collection
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from neuroimagedisttraining_tpu.comm.message import Message

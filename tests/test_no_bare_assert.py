@@ -22,6 +22,10 @@ CONTRACT_PATHS = [
     "robust/guard.py",
     "robust/recovery.py",
     "robust/aggregation.py",
+    "obs/trace.py",
+    "obs/metrics.py",
+    "obs/export.py",
+    "obs/memory.py",
     "utils/checkpoint.py",
     "utils/records.py",
     "utils/flops.py",
