@@ -117,6 +117,32 @@ def _sync_state(state):
     return float(leaves[0].sum())
 
 
+def _emit_result(result):
+    """Print the one-JSON-line contract AND append the result to the
+    durable ``results/bench_history.jsonl`` trajectory (metric, value,
+    extra, git SHA) that ``obs/regress.py`` / ``scripts/perf_gate.py``
+    gate against. History append is best-effort: a read-only checkout
+    must never fail the bench."""
+    print(json.dumps(result))
+    try:
+        import os
+
+        from neuroimagedisttraining_tpu.obs import regress
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        regress.append_history(
+            os.path.join(root, "results", "bench_history.jsonl"),
+            result, source="bench", repo_root=root)
+    except Exception as e:  # pragma: no cover - disk/permissions
+        import sys
+
+        # stderr, NOT stdout: the one-JSON-line stdout contract feeds
+        # `bench.py | tail -1 | perf_gate.py --from-json -`
+        print(f"# bench history append skipped: {e}", file=sys.stderr,
+              flush=True)
+    return result
+
+
 def _timed_rounds(algo, state, n_rounds=10, eval_every_round=False):
     """Shared timing harness: one warmup/compile round, then n timed.
     ``eval_every_round`` also runs the full per-round eval protocol inside
@@ -347,8 +373,7 @@ def main(uneven: bool = False, test_per_client: int = None):
             "batch": BATCH,
         },
     }
-    print(json.dumps(result))
-    return result
+    return _emit_result(result)
 
 
 def tracked_config(name: str):
@@ -415,8 +440,7 @@ def tracked_config(name: str):
                       "local_epochs": epochs, "batch": bs,
                       "steps_per_epoch": -(-n_per // bs)},
         }
-        print(json.dumps(result))
-        return result
+        return _emit_result(result)
     if name == "resnet3d":
         # 3D-ResNet on full-size volumes (BASELINE "3D-ResNet full cohort").
         # Phased-stem twin since r4: the k3/s2/p3 stem at C_in=1 was 66% of
@@ -471,8 +495,7 @@ def tracked_config(name: str):
             "extra": {k: (round(v, 3) if isinstance(v, float) else v)
                       for k, v in d.items()},
         }
-        print(json.dumps(result))
-        return result
+        return _emit_result(result)
     if name == "clients32":
         # the primary workload at the NORTH-STAR client count (C=32) on
         # the one real chip (VERDICT r4 weak #4): measures the scan-length
@@ -522,8 +545,7 @@ def tracked_config(name: str):
             "unit": "rounds/sec",
             "vs_baseline": 0.0,  # no published number; tracked config
         }
-        print(json.dumps(result))
-        return result
+        return _emit_result(result)
     raise SystemExit(f"unknown BENCH_CONFIG {name!r}")
 
 

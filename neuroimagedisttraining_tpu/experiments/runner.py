@@ -552,7 +552,7 @@ def _cost_round_record(algo, cost, samples_per_client, state):
 def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                       ev_every, cost, samples_per_client, history,
                       ckpt_mgr=None, args=None, counters=None,
-                      obs_session=None):
+                      obs_session=None, obs_fault_counts=None):
     """The runner's fused round loop (--fuse_rounds K): the shared
     block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
     accounting. Masks are static here (evolving-mask algorithms are
@@ -578,7 +578,10 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
         if obs_session is not None:
             # fused records arrive at the block flush point, already
             # materialized — the JSONL write forces no device sync
-            obs_session.record_round(rec)
+            obs_session.record_round(
+                rec, extra=(obs_fault_counts(r)
+                            if obs_fault_counts is not None and r >= 0
+                            else None))
         logger.info("%s round %d: %s", algo_name, r, rec)
 
     def on_block(end_round, state_out):
@@ -681,6 +684,19 @@ def run_experiment(args: argparse.Namespace,
             logger.info("sharding clients over mesh %s", dict(mesh.shape))
         _check_augment_consistency(args, algo)
 
+        # obs-only fault-trace stamper: fault draws are pure functions of
+        # (seed, round, client id), so the deterministic replay
+        # (obs/health.py) counts this round's effective stragglers /
+        # Byzantine clients host-side — the analyzer's attribution
+        # source. Never touches the record the obs-off path sees.
+        obs_fault_counts = None
+        if obs_session is not None and getattr(args, "fault_spec", ""):
+            from ..obs.health import make_fault_counts_fn
+
+            obs_fault_counts = make_fault_counts_fn(
+                args.fault_spec, args.seed, algo.num_clients,
+                algo.clients_per_round)
+
         state = None
         start_round = 0
         if ckpt_mgr is not None and args.resume:
@@ -754,6 +770,22 @@ def run_experiment(args: argparse.Namespace,
         counters = RunCounters(
             registry=obs_session.registry if obs_session else None)
 
+        # per-round obs-only enrichment (per-site eval vectors), keyed by
+        # round and joined to the JSONL line at the deferred flush point
+        obs_extra: Dict[int, Dict[str, Any]] = {}
+
+        def _obs_extra_for(rec):
+            r = rec.get("round")
+            extra = obs_extra.pop(r, None)
+            if obs_fault_counts is not None and isinstance(r, int) \
+                    and r >= 0:
+                extra = dict(extra or {})
+                # a watchdog-retried round's ACCEPTED attempt trained
+                # the re-drawn cohort (nonce = the record's retry count)
+                extra.update(obs_fault_counts(
+                    r, retry=int(rec.get("rounds_retried") or 0)))
+            return extra
+
         def _emit(rec):
             # counters accumulate at FLUSH time, when DeferredRecords has
             # already materialized the record's device scalars — counting
@@ -762,7 +794,7 @@ def run_experiment(args: argparse.Namespace,
             # JSONL write shares the same flush point for the same reason.
             counters.update(rec)
             if obs_session is not None:
-                obs_session.record_round(rec)
+                obs_session.record_round(rec, extra=_obs_extra_for(rec))
             logger.info("%s round %s: %s", algo_name, rec["round"], rec)
 
         # with obs on, records also get round_time_s stamped at flush
@@ -827,7 +859,8 @@ def run_experiment(args: argparse.Namespace,
                 args.frequency_of_the_test or 0, cost,
                 samples_per_client, history,
                 ckpt_mgr=ckpt_mgr, args=args, counters=counters,
-                obs_session=obs_session)
+                obs_session=obs_session,
+                obs_fault_counts=obs_fault_counts)
             final_eval = None  # re-evaluated once below
 
         try:
@@ -879,6 +912,13 @@ def run_experiment(args: argparse.Namespace,
                     record.update({
                         k: v for k, v in final_eval.items()
                         if not k.startswith("acc_per")})
+                    if obs_session is not None and \
+                            "acc_per_client" in final_eval:
+                        # per-site series (obs/health.py): joins the
+                        # JSONL line only, at the deferred flush — the
+                        # history record shape stays obs-off-identical
+                        obs_extra[r] = {"acc_per_client":
+                                        final_eval["acc_per_client"]}
                 history.append(record)
                 deferred.push(record)  # counters accumulate at flush
                 if ckpt_mgr is not None:

@@ -17,10 +17,35 @@ The third leg after ``parallel/`` (comm-efficient aggregation) and
 * :mod:`~.memory` — device-HBM watermark + host-RSS sampling at round
   boundaries, surfaced as gauges.
 
+The ANALYSIS half — from recording to diagnosis (offline, CLI:
+``python -m neuroimagedisttraining_tpu.obs analyze <run_dir>``):
+
+* :mod:`~.analyze` — per-phase round-time attribution, robust
+  outlier/straggler rounds, memory-leak flagging, fault-recovery and
+  compile-cost summaries; versioned ``analysis.json`` + human report.
+* :mod:`~.health` — per-client/per-site ledger: participation and
+  fault attribution via deterministic replay, per-site accuracy
+  trajectories, degraded-site flags.
+* :mod:`~.regress` — noise-aware bench-trajectory regression detection
+  (``results/bench_history.jsonl``; CI gate: ``scripts/perf_gate.py``).
+* :mod:`~.compile` — compile-time observability: per-entry-point
+  compile wall-time via ``jax.monitoring`` listeners, cache-hit
+  counters, AOT ``cost_analysis()`` FLOPs/bytes.
+
 Nothing here enters run/checkpoint identity: telemetry never forks a
 lineage, and with ``--obs`` off every hook is a no-op (bit-identical to
 the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it).
 """
-from . import export, memory, metrics, trace
+from . import (
+    analyze,
+    compile,
+    export,
+    health,
+    memory,
+    metrics,
+    regress,
+    trace,
+)
 
-__all__ = ["export", "memory", "metrics", "trace"]
+__all__ = ["analyze", "compile", "export", "health", "memory",
+           "metrics", "regress", "trace"]

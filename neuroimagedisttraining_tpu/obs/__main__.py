@@ -1,0 +1,83 @@
+"""Telemetry analysis CLI.
+
+    # analyze every recorded run under a run dir (the
+    # <results_dir>/<dataset> directory holding *.obs.jsonl streams):
+    # prints the human report, writes <identity>.analysis.json beside
+    # each stream
+    python -m neuroimagedisttraining_tpu.obs analyze results/synthetic \
+        [--trace-dir /tmp/trace] [--no-write] [--json]
+
+    # regression-gate a value against the bench history
+    # (scripts/perf_gate.py is the fuller CI surface)
+    python -m neuroimagedisttraining_tpu.obs regress --value 1.66 \
+        --metric salientgrads_rounds_per_sec_abcd_alexnet3d_8clients \
+        [--history results/bench_history.jsonl]
+
+Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
+regress — the perf-gate codes (0 pass, 1 regression, 2 no history).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m neuroimagedisttraining_tpu.obs",
+        description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pa = sub.add_parser("analyze", help="analyze recorded run telemetry")
+    pa.add_argument("run_dir", help="directory holding *.obs.jsonl "
+                                    "streams (+ metrics/stat sidecars)")
+    pa.add_argument("--trace-dir", default="",
+                    help="where --trace_dir wrote <identity>.trace.json "
+                         "(default: look in run_dir)")
+    pa.add_argument("--no-write", action="store_true",
+                    help="do not write <identity>.analysis.json files")
+    pa.add_argument("--json", action="store_true",
+                    help="print the analysis JSON instead of the report")
+
+    pr = sub.add_parser("regress", help="bench-history regression gate")
+    pr.add_argument("--history", default="results/bench_history.jsonl")
+    pr.add_argument("--metric", required=True)
+    pr.add_argument("--value", type=float, required=True)
+    pr.add_argument("--lower-is-better", action="store_true")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "analyze":
+        from . import analyze as obs_analyze
+
+        analyses = obs_analyze.analyze_run_dir(
+            args.run_dir, trace_dir=args.trace_dir,
+            write=not args.no_write)
+        if not analyses:
+            print(f"no *.obs.jsonl streams under {args.run_dir} "
+                  "(was the run launched with --obs 1?)",
+                  file=sys.stderr)
+            return 2
+        for a in analyses:
+            if args.json:
+                print(json.dumps(a, indent=1))
+            else:
+                print(obs_analyze.render_report(a))
+                if "analysis_path" in a:
+                    print(f"analysis.json -> {a['analysis_path']}")
+                print()
+        return 0
+
+    from . import regress as obs_regress
+
+    verdict = obs_regress.gate(
+        args.history, args.metric, args.value,
+        higher_is_better=not args.lower_is_better)
+    print(json.dumps(verdict))
+    return int(verdict["exit_code"])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,552 @@
+"""Offline run analyzer: from recorded telemetry to a diagnosis.
+
+PR 3 made runs record (per-round JSONL, ``metrics.json``, host span
+traces); nothing could READ what they wrote. This module turns one
+run's artifacts into a verdict:
+
+* **per-phase round-time attribution** — host span totals folded into
+  phases (``sample`` / ``train_dispatch`` / ``train_flush`` / ``eval``
+  / ``finalize`` / ``setup``), the JSONL ``round_time_s`` series as the
+  wall-clock denominator, and the un-attributed remainder reported
+  honestly as ``device_and_wait`` (the in-jit phases — ``local_train``
+  / ``guard`` / ``aggregate`` — are XLA ``named_scope``s, visible in a
+  ``--profile_dir`` device trace, not in host spans);
+* **robust outlier / straggler rounds** — median/MAD flags on the
+  ``round_time_s`` series (a deviation floor keeps a near-constant
+  series from flagging noise), cross-referenced with the deterministic
+  fault-trace replay (``robust.faults.fault_trace_round``) so a round
+  whose cohort contained injected stragglers is flagged with the exact
+  round index and the ``train`` phase;
+* **memory watermark trend** — least-squares slope + monotonicity over
+  the per-round ``mem_*`` samples, flagging suspected leaks;
+* **fault-recovery summary** and the **per-site health ledger**
+  (``obs/health.py``), plus **compile-cost** totals when the run's
+  registry recorded them (``obs/compile.py``).
+
+Everything is offline and side-effect-free: the analyzer never touches
+run identity, and obs-off runs (no JSONL) simply have nothing to
+analyze. Output is a versioned machine-readable dict
+(:data:`ANALYSIS_SCHEMA_VERSION`, written as ``<identity>.analysis.json``)
+plus a human-readable report. CLI:
+``python -m neuroimagedisttraining_tpu.obs analyze <run_dir>``.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import export as obs_export
+
+__all__ = [
+    "ANALYSIS_SCHEMA_VERSION", "analyze_records", "analyze_run_dir",
+    "render_report", "validate_analysis", "write_analysis",
+]
+
+#: version of the analysis.json schema this module emits
+ANALYSIS_SCHEMA_VERSION = 1
+
+#: host span name -> phase bucket. Container / nested spans are mapped
+#: to None and skipped so phase totals never double-count (``round``
+#: contains sample+dispatch; ``init_state`` contains ``snip_mask``;
+#: ``finalize`` contains ``finetune``). Unknown spans -> other_host.
+PHASE_OF_SPAN: Dict[str, Optional[str]] = {
+    "sample": "sample",
+    "dispatch_round": "train_dispatch",
+    "fused_block_dispatch": "train_dispatch",
+    "fused_block_flush": "train_flush",
+    "eval": "eval",
+    "finalize": "finalize",
+    "build": "setup",
+    "init_state": "setup",
+    "round": None,
+    "snip_mask": None,
+    "finetune": None,
+}
+
+#: a round is an outlier when its |round_time_s - median| exceeds this
+#: many robust standard deviations (1.4826 * MAD)
+OUTLIER_MAD_K = 3.5
+
+#: deviation floor as a fraction of the median: a series of
+#: near-identical times (MAD ~ 0) must not flag sub-percent noise
+OUTLIER_REL_FLOOR = 0.05
+
+#: minimum rounds before timing outliers are judged at all
+MIN_ROUNDS_FOR_OUTLIERS = 5
+
+#: memory-leak heuristic: at least this many samples, at least this
+#: fraction of successive deltas increasing, and at least this total
+#: growth (percent of the first sample)
+LEAK_MIN_SAMPLES = 6
+LEAK_MIN_INCREASE_FRACTION = 0.75
+LEAK_MIN_GROWTH_PCT = 2.0
+
+#: mem record field -> memory-series key in the analysis
+MEMORY_FIELDS = {
+    "mem_host_rss_bytes": "host_rss",
+    "mem_device_bytes_in_use": "device_in_use",
+}
+
+#: per-round fault count fields summed into the fault summary
+FAULT_FIELDS = ("clients_dropped", "clients_quarantined",
+                "clients_straggled", "clients_byzantine",
+                "round_skipped")
+
+
+def _round_records(records: List[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    return [r for r in records
+            if isinstance(r.get("round"), (int, float))
+            and int(r["round"]) >= 0]
+
+
+# ---------------------------------------------------------------------------
+# section analyzers
+# ---------------------------------------------------------------------------
+
+def _analyze_rounds(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    idx = [int(r["round"]) for r in records]
+    seen = set()
+    dups = sorted({i for i in idx if i in seen or seen.add(i)})
+    out: Dict[str, Any] = {"count": len(set(idx)),
+                           "first": min(idx) if idx else None,
+                           "last": max(idx) if idx else None,
+                           "duplicates": dups, "missing": []}
+    if idx:
+        out["missing"] = sorted(
+            set(range(min(idx), max(idx) + 1)) - set(idx))
+    return out
+
+
+def _analyze_round_time(records: List[Dict[str, Any]]
+                        ) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    series = [(int(r["round"]), float(r["round_time_s"]))
+              for r in records
+              if isinstance(r.get("round_time_s"), (int, float))]
+    if not series:
+        return {"present": False, "rounds": 0}, []
+    from .metrics import mad as _mad, median as _median
+
+    xs = [v for _, v in series]
+    med = _median(xs)
+    mad = _mad(xs, med)
+    sigma = max(1.4826 * mad, OUTLIER_REL_FLOOR * med, 1e-9)
+    stats = {
+        "present": True, "rounds": len(xs), "total_s": sum(xs),
+        "mean_s": sum(xs) / len(xs), "median_s": med, "mad_s": mad,
+        "min_s": min(xs), "max_s": max(xs),
+    }
+    outliers: List[Dict[str, Any]] = []
+    if len(xs) >= MIN_ROUNDS_FOR_OUTLIERS:
+        for r, v in series:
+            dev = (v - med) / sigma
+            if abs(dev) > OUTLIER_MAD_K:
+                outliers.append({
+                    "round": r, "round_time_s": v,
+                    "deviation_sigmas": round(dev, 2),
+                    "kind": "slow" if dev > 0 else "fast",
+                })
+    return stats, outliers
+
+
+def _span_list(trace_doc: Optional[Dict[str, Any]]
+               ) -> List[Dict[str, Any]]:
+    if not trace_doc:
+        return []
+    return [e for e in trace_doc.get("traceEvents", ())
+            if e.get("ph") == "X" and isinstance(e.get("dur"),
+                                                 (int, float))]
+
+
+def _analyze_phases(spans: List[Dict[str, Any]],
+                    wall_total_s: Optional[float]) -> Dict[str, Any]:
+    totals: Dict[str, Dict[str, float]] = {}
+    for e in spans:
+        phase = PHASE_OF_SPAN.get(e.get("name"), "other_host")
+        if phase is None:
+            continue
+        t = totals.setdefault(phase, {"total_s": 0.0, "count": 0})
+        t["total_s"] += float(e["dur"]) / 1e6  # trace dur is in us
+        t["count"] += 1
+    phases: Dict[str, Any] = {}
+    for name, t in sorted(totals.items()):
+        phases[name] = {
+            "total_s": t["total_s"], "count": int(t["count"]),
+            "mean_s": t["total_s"] / max(1, t["count"]),
+        }
+    if wall_total_s is not None:
+        # the wall denominator covers the ROUND loop; per-round host
+        # phases are sample + train dispatch/flush + eval
+        in_round = sum(phases[p]["total_s"] for p in
+                       ("sample", "train_dispatch", "train_flush",
+                        "eval") if p in phases)
+        phases["device_and_wait"] = {
+            "total_s": max(0.0, wall_total_s - in_round),
+            "count": 0, "mean_s": 0.0,
+        }
+        for name, p in phases.items():
+            p["share_of_wall"] = (round(p["total_s"] / wall_total_s, 4)
+                                  if wall_total_s > 0 else None)
+    return phases
+
+
+def _analyze_memory(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"present": False, "series": {},
+                           "leaks_suspected": []}
+    for field, key in MEMORY_FIELDS.items():
+        series = [(int(r["round"]), float(r[field])) for r in records
+                  if isinstance(r.get(field), (int, float))]
+        if len(series) < 2:
+            continue
+        out["present"] = True
+        rounds = [float(r) for r, _ in series]
+        vals = [v for _, v in series]
+        n = len(vals)
+        # least-squares slope (bytes per round)
+        mr, mv = sum(rounds) / n, sum(vals) / n
+        denom = sum((r - mr) ** 2 for r in rounds) or 1.0
+        slope = sum((r - mr) * (v - mv)
+                    for (r, v) in zip(rounds, vals)) / denom
+        deltas = [b - a for a, b in zip(vals, vals[1:])]
+        inc_frac = (sum(1 for d in deltas if d > 0) / len(deltas)
+                    if deltas else 0.0)
+        growth = vals[-1] - vals[0]
+        growth_pct = (100.0 * growth / vals[0]) if vals[0] else 0.0
+        leak = bool(n >= LEAK_MIN_SAMPLES
+                    and inc_frac >= LEAK_MIN_INCREASE_FRACTION
+                    and growth > 0
+                    and growth_pct >= LEAK_MIN_GROWTH_PCT)
+        out["series"][key] = {
+            "samples": n, "first_bytes": vals[0], "last_bytes": vals[-1],
+            "growth_bytes": growth, "growth_pct": round(growth_pct, 3),
+            "slope_bytes_per_round": slope,
+            "increase_fraction": round(inc_frac, 3),
+            "leak_suspected": leak,
+        }
+        if leak:
+            out["leaks_suspected"].append(key)
+    return out
+
+
+def _analyze_faults(records: List[Dict[str, Any]],
+                    metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    totals = {f: 0.0 for f in FAULT_FIELDS}
+    rounds_with = 0
+    for r in records:
+        hit = False
+        for f in FAULT_FIELDS:
+            v = r.get(f)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                totals[f] += float(v)
+                hit = hit or v > 0
+        rounds_with += bool(hit)
+    registry = {}
+    for name, m in (metrics or {}).items():
+        if name.startswith("fault_recovery_") and isinstance(m, dict):
+            registry[name[len("fault_recovery_"):]] = m.get("value")
+    return {**{k: v for k, v in totals.items()},
+            "rounds_with_faults": rounds_with, "registry": registry}
+
+
+def _straggler_rounds(records: List[Dict[str, Any]],
+                      outliers: List[Dict[str, Any]],
+                      config: Optional[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """Straggler flags from both evidence sources, keyed by round.
+
+    * ``fault_trace`` — the stream recorded ``clients_straggled > 0``
+      (the runner's obs-time replay stamp), or the replay recomputes it
+      here from the run config when the stream predates the stamp;
+    * ``round_time`` — the round is a slow MAD outlier. The FIRST
+      round of the series is exempt from timing-only flags: its wall
+      time includes compilation (the analyzer's compile section prices
+      that separately), which is not a straggler. It still appears in
+      ``outlier_rounds``.
+
+    A round backed by the fault trace is attributed to the ``train``
+    phase (stragglers return partial local-training work); a purely
+    timing-based flag stays unattributed (``phase: null``) rather than
+    guessing.
+    """
+    by_round: Dict[int, Dict[str, Any]] = {}
+    counts_fn = None
+    cfg = config or {}
+    if cfg.get("fault_spec") and cfg.get("client_num_in_total"):
+        from .health import make_fault_counts_fn
+
+        counts_fn = make_fault_counts_fn(
+            str(cfg["fault_spec"]), int(cfg.get("seed") or 0),
+            int(cfg["client_num_in_total"]),
+            int(cfg.get("client_num_per_round")
+                or cfg["client_num_in_total"]))
+    for r in records:
+        idx = int(r["round"])
+        n = r.get("clients_straggled")
+        if n is None and counts_fn is not None:
+            n = counts_fn(idx, retry=int(r.get("rounds_retried") or 0)
+                          )["clients_straggled"]
+        if isinstance(n, (int, float)) and n > 0:
+            by_round[idx] = {"round": idx, "phase": "train",
+                             "source": "fault_trace",
+                             "clients_straggled": float(n)}
+    first_round = min((int(r["round"]) for r in records), default=None)
+    for o in outliers:
+        if o["kind"] != "slow":
+            continue
+        e = by_round.get(o["round"])
+        if e is None:
+            if o["round"] == first_round:
+                continue  # compile round, not a straggler
+            by_round[o["round"]] = {
+                "round": o["round"], "phase": None,
+                "source": "round_time",
+                "deviation_sigmas": o["deviation_sigmas"]}
+        else:
+            e["source"] = "fault_trace+round_time"
+            e["deviation_sigmas"] = o["deviation_sigmas"]
+    return [by_round[k] for k in sorted(by_round)]
+
+
+def _analyze_compile(metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    m = metrics or {}
+    out: Dict[str, Any] = {"present": False, "total_s": 0.0,
+                           "by_entry": {}, "cache": {}}
+    for name in ("compile_trace_s", "compile_lower_s",
+                 "compile_backend_s"):
+        entry = m.get(name)
+        if not isinstance(entry, dict):
+            continue
+        out["present"] = True
+        val = entry.get("value") or {}
+        out["total_s"] += float(val.get("sum") or 0.0)
+        for label, v in (entry.get("labeled") or {}).items():
+            # "entry=dispatch_round" -> dispatch_round
+            key = label.split("=", 1)[-1]
+            agg = out["by_entry"].setdefault(
+                key, {"total_s": 0.0, "count": 0})
+            agg["total_s"] += float((v or {}).get("sum") or 0.0)
+            agg["count"] += int((v or {}).get("count") or 0)
+    for name, entry in m.items():
+        if name.startswith("compile_cache_") and isinstance(entry, dict):
+            out["cache"][name[len("compile_cache_"):]] = entry.get("value")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# top level
+# ---------------------------------------------------------------------------
+
+def analyze_records(records: List[Dict[str, Any]],
+                    trace_doc: Optional[Dict[str, Any]] = None,
+                    metrics: Optional[Dict[str, Any]] = None,
+                    config: Optional[Dict[str, Any]] = None,
+                    identity: str = "run") -> Dict[str, Any]:
+    """Pure-function analyzer core over an already-loaded round stream
+    (plus optional trace / metrics.json / run-config dicts)."""
+    newer = [r.get("obs_schema") for r in records
+             if isinstance(r.get("obs_schema"), int)
+             and r["obs_schema"] > obs_export.OBS_SCHEMA_VERSION]
+    if newer:
+        raise ValueError(
+            f"round stream carries obs_schema {max(newer)} but this "
+            f"analyzer understands <= {obs_export.OBS_SCHEMA_VERSION} "
+            "— upgrade before analyzing")
+    # duplicate detection wants the RAW stream; everything else the
+    # deduped (keep-last, sorted) timeline
+    rounds_info = _analyze_rounds(_round_records(records))
+    records = obs_export.dedupe_rounds(records)
+    rounds = _round_records(records)
+    rt_stats, outliers = _analyze_round_time(rounds)
+    wall = rt_stats.get("total_s") if rt_stats.get("present") else None
+    from .health import build_health_ledger
+
+    health = build_health_ledger(rounds, config)
+    stragglers = _straggler_rounds(rounds, outliers, config)
+    analysis = {
+        "schema_version": ANALYSIS_SCHEMA_VERSION,
+        "identity": identity,
+        "rounds": rounds_info,
+        "round_time": rt_stats,
+        "phases": _analyze_phases(_span_list(trace_doc), wall),
+        "outlier_rounds": outliers,
+        "stragglers": stragglers,
+        "memory": _analyze_memory(rounds),
+        "faults": _analyze_faults(rounds, metrics),
+        "compile": _analyze_compile(metrics),
+        "health": health,
+    }
+    flags = []
+    flags += [f"straggler_round_{s['round']}" for s in stragglers]
+    flags += [f"memory_leak_{k}"
+              for k in analysis["memory"]["leaks_suspected"]]
+    flags += [f"missing_rounds_{len(analysis['rounds']['missing'])}"
+              ] if analysis["rounds"]["missing"] else []
+    flags += [f"degraded_site_{c}" for c in health["degraded_sites"]]
+    analysis["flags"] = flags
+    return analysis
+
+
+#: required top-level keys and their types — the schema contract tests
+#: and scripts/obs_smoke.py validate against
+_SCHEMA_KEYS = {
+    "schema_version": int, "identity": str, "rounds": dict,
+    "round_time": dict, "phases": dict, "outlier_rounds": list,
+    "stragglers": list, "memory": dict, "faults": dict,
+    "compile": dict, "health": dict, "flags": list,
+}
+
+
+def validate_analysis(analysis: Dict[str, Any]) -> None:
+    """Raise ValueError describing every schema violation (an explicit
+    raise, not an assert — this runs under CI gates)."""
+    problems = []
+    if not isinstance(analysis, dict):
+        raise ValueError(f"analysis is {type(analysis).__name__}, "
+                         "expected dict")
+    for key, typ in _SCHEMA_KEYS.items():
+        if key not in analysis:
+            problems.append(f"missing key {key!r}")
+        elif not isinstance(analysis[key], typ):
+            problems.append(
+                f"key {key!r} is {type(analysis[key]).__name__}, "
+                f"expected {typ.__name__}")
+    if not problems and \
+            analysis["schema_version"] > ANALYSIS_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {analysis['schema_version']} newer than "
+            f"supported {ANALYSIS_SCHEMA_VERSION}")
+    if not problems:
+        try:
+            json.dumps(analysis)
+        except (TypeError, ValueError) as e:
+            problems.append(f"not JSON-serializable: {e}")
+    if problems:
+        raise ValueError("invalid analysis: " + "; ".join(problems))
+
+
+def write_analysis(analysis: Dict[str, Any], path: str) -> str:
+    validate_analysis(analysis)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(analysis, f, indent=1)
+    return path
+
+
+def _maybe_json(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    if not path or not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def analyze_run_dir(run_dir: str, trace_dir: str = "",
+                    write: bool = True) -> List[Dict[str, Any]]:
+    """Analyze every run recorded under ``run_dir`` (the
+    ``<results_dir>/<dataset>`` directory holding ``*.obs.jsonl``
+    streams and their sidecars). Returns one analysis per run; with
+    ``write`` each is also written as ``<identity>.analysis.json``
+    beside its stream."""
+    if not os.path.isdir(run_dir):
+        raise ValueError(f"not a directory: {run_dir}")
+    out = []
+    for fname in sorted(os.listdir(run_dir)):
+        if not fname.endswith(".obs.jsonl"):
+            continue
+        identity = fname[:-len(".obs.jsonl")]
+        records = obs_export.read_jsonl(os.path.join(run_dir, fname))
+        metrics = _maybe_json(
+            os.path.join(run_dir, identity + ".metrics.json"))
+        stat = _maybe_json(os.path.join(run_dir, identity + ".json"))
+        trace_doc = None
+        for td in filter(None, (trace_dir, run_dir)):
+            trace_doc = _maybe_json(
+                os.path.join(td, identity + ".trace.json"))
+            if trace_doc is not None:
+                break
+        analysis = analyze_records(
+            records, trace_doc=trace_doc, metrics=metrics,
+            config=(stat or {}).get("config"), identity=identity)
+        if write:
+            analysis["analysis_path"] = write_analysis(
+                analysis, os.path.join(run_dir,
+                                       identity + ".analysis.json"))
+        out.append(analysis)
+    return out
+
+
+def render_report(analysis: Dict[str, Any]) -> str:
+    """The human-readable side of ``analysis.json``."""
+    from .health import render_health
+
+    a = analysis
+    lines = [f"== telemetry analysis: {a['identity']} "
+             f"(schema v{a['schema_version']}) =="]
+    r = a["rounds"]
+    lines.append(f"rounds: {r['count']} "
+                 f"[{r['first']}..{r['last']}]"
+                 + (f", missing {r['missing']}" if r["missing"] else "")
+                 + (f", duplicates {r['duplicates']}"
+                    if r["duplicates"] else ""))
+    rt = a["round_time"]
+    if rt.get("present"):
+        lines.append(
+            f"round time: median {rt['median_s'] * 1e3:.1f} ms, "
+            f"mad {rt['mad_s'] * 1e3:.1f} ms, total {rt['total_s']:.2f} s"
+            f" over {rt['rounds']} rounds")
+    else:
+        lines.append("round time: not recorded (pre-obs stream?)")
+    if a["phases"]:
+        lines.append("phase attribution (host spans vs round wall):")
+        for name, p in sorted(a["phases"].items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            share = p.get("share_of_wall")
+            lines.append(
+                f"  {name:<16} {p['total_s'] * 1e3:9.1f} ms"
+                + (f"  ({100 * share:5.1f}% of wall)"
+                   if share is not None else ""))
+        lines.append("  (in-jit phases local_train/guard/aggregate are "
+                     "XLA named_scopes: see --profile_dir device trace)")
+    for s in a["stragglers"]:
+        lines.append(
+            f"STRAGGLER round {s['round']}: source={s['source']}, "
+            f"phase={s['phase'] or 'unattributed'}"
+            + (f", clients={s['clients_straggled']:g}"
+               if "clients_straggled" in s else ""))
+    for o in a["outlier_rounds"]:
+        lines.append(f"outlier round {o['round']}: {o['kind']} "
+                     f"({o['deviation_sigmas']:+.1f} sigma, "
+                     f"{o['round_time_s'] * 1e3:.1f} ms)")
+    mem = a["memory"]
+    if mem["present"]:
+        for key, s in mem["series"].items():
+            lines.append(
+                f"memory[{key}]: {s['first_bytes'] / 1e6:.1f} -> "
+                f"{s['last_bytes'] / 1e6:.1f} MB "
+                f"({s['growth_pct']:+.2f}%, "
+                f"slope {s['slope_bytes_per_round'] / 1e3:.1f} KB/round)"
+                + ("  LEAK SUSPECTED" if s["leak_suspected"] else ""))
+    f = a["faults"]
+    if f["rounds_with_faults"]:
+        lines.append(
+            "faults: " + ", ".join(
+                f"{k}={f[k]:g}" for k in FAULT_FIELDS if f.get(k)))
+    c = a["compile"]
+    if c["present"]:
+        lines.append(f"compile: {c['total_s']:.2f} s total"
+                     + (", by entry: " + ", ".join(
+                         f"{k}={v['total_s']:.2f}s"
+                         for k, v in sorted(
+                             c["by_entry"].items(),
+                             key=lambda kv: -kv[1]["total_s"]))
+                        if c["by_entry"] else ""))
+        if c["cache"]:
+            lines.append("compile cache: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(c["cache"].items())
+                if isinstance(v, (int, float))))
+    lines.append(render_health(a["health"]))
+    lines.append("flags: " + (", ".join(a["flags"]) or "none"))
+    return "\n".join(lines)

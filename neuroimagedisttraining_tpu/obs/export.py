@@ -27,9 +27,15 @@ from .memory import MemoryWatermark
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "ObsSession", "RoundLogWriter", "maybe_tensorboard_writer",
-    "merge_host_jsonl", "write_metrics_json",
+    "OBS_SCHEMA_VERSION", "ObsSession", "RoundLogWriter",
+    "dedupe_rounds", "maybe_tensorboard_writer", "merge_host_jsonl",
+    "write_metrics_json",
 ]
+
+#: version of the per-round JSONL record schema (stamped on every
+#: exported line; obs/analyze.py refuses records from a NEWER schema
+#: than it understands instead of misreading them)
+OBS_SCHEMA_VERSION = 1
 
 
 def _process_index() -> int:
@@ -56,6 +62,23 @@ def _json_default(v: Any) -> Any:
     except ImportError:  # pragma: no cover
         pass
     return str(v)
+
+
+def _json_safe_value(v: Any) -> Any:
+    """Obs-extra enrichment values -> JSON-native (1-d arrays become
+    float lists; scalars become floats; everything else passes through
+    to the writer's default handler)."""
+    try:
+        import numpy as np
+
+        if isinstance(v, np.generic):
+            return v.item()
+        arr = np.asarray(v)
+        if arr.ndim == 1 and arr.dtype.kind in "fiu":
+            return [float(x) for x in arr]
+    except Exception:  # non-array extras (strings, dicts)
+        pass
+    return v
 
 
 class RoundLogWriter:
@@ -112,13 +135,41 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
     return out
 
 
-def merge_host_jsonl(paths: List[str]) -> List[Dict[str, Any]]:
+def dedupe_rounds(records: List[Dict[str, Any]],
+                  key: str = "round") -> List[Dict[str, Any]]:
+    """Deterministic timeline repair for one stream: keep the LAST
+    record per round index (an interrupted run that was rerun under the
+    same identity APPENDS — the later attempt's record supersedes the
+    orphaned one), then sort by round. Records without the key (e.g. a
+    stream-level header) are dropped — they are not rounds. The
+    round=-1 final record sorts first and survives as its own key."""
+    last: Dict[Any, Dict[str, Any]] = {}
+    for rec in records:
+        r = rec.get(key)
+        if r is None:
+            continue
+        last[r] = rec
+    return [last[r] for r in sorted(last)]
+
+
+def merge_host_jsonl(paths: List[str],
+                     dedupe: bool = True) -> List[Dict[str, Any]]:
     """Fold per-host round streams into one timeline: records gain a
     ``host`` field (their stream's position in ``paths``) and sort by
-    ``(round, host)`` — a stable global view of a multi-process run."""
+    ``(round, host)`` — a stable global view of a multi-process run.
+
+    Hardened against the timelines real runs produce: an empty (or
+    all-blank) stream contributes nothing; out-of-order records sort
+    deterministically; with ``dedupe`` (default) duplicate rounds
+    WITHIN one host's stream keep the last occurrence (the rerun-
+    appends semantics of :class:`RoundLogWriter`) — the same round on
+    DIFFERENT hosts is not a duplicate, it is the multihost fold."""
     merged: List[Dict[str, Any]] = []
     for host, p in enumerate(paths):
-        for rec in read_jsonl(p):
+        recs = read_jsonl(p)
+        if dedupe:
+            recs = dedupe_rounds(recs)
+        for rec in recs:
             rec = dict(rec)
             rec.setdefault("host", host)
             merged.append(rec)
@@ -178,6 +229,7 @@ class ObsSession:
                  tb_dir: str = ""):
         self.identity = identity
         self.registry = obs_metrics.MetricsRegistry()
+        self.registry.gauge("obs_schema_version").set(OBS_SCHEMA_VERSION)
         self.tracer = obs_trace.Tracer()
         self._prev_tracer = obs_trace.get_tracer()
         obs_trace.set_tracer(self.tracer)
@@ -187,15 +239,27 @@ class ObsSession:
         self.trace_dir = trace_dir
         self.memory = MemoryWatermark(self.registry,
                                       sample_every=sample_every)
+        # compile-time observability (obs/compile.py): jax.monitoring
+        # listeners live only while a session does, so obs-off runs
+        # never touch the monitoring hot path
+        from .compile import CompileWatch
+
+        self.compile_watch = CompileWatch(self.registry).install()
         self._tb = maybe_tensorboard_writer(tb_dir) if tb_dir else None
         self.metrics_json_path: Optional[str] = None
         self.trace_path: Optional[str] = None
         self._closed = False
 
     # -- per-round hook --------------------------------------------------
-    def record_round(self, record: Dict[str, Any]) -> None:
+    def record_round(self, record: Dict[str, Any],
+                     extra: Optional[Dict[str, Any]] = None) -> None:
         """Record one round's (already materialized) record: JSONL line,
-        loss/time distributions, memory watermark sample."""
+        loss/time distributions, memory watermark sample.
+
+        ``extra`` is obs-ONLY enrichment (per-site eval vectors, the
+        runner's fault-trace stamps): it joins the exported JSONL line
+        but never mutates ``record`` itself — the caller's history (and
+        with it the obs-off record shape) stays untouched."""
         r = record.get("round")
         reg = self.registry
         reg.counter("rounds_recorded").inc()
@@ -209,10 +273,19 @@ class ObsSession:
         # from the RunCounters mirror (fault_<field>_total, which also
         # sees watchdog-discarded attempts) plus the runner's end-of-run
         # fault_recovery_* gauges (the stat_info-authoritative block)
+        mem_sample = None
         if isinstance(r, int):
-            self.memory.maybe_sample(r)
+            mem_sample = self.memory.maybe_sample(r)
         if self.writer is not None:
-            self.writer.write(record)
+            out = dict(record)
+            out["obs_schema"] = OBS_SCHEMA_VERSION
+            if mem_sample:
+                # per-round memory series: what obs/analyze.py's leak
+                # detector trends over (gauges are last-value-wins)
+                out.update(mem_sample)
+            for k, v in (extra or {}).items():
+                out[k] = _json_safe_value(v)
+            self.writer.write(out)
         if self._tb is not None and isinstance(r, int):
             for k, v in record.items():
                 if isinstance(v, (int, float)) and k != "round":
@@ -227,6 +300,7 @@ class ObsSession:
         """Final memory sample, write sinks, return the registry
         snapshot (the runner merges it into stat_info)."""
         self.memory.sample()
+        self.compile_watch.summarize()
         if self.exports:
             if self.jsonl_path:
                 self.metrics_json_path = write_metrics_json(
@@ -247,6 +321,7 @@ class ObsSession:
             return
         self._closed = True
         obs_trace.set_tracer(self._prev_tracer)
+        self.compile_watch.uninstall()
         if self.writer is not None:
             self.writer.close()
         if self._tb is not None:

@@ -1,0 +1,244 @@
+"""Per-client / per-site health ledger over a recorded round stream.
+
+The paper's premise is federated training across ~21 heterogeneous ABCD
+acquisition sites, but the per-round JSONL stream records cohort-level
+aggregates — nothing in the repo could answer "which SITE is unhealthy".
+This module reconstructs the per-site view OFFLINE from three sources:
+
+1. **Participation replay** — cohort draws are a pure function of the
+   round index (``algorithms.base.sample_client_indexes``, the
+   reference's comparability contract), so each round's selected
+   clients are recomputable from ``(round, client_num_in_total,
+   client_num_per_round)`` alone — no recording needed.
+2. **Fault-trace replay** — fault draws are a pure function of
+   ``(seed, round, client id)`` (``robust.faults.fault_trace_round``),
+   so drop / straggle / NaN-poison / Byzantine events attribute to
+   exact (round, site) pairs offline. Determinism bought attribution.
+3. **Recorded per-site series** — when the obs stream carries
+   ``acc_per_client`` (stamped by the runner on eval rounds with
+   ``--obs`` on), each site gets a global-model accuracy trajectory.
+
+The ledger feeds ``obs/analyze.py``'s report and flags degraded sites:
+repeated faults, or an accuracy trajectory whose recent half regressed
+against its earlier half by more than :data:`DEGRADED_ACC_DROP`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["build_health_ledger", "make_fault_counts_fn",
+           "render_health", "replay_client_indexes"]
+
+#: a site is flagged when its mean accuracy over the most recent half of
+#: its trajectory sits this far below the earlier half (absolute)
+DEGRADED_ACC_DROP = 0.05
+
+#: minimum recorded eval points before an accuracy trend is judged
+MIN_TREND_POINTS = 4
+
+#: a site is flagged when this fraction (or more) of its participations
+#: ended in a fault (drop / quarantine-grade poison)
+DEGRADED_FAULT_RATE = 0.5
+
+
+def _round_indices(records: List[Dict[str, Any]]) -> List[int]:
+    return sorted({int(r["round"]) for r in records
+                   if isinstance(r.get("round"), (int, float))
+                   and int(r.get("round", -1)) >= 0})
+
+
+def _effective_straggled(tr: Dict[str, Any]):
+    """Straggle draws that actually took effect in the round program:
+    ``make_fault_fn`` lets Byzantine scaling override the straggle
+    factor and NaN poison override every delta transform, and a dropped
+    client's payload never reaches the server at all."""
+    import numpy as np
+
+    return np.logical_and.reduce([
+        tr["straggled"],
+        np.logical_not(tr["byzantine"]),
+        np.logical_not(tr["poisoned"]),
+        np.logical_not(tr["dropped"]),
+    ])
+
+
+def replay_client_indexes(round_idx: int, num_clients: int,
+                          clients_per_round: int, retry: int = 0):
+    """Offline twin of ``algorithms.base.sample_client_indexes``: the
+    identical draw (it IS that function), but with the process-global
+    numpy RNG state saved and restored around the reseed — the runner
+    stamps counts mid-round-loop, and telemetry must not leave RNG
+    side effects behind (the bit-identity contract). ``retry`` is the
+    accepted attempt's watchdog nonce (``rounds_retried`` on the
+    record): a retried round trained a RE-DRAWN cohort, and replaying
+    nonce 0 would attribute faults to clients that never ran."""
+    import numpy as np
+
+    from ..algorithms.base import sample_client_indexes
+
+    state = np.random.get_state()
+    try:
+        return sample_client_indexes(
+            round_idx, num_clients, clients_per_round, retry=retry)
+    finally:
+        np.random.set_state(state)
+
+
+def make_fault_counts_fn(fault_spec: str, seed: int, num_clients: int,
+                         clients_per_round: int):
+    """Per-round fault-count stamper for the runner's obs path: returns
+    ``fn(round, retry=0) -> {"clients_straggled",
+    "clients_byzantine"}`` counted over that round's REPLAYED cohort
+    (drop/quarantine counts are measured in-jit by the guard and
+    deliberately not replayed here). Returns None when the spec
+    injects nothing."""
+    from ..robust.faults import fault_trace_round, parse_fault_spec
+
+    spec = parse_fault_spec(fault_spec)
+    if spec is None or not spec.any_active:
+        return None
+
+    def counts(round_idx: int, retry: int = 0) -> Dict[str, float]:
+        sel = replay_client_indexes(
+            round_idx, num_clients, clients_per_round, retry=retry)
+        tr = fault_trace_round(spec, seed, round_idx, sel)
+        return {
+            "clients_straggled": float(_effective_straggled(tr).sum()),
+            "clients_byzantine": float(
+                (tr["byzantine"] & ~tr["poisoned"]
+                 & ~tr["dropped"]).sum()),
+        }
+
+    return counts
+
+
+def build_health_ledger(records: List[Dict[str, Any]],
+                        config: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """The per-site ledger for one run's (deduped) round stream.
+
+    ``config`` is the run's recorded flag namespace (the stat_info JSON
+    sidecar's ``config`` block); without it the replay sources are
+    unavailable and the ledger degrades to the recorded series only.
+    """
+    import numpy as np
+
+    config = config or {}
+    rounds = _round_indices(records)
+    num_clients = int(config.get("client_num_in_total") or 0)
+    clients_per_round = int(config.get("client_num_per_round")
+                            or num_clients)
+    seed = int(config.get("seed") or 0)
+    fault_spec = str(config.get("fault_spec") or "")
+
+    ledger: Dict[str, Any] = {
+        "sites": {}, "degraded_sites": [], "rounds_analyzed": len(rounds),
+        "num_clients": num_clients, "replay": {
+            "participation": bool(num_clients and rounds),
+            "faults": False,
+        },
+    }
+    if not num_clients or not rounds:
+        return ledger
+
+    # the accepted attempt of a watchdog-retried round trained a
+    # RE-DRAWN cohort; its nonce is the record's rounds_retried
+    retry_of = {int(r["round"]): int(r.get("rounds_retried") or 0)
+                for r in records
+                if isinstance(r.get("round"), (int, float))
+                and isinstance(r.get("rounds_retried"), (int, float))}
+
+    participated = np.zeros(num_clients, np.int64)
+    dropped = np.zeros(num_clients, np.int64)
+    poisoned = np.zeros(num_clients, np.int64)
+    straggled = np.zeros(num_clients, np.int64)
+    byzantine = np.zeros(num_clients, np.int64)
+
+    spec = None
+    if fault_spec:
+        from ..robust.faults import parse_fault_spec
+
+        spec = parse_fault_spec(fault_spec)
+        if spec is not None and not spec.any_active:
+            spec = None
+    ledger["replay"]["faults"] = spec is not None
+
+    for r in rounds:
+        sel = replay_client_indexes(r, num_clients, clients_per_round,
+                                    retry=retry_of.get(r, 0))
+        participated[sel] += 1
+        if spec is not None:
+            from ..robust.faults import fault_trace_round
+
+            tr = fault_trace_round(spec, seed, r, sel)
+            dropped[sel] += tr["dropped"]
+            poisoned[sel] += tr["poisoned"]
+            straggled[sel] += _effective_straggled(tr)
+            byzantine[sel] += (tr["byzantine"] & ~tr["poisoned"]
+                               & ~tr["dropped"])
+
+    # recorded per-site accuracy trajectories (eval rounds with obs on)
+    acc_traj: Dict[int, List[float]] = {}
+    for rec in records:
+        per = rec.get("acc_per_client")
+        if isinstance(per, (list, tuple)) and len(per) == num_clients:
+            for c, v in enumerate(per):
+                if isinstance(v, (int, float)):
+                    acc_traj.setdefault(c, []).append(float(v))
+
+    for c in range(num_clients):
+        traj = acc_traj.get(c, [])
+        entry: Dict[str, Any] = {
+            "rounds_participated": int(participated[c]),
+            "participation_share": (float(participated[c]) / len(rounds)
+                                    if rounds else 0.0),
+            "dropped": int(dropped[c]),
+            "quarantined": int(poisoned[c]),
+            "straggled": int(straggled[c]),
+            "byzantine": int(byzantine[c]),
+            "eval_points": len(traj),
+            "last_acc": traj[-1] if traj else None,
+        }
+        reasons = []
+        faults = int(dropped[c] + poisoned[c])
+        if participated[c] and \
+                faults / float(participated[c]) >= DEGRADED_FAULT_RATE:
+            reasons.append("fault_rate")
+        if len(traj) >= MIN_TREND_POINTS:
+            half = len(traj) // 2
+            early = float(np.mean(traj[:half]))
+            late = float(np.mean(traj[half:]))
+            entry["acc_trend"] = late - early
+            if early - late > DEGRADED_ACC_DROP:
+                reasons.append("acc_regressing")
+        entry["degraded"] = bool(reasons)
+        entry["degraded_reasons"] = reasons
+        ledger["sites"][str(c)] = entry
+        if reasons:
+            ledger["degraded_sites"].append(c)
+    return ledger
+
+
+def render_health(ledger: Dict[str, Any]) -> str:
+    """Human-readable ledger summary (one line per noteworthy site)."""
+    lines = [f"per-site health — {ledger['rounds_analyzed']} rounds, "
+             f"{ledger['num_clients']} sites"
+             + ("" if ledger["replay"]["faults"]
+                else " (no fault replay: fault_spec empty/unavailable)")]
+    for c, s in sorted(ledger["sites"].items(), key=lambda kv: int(kv[0])):
+        noteworthy = s["degraded"] or s["dropped"] or s["quarantined"] \
+            or s["straggled"] or s["byzantine"]
+        if not noteworthy:
+            continue
+        bits = [f"site {c}: participated {s['rounds_participated']}"]
+        for k in ("dropped", "quarantined", "straggled", "byzantine"):
+            if s[k]:
+                bits.append(f"{k} {s[k]}")
+        if s["last_acc"] is not None:
+            bits.append(f"last_acc {s['last_acc']:.3f}")
+        if s["degraded"]:
+            bits.append("DEGRADED(" + ",".join(s["degraded_reasons"]) + ")")
+        lines.append("  " + ", ".join(bits))
+    if len(lines) == 1:
+        lines.append("  all sites healthy")
+    return "\n".join(lines)

@@ -111,12 +111,16 @@ class MemoryWatermark:
         self._every = max(1, int(sample_every))
         self.samples = 0
 
-    def maybe_sample(self, round_idx: int) -> None:
+    def maybe_sample(self, round_idx: int):
+        """Cadence-gated :meth:`sample`: the sampled values dict when a
+        sample was taken this round, else None (the ObsSession stamps
+        the dict into the round's JSONL record — the per-round series
+        the leak detector in ``obs/analyze.py`` trends over)."""
         if round_idx % self._every:
-            return
-        self.sample()
+            return None
+        return self.sample()
 
-    def sample(self) -> None:
+    def sample(self) -> Dict[str, float]:
         reg = self._registry
         try:
             devs = device_memory()
@@ -143,3 +147,9 @@ class MemoryWatermark:
         rss = host_rss()
         reg.gauge("mem_host_rss_bytes").set(rss["rss_bytes"])
         self.samples += 1
+        out = {"mem_host_rss_bytes": float(rss["rss_bytes"])}
+        if devs:
+            out["mem_device_bytes_in_use"] = float(in_use_max)
+        if peak_max is not None:
+            out["mem_device_peak_bytes"] = float(peak_max)
+        return out

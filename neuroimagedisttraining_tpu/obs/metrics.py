@@ -32,8 +32,27 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 
 __all__ = [
     "Counter", "Distribution", "Gauge", "LabelCardinalityError",
-    "MetricsRegistry", "SectionTimer", "get_registry", "set_registry",
+    "MetricsRegistry", "SectionTimer", "get_registry", "mad", "median",
+    "set_registry",
 ]
+
+
+def median(xs) -> float:
+    """Exact median of a non-empty sequence (shared by the analysis
+    layer's robust statistics — obs/analyze.py outlier flags and
+    obs/regress.py noise bands must not drift apart)."""
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        raise ValueError("median of an empty sequence")
+    mid = n // 2
+    return float(s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid]))
+
+
+def mad(xs, center: Optional[float] = None) -> float:
+    """Median absolute deviation about ``center`` (default: median)."""
+    c = median(xs) if center is None else center
+    return median([abs(x - c) for x in xs])
 
 #: default bound on distinct label-sets per metric family
 MAX_LABEL_SETS = 64

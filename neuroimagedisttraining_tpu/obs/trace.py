@@ -30,8 +30,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = [
-    "NULL_TRACER", "NullSpan", "Tracer", "get_tracer", "set_tracer",
-    "span", "step_span", "tracing_enabled",
+    "NULL_TRACER", "NullSpan", "Tracer", "current_span_name",
+    "get_tracer", "set_tracer", "span", "step_span", "tracing_enabled",
 ]
 
 
@@ -65,6 +65,9 @@ class NullTracer:
     def step_span(self, name: str, step: int):
         return _NULL_SPAN
 
+    def current_span_name(self) -> str:
+        return ""
+
 
 NULL_TRACER = NullTracer()
 
@@ -94,7 +97,7 @@ class _Span:
     def __enter__(self) -> "_Span":
         if self._annotation is not None:
             self._annotation.__enter__()
-        self._tracer._depth_push()
+        self._tracer._depth_push(self._name)
         self._t0 = time.perf_counter_ns()
         return self
 
@@ -132,13 +135,25 @@ class Tracer:
         self._origin_ns = time.perf_counter_ns()
 
     # -- depth tracking (per thread) ------------------------------------
-    def _depth_push(self) -> None:
-        self._local.depth = getattr(self._local, "depth", 0) + 1
+    # The open-span name stack doubles as the compile-attribution
+    # context: obs/compile.py labels jax compile events with the
+    # innermost open span (the jitted entry point being dispatched).
+    def _depth_push(self, name: str = "") -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(name)
 
     def _depth_pop(self) -> int:
-        d = getattr(self._local, "depth", 1)
-        self._local.depth = d - 1
-        return d - 1  # depth of the span that just closed (0 = top level)
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            stack.pop()
+        return len(stack or ())  # depth of the closed span (0 = top)
+
+    def current_span_name(self) -> str:
+        """Innermost OPEN span on this thread ('' outside any span)."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else ""
 
     def _emit(self, name: str, t0_ns: int, dur_ns: int, depth: int,
               args: Optional[Dict[str, Any]]) -> None:
@@ -232,3 +247,9 @@ def span(name: str, args: Optional[Dict[str, Any]] = None):
 def step_span(name: str, step: int):
     """``with trace.step_span("round", r): ...`` — step-annotated span."""
     return _active.step_span(name, step)
+
+
+def current_span_name() -> str:
+    """Innermost open span name on the active tracer ('' when tracing is
+    off or outside any span) — the compile-attribution context."""
+    return _active.current_span_name()

@@ -169,3 +169,46 @@ def make_fault_fn(spec: FaultSpec, seed: int) -> FaultFn:
         return jax.vmap(per_client, in_axes=(0, 0))(stacked, sel_idx)
 
     return inject
+
+
+def fault_trace_round(spec: FaultSpec, seed: int, round_idx: int,
+                      client_ids) -> dict:
+    """Host-side replay of one round's fault draws — the offline twin of
+    :func:`make_fault_fn`.
+
+    Because every draw is a pure function of (run seed, round, global
+    client id), the telemetry analyzer (``obs/health.py`` /
+    ``obs/analyze.py``) can reconstruct exactly which clients dropped,
+    straggled, were poisoned, or went Byzantine in any recorded round —
+    WITHOUT the round program recording any of it. The key derivation
+    below must stay bit-for-bit in sync with ``make_fault_fn``'s
+    (``tests/test_obs_analyze.py`` pins the parity).
+
+    Returns ``{"dropped", "straggled", "poisoned", "byzantine"}``, each
+    a ``bool`` numpy array aligned with ``client_ids``.
+    """
+    import contextlib
+
+    import numpy as np
+
+    # the replay runs mid-round-loop on the runner's obs path: pin it to
+    # the CPU backend so a TPU run's device queue never sees these tiny
+    # host-side programs
+    try:
+        ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+    except Exception:  # no CPU backend registered
+        ctx = contextlib.nullcontext()
+    with ctx:
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), FAULT_SALT)
+        rkey = jax.random.fold_in(
+            base, jnp.asarray(round_idx).astype(jnp.int32))
+        cids = jnp.asarray(client_ids, jnp.int32)
+        keys = jax.vmap(lambda c: jax.random.fold_in(rkey, c))(cids)
+        u = np.asarray(jax.vmap(
+            lambda k: jax.random.uniform(k, (4,)))(keys))
+    return {
+        "dropped": u[:, 0] < spec.drop,
+        "straggled": u[:, 1] < spec.straggle,
+        "poisoned": u[:, 2] < spec.nan,
+        "byzantine": u[:, 3] < spec.scale,
+    }

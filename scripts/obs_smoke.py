@@ -11,7 +11,13 @@ asserts the obs acceptance contract:
      Perfetto-loadable trace file,
   3. obs-on marginal per-round wall-clock overhead is ≤ 3% (N-vs-2N
      wall subtraction per config, cancelling compile/setup — the same
-     methodology as chaos_smoke's guard probe).
+     methodology as chaos_smoke's guard probe),
+  4. the ANALYSIS layer (obs/analyze.py) runs over the smoke's own
+     telemetry and emits a schema-valid ``analysis.json`` with full
+     round coverage, phase attribution, and compile metrics — so the
+     bit-identity and overhead gates above also hold end-to-end through
+     the new record enrichment (schema stamp, memory-in-JSONL, compile
+     listeners).
 
     python scripts/obs_smoke.py                     # CI gate
     python scripts/obs_smoke.py --clients 8 --rounds 8
@@ -85,6 +91,13 @@ def main(argv=None) -> dict:
                         "workload (the smoke model's rounds are nearly "
                         "compute-free, which inflates the overhead pct)")
     p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--repeats", type=int, default=4,
+                   help="repeat each timed config and keep the MINIMUM "
+                        "wall: scheduler/compile noise on a shared host "
+                        "only ever ADDS time, so min-of-repeats is the "
+                        "robust estimator the 3%% gate needs (a single "
+                        "6-round subtraction swings tens of ms/round; "
+                        "min-of-4 converges to ~2 ms/round)")
     p.add_argument("--max_overhead_pct", type=float, default=3.0)
     p.add_argument("--tmp", type=str, default="",
                    help="scratch dir (default: a fresh tempdir)")
@@ -119,9 +132,15 @@ def main(argv=None) -> dict:
     def per_round(extra, sub):
         """Marginal per-round seconds via N-vs-2N wall subtraction: each
         run pays its own compile (fresh jitted closures per
-        FedAlgorithm), the subtraction cancels that fixed cost."""
-        w1, _ = timed_wall(extra, sub + "_n", args.rounds)
-        w2, out2 = timed_wall(extra, sub + "_2n", 2 * args.rounds)
+        FedAlgorithm), the subtraction cancels that fixed cost. Each
+        config runs ``--repeats`` times and keeps the MIN wall (noise
+        is one-sided); the artifact checks read the last 2N run."""
+        w1 = min(timed_wall(extra, f"{sub}_n{i}", args.rounds)[0]
+                 for i in range(args.repeats))
+        w2 = out2 = None
+        for i in range(args.repeats):
+            w, out2 = timed_wall(extra, f"{sub}_2n{i}", 2 * args.rounds)
+            w2 = w if w2 is None else min(w2, w)
         return max(w2 - w1, 1e-9) / args.rounds, out2
 
     # process-level warmup per config (page cache / BLAS pools), then the
@@ -142,9 +161,40 @@ def main(argv=None) -> dict:
             raise SystemExit(
                 "obs-on run is not bit-identical to obs-off")
 
-    # 2. artifact contract (on the 2N obs run)
-    art = _check_artifacts(out_on, os.path.join(tmp, "on_2n"), trace_dir,
-                           2 * args.rounds)
+    # 2. artifact contract (on the last 2N obs run)
+    on_2n_dir = os.path.join(tmp, f"on_2n{args.repeats - 1}")
+    art = _check_artifacts(out_on, on_2n_dir, trace_dir, 2 * args.rounds)
+
+    # 2b. the analysis layer over the smoke's own telemetry: schema-
+    # valid analysis.json, every round covered, phases attributed,
+    # compile cost recorded
+    from neuroimagedisttraining_tpu.obs import analyze as obs_analyze
+
+    run_dir = os.path.join(on_2n_dir, "results", "synthetic")
+    analyses = obs_analyze.analyze_run_dir(run_dir, trace_dir=trace_dir)
+    if len(analyses) != 1:
+        raise SystemExit(
+            f"expected one analyzable run under {run_dir}, "
+            f"got {len(analyses)}")
+    analysis = analyses[0]
+    obs_analyze.validate_analysis(analysis)  # raises on schema drift
+    if analysis["rounds"]["count"] != 2 * args.rounds or \
+            analysis["rounds"]["missing"]:
+        raise SystemExit(
+            f"analysis round coverage wrong: {analysis['rounds']}")
+    if not analysis["round_time"]["present"]:
+        raise SystemExit("analysis found no round_time_s series")
+    if "train_dispatch" not in analysis["phases"]:
+        raise SystemExit(
+            f"phase attribution missing train_dispatch: "
+            f"{sorted(analysis['phases'])}")
+    if not analysis["compile"]["present"]:
+        raise SystemExit("compile metrics missing from the analysis")
+    art.update({
+        "analysis_schema": analysis["schema_version"],
+        "analysis_flags": analysis["flags"],
+        "compile_total_s": round(analysis["compile"]["total_s"], 3),
+    })
 
     # 3. overhead budget
     if overhead_pct > args.max_overhead_pct:

@@ -26,6 +26,10 @@ CONTRACT_PATHS = [
     "obs/metrics.py",
     "obs/export.py",
     "obs/memory.py",
+    "obs/analyze.py",
+    "obs/health.py",
+    "obs/regress.py",
+    "obs/compile.py",
     "utils/checkpoint.py",
     "utils/records.py",
     "utils/flops.py",
