@@ -1,0 +1,244 @@
+"""Noise-aware cross-run performance regression detection.
+
+Every perf PR so far was judged by eyeballing one ``bench.py`` JSON line
+against the previous round's ``BENCH_r*.json``. This module makes the
+verdict mechanical and noise-aware:
+
+* ``results/bench_history.jsonl`` is the durable trajectory — one JSON
+  line per bench result (metric, value, unit, git SHA, source).
+  ``bench.py`` appends to it on every run; :func:`backfill_bench_files`
+  seeds it once from the committed ``BENCH_r*.json`` driver artifacts.
+* :func:`detect_regression` compares a current value against the
+  history's recent window with a median/MAD band: the allowed drop is
+  ``max(rel_threshold * median, mad_k * 1.4826 * MAD)`` — a noisy
+  metric earns a wider band, a rock-stable one a tight band, and a
+  single hot or cold historical run cannot move the center the way it
+  would move a mean.
+* :func:`gate` is the CI entry (``scripts/perf_gate.py``): exit 0 on
+  pass, :data:`EXIT_REGRESSION` on a significant regression,
+  :data:`EXIT_NO_HISTORY` when there is not enough history to judge —
+  distinct codes so a pipeline can treat "no baseline yet" as a
+  soft-pass instead of a silent one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "EXIT_NO_HISTORY", "EXIT_OK", "EXIT_REGRESSION",
+    "append_history", "backfill_bench_files", "detect_regression",
+    "gate", "git_sha", "last_json_result", "read_history",
+]
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_NO_HISTORY = 2
+
+#: default relative drop tolerated before a regression verdict (the
+#: committed BENCH trajectory's run-to-run spread is ~2-3%; 5% leaves
+#: headroom without masking a real hit)
+DEFAULT_REL_THRESHOLD = 0.05
+
+#: robust-sigma multiplier for the noise-derived band
+DEFAULT_MAD_K = 4.0
+
+#: history entries (most recent) considered the comparison window
+DEFAULT_WINDOW = 10
+
+#: minimum history points before a verdict is attempted
+MIN_HISTORY = 2
+
+
+def git_sha(repo_root: Optional[str] = None) -> str:
+    """Current commit SHA ('' when git is unavailable — history entries
+    stay useful without it)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_root or None,
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+def read_history(path: str,
+                 metric: Optional[str] = None) -> List[Dict[str, Any]]:
+    """History entries (optionally one metric's), oldest first. A
+    missing file is an empty history, not an error — the gate's
+    EXIT_NO_HISTORY covers the bootstrap case explicitly."""
+    if not os.path.exists(path):
+        return []
+    from .export import read_jsonl
+
+    entries = read_jsonl(path)
+    if metric is not None:
+        entries = [e for e in entries if e.get("metric") == metric]
+    return entries
+
+
+def append_history(path: str, result: Dict[str, Any],
+                   source: str = "bench",
+                   repo_root: Optional[str] = None,
+                   **extra_fields: Any) -> Dict[str, Any]:
+    """Append one bench result (the ``bench.py`` JSON object) to the
+    history stream; returns the entry written."""
+    if not isinstance(result.get("value"), (int, float)):
+        raise ValueError(
+            f"bench result has no numeric 'value': {result!r}")
+    entry = {
+        "metric": result.get("metric", "unknown"),
+        "value": float(result["value"]),
+        "unit": result.get("unit", ""),
+        "source": source,
+        "git_sha": git_sha(repo_root),
+        "ts": time.time(),
+        **extra_fields,
+    }
+    if isinstance(result.get("extra"), dict):
+        entry["extra"] = result["extra"]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def last_json_result(text: str,
+                     required: tuple = ("metric", "value")
+                     ) -> Optional[Dict[str, Any]]:
+    """The LAST parseable JSON-object line in ``text`` carrying every
+    ``required`` key — the one scanner behind both the BENCH_r*
+    artifact tails and ``perf_gate --from-json`` (two hand-rolled
+    copies would drift)."""
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            cand = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(cand, dict) and all(k in cand for k in required):
+            result = cand
+    return result
+
+
+def parse_bench_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """One committed ``BENCH_r*.json`` driver artifact -> the bench
+    result JSON object its captured stdout tail holds (None when the
+    run failed or printed no JSON line)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("rc") not in (0, None):
+        return None
+    result = last_json_result(str(doc.get("tail", "")))
+    if result is not None and isinstance(doc.get("n"), int):
+        result = {**result, "bench_round": doc["n"]}
+    return result
+
+
+def backfill_bench_files(repo_root: str, history_path: str) -> int:
+    """One-shot seed of the history from the repo's ``BENCH_r*.json``
+    files. Idempotent: artifacts whose (metric, bench_round) already
+    appear in the history are skipped. Returns entries appended."""
+    import glob
+
+    existing = {(e.get("metric"), e.get("bench_round"))
+                for e in read_history(history_path)
+                if e.get("bench_round") is not None}
+    appended = 0
+    for path in sorted(glob.glob(os.path.join(repo_root,
+                                              "BENCH_r*.json"))):
+        result = parse_bench_artifact(path)
+        if result is None:
+            continue
+        key = (result.get("metric"), result.get("bench_round"))
+        if key in existing:
+            continue
+        # bench_round carried on the entry keeps the backfill
+        # idempotent; git_sha is deliberately blank — the artifact's
+        # value was NOT measured at the current checkout, and gate()'s
+        # own-commit exclusion must never drop the seeded baseline
+        append_history(history_path, result,
+                       source=os.path.basename(path),
+                       repo_root=repo_root,
+                       bench_round=result.get("bench_round"),
+                       git_sha="")
+        existing.add(key)
+        appended += 1
+    return appended
+
+
+def detect_regression(history_values: List[float], current: float,
+                      rel_threshold: float = DEFAULT_REL_THRESHOLD,
+                      mad_k: float = DEFAULT_MAD_K,
+                      window: int = DEFAULT_WINDOW,
+                      higher_is_better: bool = True) -> Dict[str, Any]:
+    """Median/MAD verdict of ``current`` against the recent history.
+
+    Returns a dict with ``regression`` (bool), ``baseline_median``,
+    ``allowed_drop``, ``margin`` (how far current sits from the
+    regression line; negative = regressed past it) and ``reason``.
+    """
+    if len(history_values) < MIN_HISTORY:
+        return {"regression": False, "judged": False,
+                "reason": f"history has {len(history_values)} points, "
+                          f"need >= {MIN_HISTORY}"}
+    from .metrics import mad as _mad, median as _median
+
+    recent = [float(v) for v in history_values[-window:]]
+    med = _median(recent)
+    mad = _mad(recent, med)
+    allowed = max(rel_threshold * abs(med), mad_k * 1.4826 * mad)
+    drop = (med - current) if higher_is_better else (current - med)
+    regression = drop > allowed
+    return {
+        "regression": regression, "judged": True,
+        "baseline_median": med, "baseline_mad": mad,
+        "baseline_window": len(recent), "current": float(current),
+        "allowed_drop": allowed, "drop": drop,
+        "margin": allowed - drop,
+        "reason": (f"current {current:g} vs median {med:g}: drop "
+                   f"{drop:g} {'exceeds' if regression else 'within'} "
+                   f"allowed {allowed:g} (rel {rel_threshold:g}, "
+                   f"mad_k {mad_k:g})"),
+    }
+
+
+def gate(history_path: str, metric: str, current: float,
+         rel_threshold: float = DEFAULT_REL_THRESHOLD,
+         mad_k: float = DEFAULT_MAD_K, window: int = DEFAULT_WINDOW,
+         higher_is_better: bool = True,
+         exclude_git_sha: str = "") -> Dict[str, Any]:
+    """The CI verdict: compare ``current`` for ``metric`` against the
+    recorded trajectory. The returned dict carries ``exit_code``
+    (:data:`EXIT_OK` / :data:`EXIT_REGRESSION` /
+    :data:`EXIT_NO_HISTORY`).
+
+    ``exclude_git_sha`` drops history entries recorded at that commit
+    from the baseline — ``bench.py`` appends its result BEFORE the
+    gate judges it, so without the exclusion a commit would be judged
+    against its own (possibly regressed, possibly rerun-duplicated)
+    measurements until they shifted the median. Pass the commit under
+    test (``scripts/perf_gate.py`` does)."""
+    values = [e["value"] for e in read_history(history_path, metric)
+              if isinstance(e.get("value"), (int, float))
+              and not (exclude_git_sha
+                       and e.get("git_sha") == exclude_git_sha)]
+    verdict = detect_regression(
+        values, current, rel_threshold=rel_threshold, mad_k=mad_k,
+        window=window, higher_is_better=higher_is_better)
+    verdict["metric"] = metric
+    verdict["history_points"] = len(values)
+    if not verdict["judged"]:
+        verdict["exit_code"] = EXIT_NO_HISTORY
+    else:
+        verdict["exit_code"] = (EXIT_REGRESSION if verdict["regression"]
+                                else EXIT_OK)
+    return verdict
