@@ -127,6 +127,7 @@ class FedAlgorithm(abc.ABC):
         agg_bucket_size: int = 0,
         fault_spec: str = "",
         guard: Optional[bool] = None,
+        obs_numerics: bool = False,
     ):
         from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
 
@@ -211,6 +212,28 @@ class FedAlgorithm(abc.ABC):
         # injected channel axis
         self.init_sample_shape = tuple(data.sample_shape) + (
             (1,) if channel_inject else ())
+        # obs_numerics: in-jit training-dynamics telemetry
+        # (obs/numerics.py) — per-layer-group update/grad norms,
+        # non-finite precursor gauges, per-client drift/cosine, mask
+        # dynamics — appended to _round_metric_names as ordinary f32
+        # scalars so both the unfused record path and the fused
+        # packed-metric transfer carry them sync-free. The plan's layer
+        # groups come from the eval_shape params template (no compute);
+        # off (the default) is bit-inert. Like every obs knob it never
+        # enters run/checkpoint identity.
+        self._numerics_plan = None
+        if obs_numerics and self.numerics_supported:
+            from ..models import init_params
+            from ..obs.numerics import NumericsPlan
+
+            template = jax.eval_shape(lambda: init_params(
+                self.model, jax.random.PRNGKey(0),
+                self.init_sample_shape))
+            self._numerics_plan = NumericsPlan.from_params(
+                template, slots=self.clients_per_round,
+                with_mask=self.numerics_with_mask)
+            self._round_metric_names = tuple(self._round_metric_names) \
+                + self._numerics_plan.metric_names
         if hp.batching == "epoch":
             from ..parallel.multihost import host_client_counts
 
@@ -314,6 +337,15 @@ class FedAlgorithm(abc.ABC):
     #: Algorithms sharing _train_selected_weighted without threading the
     #: counters (Ditto's global leg) still get the guard itself.
     guard_metrics_supported: bool = False
+
+    #: whether this algorithm's round body threads the in-jit numerics
+    #: telemetry (obs/numerics.py) through its outputs — same support
+    #: surface as guard_metrics_supported (the central-aggregate round).
+    numerics_supported: bool = False
+
+    #: whether the numerics plan also emits mask dynamics (churn /
+    #: cross-client agreement) — static-mask algorithms (SalientGrads)
+    numerics_with_mask: bool = False
 
     def cost_trained_clients_per_round(self) -> int:
         """Client training passes one round actually runs (cost accounting).
@@ -645,14 +677,29 @@ class FedAlgorithm(abc.ABC):
                 fstats["ok"], locals_, personal, sel_idx)
         return tree_scatter_update(personal, sel_idx, upd)
 
-    def _round_outputs(self, state, mean_loss, fstats):
+    def _numerics_outputs(self, old_global, new_global, locals_,
+                          mask=None):
+        """The in-jit numerics telemetry scalars (obs/numerics.py) for
+        this round, in ``_round_metric_names`` order — ``()`` when
+        ``--obs_numerics`` is off (bit-inert). Computed on the round's
+        already-live arrays under its own ``named_scope`` so the XLA
+        device trace labels the readout alongside local_train / guard /
+        aggregate."""
+        if self._numerics_plan is None:
+            return ()
+        with jax.named_scope("numerics"):
+            return self._numerics_plan.compute(
+                old_global, new_global, locals_, mask=mask)
+
+    def _round_outputs(self, state, mean_loss, fstats, numerics=()):
         """A round_fn's return tuple, matching ``_round_metric_names``:
         ``(state, train_loss)`` plus the guard's per-round counters when
-        this algorithm threads them (guard_metrics_supported)."""
+        this algorithm threads them (guard_metrics_supported), plus the
+        in-jit numerics scalars when ``--obs_numerics`` is on."""
         if fstats is None or not self.guard_metrics_supported:
-            return state, mean_loss
+            return (state, mean_loss) + tuple(numerics)
         return (state, mean_loss, fstats["clients_dropped"],
-                fstats["clients_quarantined"])
+                fstats["clients_quarantined"]) + tuple(numerics)
 
     def _train_stacked(self, client_update, params_stack, mask_stack,
                        round_idx, round_key, x, y, n, prox_target=None):

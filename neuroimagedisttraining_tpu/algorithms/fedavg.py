@@ -49,6 +49,7 @@ class FedAvg(FedAlgorithm):
     name = "fedavg"
     supports_fused = True
     guard_metrics_supported = True
+    numerics_supported = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
                  **kwargs):
@@ -79,10 +80,14 @@ class FedAvg(FedAlgorithm):
                 )
             new_personal = self._guarded_personal_update(
                 state.personal_params, locals_, sel_idx, fstats)
+            # in-jit numerics telemetry (--obs_numerics): pure readout
+            # on the round's live arrays, () when off
+            nums = self._numerics_outputs(
+                state.global_params, new_global, locals_)
             return self._round_outputs(
                 FedAvgState(global_params=new_global,
                             personal_params=new_personal, rng=rng),
-                mean_loss, fstats)
+                mean_loss, fstats, nums)
 
         self._round_jit = jax.jit(round_fn)
 

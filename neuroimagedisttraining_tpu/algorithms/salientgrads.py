@@ -57,6 +57,8 @@ class SalientGrads(FedAlgorithm):
     name = "salientgrads"
     supports_fused = True
     guard_metrics_supported = True
+    numerics_supported = True
+    numerics_with_mask = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
@@ -174,11 +176,17 @@ class SalientGrads(FedAlgorithm):
             # trained weights (sailentgrads_api.py:133), guard-aware
             new_personal = self._guarded_personal_update(
                 state.personal_params, locals_, sel_idx, fstats)
+            # in-jit numerics telemetry (--obs_numerics) incl. mask
+            # churn / cross-client agreement; AFTER the defense re-mask
+            # so the update norms see the adopted global. () when off
+            nums = self._numerics_outputs(
+                state.global_params, new_global, locals_,
+                mask=state.mask)
             return self._round_outputs(
                 SalientGradsState(global_params=new_global,
                                   mask=state.mask,
                                   personal_params=new_personal, rng=rng),
-                mean_loss, fstats)
+                mean_loss, fstats, nums)
 
         self._round_jit = jax.jit(round_fn)
         self._eval_global = self._make_global_eval()

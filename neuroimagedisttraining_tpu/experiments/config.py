@@ -294,6 +294,34 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--obs_tb_dir", type=str, default="",
                    help="optional TensorBoard scalar export dir (no-op "
                         "unless a TB writer is importable)")
+    p.add_argument("--obs_numerics", type=int, default=0,
+                   help="in-jit training-dynamics telemetry "
+                        "(obs/numerics.py): per-layer-group update/grad "
+                        "norms, non-finite precursor gauges, per-client "
+                        "drift/cosine, SalientGrads mask churn/agreement "
+                        "— computed inside the jitted round on live "
+                        "arrays and returned through the round outputs "
+                        "(fused blocks stay sync-free). fedavg/"
+                        "salientgrads only. Off (the default) is "
+                        "bit-inert")
+    p.add_argument("--flight_recorder", type=str, default="",
+                   help="anomaly flight recorder (obs/recorder.py): "
+                        "comma-separated triggers — 'guard' (in-jit "
+                        "quarantine fired), 'watchdog' (rollback/skip "
+                        "verdict), 'drift>K' (max client drift exceeds "
+                        "the trailing median by K robust sigmas; "
+                        "non-finite drift always trips), or 'auto' "
+                        "(= watchdog,guard). On trigger a bounded "
+                        "post-mortem bundle (trigger detail + last-"
+                        "K-round numerics window) lands under "
+                        "<results_dir>/<dataset>/<identity>.flight/")
+    p.add_argument("--flight_window", type=int, default=16,
+                   help="flight-recorder sliding window: rounds of "
+                        "telemetry frozen into each bundle")
+    p.add_argument("--flight_profile", type=int, default=0,
+                   help="with --flight_recorder and the watchdog: also "
+                        "capture a jax.profiler device trace of the "
+                        "first rollback-RETRY attempt into its bundle")
     p.add_argument("--tag", type=str, default="", help="identity suffix")
 
     if algo is not None:
@@ -409,6 +437,13 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         from ..robust.faults import parse_fault_spec
 
         parse_fault_spec(fault_spec)  # raises ValueError on bad specs
+    # same rule for the flight-recorder trigger spec: a typo'd trigger
+    # must die at parse time, not silently at the fault it was meant
+    # to capture
+    if getattr(args, "flight_recorder", ""):
+        from ..obs.recorder import parse_triggers
+
+        parse_triggers(args.flight_recorder)
     if getattr(args, "guard", None) is None:
         args.guard = 1 if fault_spec else 0
     if getattr(args, "watchdog", None) is None:

@@ -296,6 +296,7 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         # parse_args always resolves the sentinel in derive()
         guard=(bool(args.guard)
                if getattr(args, "guard", None) is not None else None),
+        obs_numerics=bool(getattr(args, "obs_numerics", 0)),
     )
     if (getattr(args, "fault_spec", "") or getattr(args, "guard", 0)) \
             and algo_name not in ("fedavg", "salientgrads", "ditto"):
@@ -303,6 +304,12 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             "--fault_spec/--guard protect the CENTRAL aggregation round "
             f"(fedavg/salientgrads/ditto); {algo_name} has no central "
             "aggregate to guard")
+    if getattr(args, "obs_numerics", 0) and \
+            algo_name not in ("fedavg", "salientgrads"):
+        raise SystemExit(
+            "--obs_numerics threads the in-jit numerics telemetry "
+            "through the central-aggregate round outputs "
+            f"(fedavg/salientgrads); {algo_name} does not thread them")
     agg_impl = getattr(args, "agg_impl", "dense")
     if agg_impl != "dense" and algo_name not in (
             "fedavg", "salientgrads", "ditto"):
@@ -552,7 +559,8 @@ def _cost_round_record(algo, cost, samples_per_client, state):
 def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                       ev_every, cost, samples_per_client, history,
                       ckpt_mgr=None, args=None, counters=None,
-                      obs_session=None, obs_fault_counts=None):
+                      obs_session=None, obs_fault_counts=None,
+                      flight=None):
     """The runner's fused round loop (--fuse_rounds K): the shared
     block driver (FedAlgorithm._fused_block_loop) plus the runner's cost
     accounting. Masks are static here (evolving-mask algorithms are
@@ -582,6 +590,8 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                 rec, extra=(obs_fault_counts(r)
                             if obs_fault_counts is not None and r >= 0
                             else None))
+        if flight is not None:
+            flight.observe_record(rec)
         logger.info("%s round %d: %s", algo_name, r, rec)
 
     def on_block(end_round, state_out):
@@ -697,6 +707,23 @@ def run_experiment(args: argparse.Namespace,
                 args.fault_spec, args.seed, algo.num_clients,
                 algo.clients_per_round)
 
+        # anomaly flight recorder (obs/recorder.py): bounded post-mortem
+        # bundles on guard quarantine / watchdog rollback / drift
+        # triggers. Reads only already-materialized records at the
+        # flush point; like every obs knob it never enters identity.
+        flight = None
+        if getattr(args, "flight_recorder", ""):
+            from ..obs.recorder import FlightRecorder
+
+            flight = FlightRecorder(
+                os.path.join(args.results_dir or ".", args.dataset),
+                identity, spec=args.flight_recorder,
+                window=getattr(args, "flight_window", 16),
+                profile_retry=bool(getattr(args, "flight_profile", 0)),
+                num_clients=algo.num_clients,
+                clients_per_round=algo.clients_per_round)
+            logger.info("flight recorder armed -> %s", flight.dir)
+
         state = None
         start_round = 0
         if ckpt_mgr is not None and args.resume:
@@ -795,6 +822,10 @@ def run_experiment(args: argparse.Namespace,
             counters.update(rec)
             if obs_session is not None:
                 obs_session.record_round(rec, extra=_obs_extra_for(rec))
+            if flight is not None:
+                # records are materialized at this point: trigger
+                # evaluation (guard counters, drift) is sync-free
+                flight.observe_record(rec)
             logger.info("%s round %s: %s", algo_name, rec["round"], rec)
 
         # with obs on, records also get round_time_s stamped at flush
@@ -860,7 +891,7 @@ def run_experiment(args: argparse.Namespace,
                 samples_per_client, history,
                 ckpt_mgr=ckpt_mgr, args=args, counters=counters,
                 obs_session=obs_session,
-                obs_fault_counts=obs_fault_counts)
+                obs_fault_counts=obs_fault_counts, flight=flight)
             final_eval = None  # re-evaluated once below
 
         try:
@@ -870,10 +901,19 @@ def run_experiment(args: argparse.Namespace,
             end_round = (start_round if fuse > 1
                          else max(start_round, args.comm_round))
             while r < end_round:
+                attempt_nonce = 0
                 if watchdog is not None:
                     # retry attempts re-sample the cohort (nonce 0 = the
                     # reference's seeded draw, bit-compatible)
-                    algo.set_retry_nonce(watchdog.retries_at(r))
+                    attempt_nonce = watchdog.retries_at(r)
+                    algo.set_retry_nonce(attempt_nonce)
+                prof_dir = (flight.take_retry_profile(r)
+                            if flight is not None else None)
+                if prof_dir is not None:
+                    # flight recorder (--flight_profile): device-trace
+                    # the watchdog RETRY attempt into its bundle —
+                    # best-effort, once per run
+                    flight.start_profile(prof_dir)
                 with obs_trace.step_span("round", r):
                     # NOTE: dispatch-time span (the round program is
                     # async); wall attribution lives in round_time_s at
@@ -882,6 +922,21 @@ def run_experiment(args: argparse.Namespace,
                 record = {"round": r, **dict(rec)}
                 if watchdog is not None:
                     verdict = watchdog.judge(r, record, new_state, state)
+                    if prof_dir is not None:
+                        # the judge materialized the attempt's metrics,
+                        # so the retry's device work is in the trace
+                        flight.stop_profile()
+                        prof_dir = None
+                    if flight is not None and verdict != _recovery.OK:
+                        # rollback/skip verdicts never reach the
+                        # deferred emitter (RETRY) or mark degraded
+                        # rounds (SKIP): capture from the verdict path,
+                        # with THIS attempt's cohort nonce — the record
+                        # carries no rounds_retried yet, and a re-drawn
+                        # cohort replayed at nonce 0 would attribute
+                        # the drift to clients that never ran
+                        flight.note_watchdog(r, verdict, record,
+                                             retry=attempt_nonce)
                     if verdict == _recovery.RETRY:
                         # faults observed in the discarded attempt still
                         # happened — count them here (the record never
@@ -898,6 +953,8 @@ def run_experiment(args: argparse.Namespace,
                         new_state = state  # degrade: carry last-good
                         record["round_skipped"] = 1.0
                     record.update(watchdog.round_counters())
+                if prof_dir is not None:  # no watchdog judge ran
+                    flight.stop_profile()
                 state = new_state
                 crec = _cost_round_record(
                     algo, cost, samples_per_client, state)
@@ -997,6 +1054,16 @@ def run_experiment(args: argparse.Namespace,
         if ckpt_mgr is not None:
             fault_totals["checkpoint_save_failures"] = float(
                 ckpt_mgr.save_failures)
+        if flight is not None:
+            fs = flight.summary()
+            if fs["bundles"] or fs["triggers_skipped"]:
+                logger.info("flight recorder: %d bundle(s), %d "
+                            "trigger(s) over budget: %s",
+                            len(fs["bundles"]), fs["triggers_skipped"],
+                            fs["bundles"])
+            if obs_session is not None:
+                obs_session.registry.gauge("flight_bundles").set(
+                    float(len(fs["bundles"])))
         obs_snapshot = None
         if obs_session is not None:
             for k, v in fault_totals.items():

@@ -32,6 +32,18 @@ The ANALYSIS half — from recording to diagnosis (offline, CLI:
   compile wall-time via ``jax.monitoring`` listeners, cache-hit
   counters, AOT ``cost_analysis()`` FLOPs/bytes.
 
+The NUMERICS half — what happens inside the jitted round:
+
+* :mod:`~.numerics` — in-jit training-dynamics telemetry
+  (``--obs_numerics``): per-layer-group update/grad norms, non-finite
+  precursor gauges, per-client drift/cosine, SalientGrads mask
+  churn/agreement — returned through the round outputs as f32 scalars,
+  so fused blocks stay sync-free.
+* :mod:`~.recorder` — anomaly flight recorder (``--flight_recorder``):
+  bounded post-mortem bundles (trigger detail + last-K rounds of
+  numerics JSONL + optional retry-round device trace) when the guard
+  quarantines, the watchdog rolls back, or a drift trigger trips.
+
 Nothing here enters run/checkpoint identity: telemetry never forks a
 lineage, and with ``--obs`` off every hook is a no-op (bit-identical to
 the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it).
@@ -43,9 +55,11 @@ from . import (
     health,
     memory,
     metrics,
+    numerics,
+    recorder,
     regress,
     trace,
 )
 
 __all__ = ["analyze", "compile", "export", "health", "memory",
-           "metrics", "regress", "trace"]
+           "metrics", "numerics", "recorder", "regress", "trace"]

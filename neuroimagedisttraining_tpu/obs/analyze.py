@@ -44,8 +44,14 @@ __all__ = [
     "render_report", "validate_analysis", "write_analysis",
 ]
 
-#: version of the analysis.json schema this module emits
-ANALYSIS_SCHEMA_VERSION = 1
+#: version of the analysis.json schema this module emits.
+#: v2 adds the ``numerics`` section (in-jit training-dynamics telemetry:
+#: per-layer-group precursor trends, per-client drift trajectories,
+#: fault/rollback attribution) and the combined ``outlier_table``
+#: (timing outliers + numeric drift outliers as one ranked table).
+#: v1 documents (and v1/PR-4-era ``obs_schema 1`` round streams) are
+#: still accepted — the v2 keys are required only of v2 documents.
+ANALYSIS_SCHEMA_VERSION = 2
 
 #: host span name -> phase bucket. Container / nested spans are mapped
 #: to None and skipped so phase totals never double-count (``round``
@@ -94,6 +100,29 @@ FAULT_FIELDS = ("clients_dropped", "clients_quarantined",
                 "clients_straggled", "clients_byzantine",
                 "round_skipped")
 
+#: numerics precursor warning: a layer group whose max-abs gauge sits
+#: within this many doublings of the f32 overflow boundary is flagged
+#: (non-finite gauges always flag)
+NUMERICS_WARN_HEADROOM_BITS = 16.0
+
+#: a client's drift is an outlier when it exceeds the cohort's median
+#: by this many robust sigmas (1.4826 * MAD); non-finite drift always
+NUMERICS_DRIFT_MAD_K = 3.5
+
+_F32_MAX = 3.4028235e38
+
+
+def _headroom_bits(maxabs: float) -> Optional[float]:
+    """Doublings left before a gauge value overflows f32 (None when the
+    gauge is zero/absent; 0.0 when it is already non-finite)."""
+    if not isinstance(maxabs, (int, float)):
+        return None
+    if not math.isfinite(maxabs):
+        return 0.0
+    if maxabs <= 0:
+        return None
+    return math.log2(_F32_MAX / maxabs)
+
 
 def _round_records(records: List[Dict[str, Any]]
                    ) -> List[Dict[str, Any]]:
@@ -127,12 +156,12 @@ def _analyze_round_time(records: List[Dict[str, Any]]
               if isinstance(r.get("round_time_s"), (int, float))]
     if not series:
         return {"present": False, "rounds": 0}, []
-    from .metrics import mad as _mad, median as _median
+    from .metrics import mad as _mad, median as _median, robust_sigma
 
     xs = [v for _, v in series]
     med = _median(xs)
     mad = _mad(xs, med)
-    sigma = max(1.4826 * mad, OUTLIER_REL_FLOOR * med, 1e-9)
+    sigma = max(robust_sigma(xs, med), OUTLIER_REL_FLOOR * med, 1e-9)
     stats = {
         "present": True, "rounds": len(xs), "total_s": sum(xs),
         "mean_s": sum(xs) / len(xs), "median_s": med, "mad_s": mad,
@@ -309,6 +338,276 @@ def _straggler_rounds(records: List[Dict[str, Any]],
     return [by_round[k] for k in sorted(by_round)]
 
 
+def _numerics_maps(rec: Dict[str, Any], prefix: str) -> Dict[str, float]:
+    """``{suffix: value}`` for one record's ``<prefix><suffix>`` keys."""
+    out = {}
+    for k, v in rec.items():
+        if k.startswith(prefix) and isinstance(v, (int, float)):
+            out[k[len(prefix):]] = float(v)
+    return out
+
+
+def _replay_sel_fn(config: Optional[Dict[str, Any]]):
+    """Slot → global-client mapper via the deterministic participation
+    replay, or None when the run config lacks the cohort shape."""
+    cfg = config or {}
+    num = int(cfg.get("client_num_in_total") or 0)
+    if not num:
+        return None
+    per = int(cfg.get("client_num_per_round") or num)
+    from .health import replay_client_indexes
+
+    def sel(round_idx: int, retry: int = 0):
+        return replay_client_indexes(round_idx, num, per, retry=retry)
+
+    return sel
+
+
+def _analyze_numerics(records: List[Dict[str, Any]],
+                      config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The in-jit numerics section: per-layer-group precursor trends,
+    per-client drift trajectories (slots mapped to global client ids by
+    the deterministic participation replay), headroom warnings, and —
+    the flight-recorder question — the attribution of each
+    fault/rollback round to the layer group and client trajectory that
+    preceded it."""
+    out: Dict[str, Any] = {
+        "present": False, "groups": {}, "update_norm": {},
+        "mask": {}, "clients": {}, "client_outliers": [],
+        "warnings": [], "fault_attribution": [],
+    }
+    rows = [(int(r["round"]), r) for r in records
+            if any(k.startswith("num_") for k in r)]
+    if not rows:
+        return out
+    out["present"] = True
+    # key on the round index alone: ties (duplicate rounds in a stream
+    # analyzed without the dedupe pass) must not fall through to dict
+    # comparison
+    rows.sort(key=lambda t: t[0])
+    sel_fn = _replay_sel_fn(config)
+
+    # ---- per-layer-group precursor gauges -----------------------------
+    maxabs_series: Dict[str, List[Tuple[int, float]]] = {}
+    upd_series: Dict[str, List[Tuple[int, float]]] = {}
+    total_upd: List[Tuple[int, float]] = []
+    for ridx, rec in rows:
+        for g, v in _numerics_maps(rec, "num_maxabs/").items():
+            maxabs_series.setdefault(g, []).append((ridx, v))
+        for g, v in _numerics_maps(rec, "num_upd/").items():
+            upd_series.setdefault(g, []).append((ridx, v))
+        tv = rec.get("num_update_norm")
+        if isinstance(tv, (int, float)):
+            total_upd.append((ridx, float(tv)))
+    for g, series in sorted(maxabs_series.items()):
+        vals = [v for _, v in series]
+        finite = [v for v in vals if math.isfinite(v)]
+        nonfinite_rounds = [r for r, v in series
+                            if not math.isfinite(v)]
+        entry = {
+            "rounds": len(series),
+            "maxabs_first": vals[0], "maxabs_last": vals[-1],
+            "maxabs_peak": max(finite) if finite else None,
+            "headroom_bits_last": _headroom_bits(vals[-1]),
+            "nonfinite_rounds": nonfinite_rounds,
+        }
+        ug = upd_series.get(g)
+        if ug:
+            entry["update_norm_last"] = ug[-1][1]
+        out["groups"][g] = entry
+        for r, v in series:
+            hb = _headroom_bits(v)
+            if not math.isfinite(v) or (
+                    hb is not None
+                    and hb < NUMERICS_WARN_HEADROOM_BITS):
+                out["warnings"].append(
+                    {"round": r, "group": g, "maxabs": v,
+                     "headroom_bits": hb})
+    if total_upd:
+        finite = [v for _, v in total_upd if math.isfinite(v)]
+        out["update_norm"] = {
+            "last": total_upd[-1][1],
+            "peak": max(finite) if finite else None,
+            "rounds": len(total_upd),
+        }
+
+    # ---- mask dynamics (SalientGrads) ---------------------------------
+    churn = [(r, rec["num_mask_churn"]) for r, rec in rows
+             if isinstance(rec.get("num_mask_churn"), (int, float))]
+    agree = [(r, rec["num_mask_agree"]) for r, rec in rows
+             if isinstance(rec.get("num_mask_agree"), (int, float))]
+    if churn:
+        out["mask"] = {
+            "churn_last": float(churn[-1][1]),
+            "churn_max": max(float(v) for _, v in churn),
+            "agree_last": (float(agree[-1][1]) if agree else None),
+            "agree_min": (min(float(v) for _, v in agree)
+                          if agree else None),
+        }
+
+    # ---- per-client drift trajectories --------------------------------
+    traj: Dict[Any, List[Tuple[int, float]]] = {}
+    slot_by_round: Dict[int, Dict[int, float]] = {}
+    from .numerics import drift_slots
+
+    for ridx, rec in rows:
+        slots = drift_slots(rec)
+        if not slots:
+            continue
+        slot_by_round[ridx] = slots
+        sel = None
+        if sel_fn is not None:
+            sel = sel_fn(ridx,
+                         retry=int(rec.get("rounds_retried") or 0))
+        for j, v in slots.items():
+            cid = (int(sel[j]) if sel is not None and j < len(sel)
+                   else f"slot{j}")
+            traj.setdefault(cid, []).append((ridx, v))
+    all_finite = [v for t in traj.values() for _, v in t
+                  if math.isfinite(v)]
+    med = sigma = None
+    if all_finite:
+        from .metrics import median as _median, robust_sigma
+
+        med = _median(all_finite)
+        sigma = max(robust_sigma(all_finite, med),
+                    OUTLIER_REL_FLOOR * abs(med), 1e-12)
+    for cid, t in sorted(traj.items(), key=lambda kv: str(kv[0])):
+        finite = [(r, v) for r, v in t if math.isfinite(v)]
+        nonfin = [r for r, v in t if not math.isfinite(v)]
+        entry: Dict[str, Any] = {
+            "points": len(t), "nonfinite_rounds": nonfin,
+        }
+        if finite:
+            peak_r, peak = max(finite, key=lambda rv: rv[1])
+            entry["max_drift"] = peak
+            entry["max_drift_round"] = peak_r
+            if med is not None:
+                entry["drift_sigmas"] = round((peak - med) / sigma, 2)
+        entry["outlier"] = bool(
+            nonfin or (entry.get("drift_sigmas") or 0)
+            > NUMERICS_DRIFT_MAD_K)
+        out["clients"][str(cid)] = entry
+        if entry["outlier"]:
+            out["client_outliers"].append(str(cid))
+
+    # ---- fault / rollback attribution ---------------------------------
+    # total precursor gauge per round (max over groups, finite only) —
+    # the "how many rounds of warning" series
+    gauge: Dict[int, float] = {}
+    for g, series in maxabs_series.items():
+        for r, v in series:
+            if math.isfinite(v):
+                gauge[r] = max(gauge.get(r, 0.0), v)
+    gauge_rounds = sorted(gauge)
+    for ridx, rec in rows:
+        sources = []
+        for field, label in (("clients_quarantined", "guard_quarantine"),
+                             ("rounds_retried", "rollback_retry"),
+                             ("round_skipped", "round_skipped")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and v > 0:
+                sources.append(label)
+        if not sources:
+            continue
+        slots = slot_by_round.get(ridx, {})
+        bad_slots = sorted(j for j, v in slots.items()
+                           if not math.isfinite(v))
+        if not bad_slots and slots and med is not None:
+            bad_slots = sorted(
+                j for j, v in slots.items()
+                if (v - med) / sigma > NUMERICS_DRIFT_MAD_K)
+        if not bad_slots and slots:
+            bad_slots = [max(slots, key=lambda j: slots[j])]
+        sel = None
+        if sel_fn is not None:
+            sel = sel_fn(ridx,
+                         retry=int(rec.get("rounds_retried") or 0))
+        clients = [int(sel[j]) for j in bad_slots
+                   if sel is not None and j < len(sel)]
+        groups = sorted(
+            g for g, series in maxabs_series.items()
+            if any(r == ridx and not math.isfinite(v)
+                   for r, v in series))
+        if not groups and maxabs_series:
+            # no non-finite gauge: name the group with the largest
+            # gauge jump into the fault round (else largest gauge)
+            def jump(g):
+                s = dict(maxabs_series[g])
+                cur = s.get(ridx)
+                if cur is None or not math.isfinite(cur):
+                    return float("-inf")
+                prev = [v for r, v in sorted(s.items())
+                        if r < ridx and math.isfinite(v)]
+                return cur / prev[-1] if prev and prev[-1] > 0 else cur
+            best = max(maxabs_series, key=jump)
+            if jump(best) != float("-inf"):
+                groups = [best]
+        # consecutive rounds of rising precursor gauge before the fault
+        prior = [r for r in gauge_rounds if r < ridx]
+        warn = 0
+        for a, b in zip(reversed(prior[:-1] or []), reversed(prior)):
+            if gauge[b] > gauge[a]:
+                warn += 1
+            else:
+                break
+        out["fault_attribution"].append({
+            "round": ridx, "sources": sources,
+            "slots": bad_slots, "clients": clients,
+            "layer_groups": groups, "precursor_rounds": warn,
+        })
+    return out
+
+
+def _outlier_table(stragglers: List[Dict[str, Any]],
+                   numerics: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Timing outliers and numeric drift outliers as ONE ranked table:
+    each row is a round, carrying the timing deviation (when the round
+    was a slow outlier / flagged straggler), the offending clients and
+    their drift deviation (when the numerics flagged them there), and
+    the union of evidence sources. Non-finite drift ranks first, then
+    by the larger of the two robust deviations."""
+    rows: Dict[int, Dict[str, Any]] = {}
+
+    def row(r: int) -> Dict[str, Any]:
+        return rows.setdefault(r, {
+            "round": r, "clients": [], "timing_sigmas": None,
+            "drift_sigmas": None, "nonfinite": False, "sources": []})
+
+    for s in stragglers:
+        e = row(int(s["round"]))
+        e["timing_sigmas"] = s.get("deviation_sigmas")
+        e["sources"].append(s["source"])
+        if "clients_straggled" in s:
+            e["clients_straggled"] = s["clients_straggled"]
+    for cid in numerics.get("client_outliers", ()):
+        c = numerics["clients"][cid]
+        for r in c.get("nonfinite_rounds", ()):
+            e = row(int(r))
+            e["nonfinite"] = True
+            if cid not in e["clients"]:
+                e["clients"].append(cid)
+            if "drift_nonfinite" not in e["sources"]:
+                e["sources"].append("drift_nonfinite")
+        if not c.get("nonfinite_rounds") and \
+                c.get("max_drift_round") is not None:
+            e = row(int(c["max_drift_round"]))
+            if cid not in e["clients"]:
+                e["clients"].append(cid)
+            ds = c.get("drift_sigmas")
+            if ds is not None:
+                e["drift_sigmas"] = max(e["drift_sigmas"] or 0.0, ds)
+            if "drift_outlier" not in e["sources"]:
+                e["sources"].append("drift_outlier")
+
+    def severity(e):
+        return (0 if e["nonfinite"] else 1,
+                -max(abs(e["timing_sigmas"] or 0.0),
+                     abs(e["drift_sigmas"] or 0.0)))
+
+    return sorted(rows.values(), key=severity)
+
+
 def _analyze_compile(metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     m = metrics or {}
     out: Dict[str, Any] = {"present": False, "total_s": 0.0,
@@ -364,6 +663,7 @@ def analyze_records(records: List[Dict[str, Any]],
 
     health = build_health_ledger(rounds, config)
     stragglers = _straggler_rounds(rounds, outliers, config)
+    numerics = _analyze_numerics(rounds, config)
     analysis = {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
         "identity": identity,
@@ -376,6 +676,8 @@ def analyze_records(records: List[Dict[str, Any]],
         "faults": _analyze_faults(rounds, metrics),
         "compile": _analyze_compile(metrics),
         "health": health,
+        "numerics": numerics,
+        "outlier_table": _outlier_table(stragglers, numerics),
     }
     flags = []
     flags += [f"straggler_round_{s['round']}" for s in stragglers]
@@ -384,6 +686,10 @@ def analyze_records(records: List[Dict[str, Any]],
     flags += [f"missing_rounds_{len(analysis['rounds']['missing'])}"
               ] if analysis["rounds"]["missing"] else []
     flags += [f"degraded_site_{c}" for c in health["degraded_sites"]]
+    flags += [f"drift_outlier_client_{c}"
+              for c in numerics["client_outliers"]]
+    flags += [f"numerics_fault_round_{a['round']}"
+              for a in numerics["fault_attribution"]]
     analysis["flags"] = flags
     return analysis
 
@@ -397,6 +703,10 @@ _SCHEMA_KEYS = {
     "compile": dict, "health": dict, "flags": list,
 }
 
+#: keys ADDED by schema v2 — required only of v2+ documents, so v1
+#: analysis.json files (PR-4-era run dirs) still validate cleanly
+_SCHEMA_KEYS_V2 = {"numerics": dict, "outlier_table": list}
+
 
 def validate_analysis(analysis: Dict[str, Any]) -> None:
     """Raise ValueError describing every schema violation (an explicit
@@ -405,7 +715,11 @@ def validate_analysis(analysis: Dict[str, Any]) -> None:
     if not isinstance(analysis, dict):
         raise ValueError(f"analysis is {type(analysis).__name__}, "
                          "expected dict")
-    for key, typ in _SCHEMA_KEYS.items():
+    required = dict(_SCHEMA_KEYS)
+    if isinstance(analysis.get("schema_version"), int) and \
+            analysis["schema_version"] >= 2:
+        required.update(_SCHEMA_KEYS_V2)
+    for key, typ in required.items():
         if key not in analysis:
             problems.append(f"missing key {key!r}")
         elif not isinstance(analysis[key], typ):
@@ -534,6 +848,63 @@ def render_report(analysis: Dict[str, Any]) -> str:
         lines.append(
             "faults: " + ", ".join(
                 f"{k}={f[k]:g}" for k in FAULT_FIELDS if f.get(k)))
+    n = a.get("numerics") or {}
+    if n.get("present"):
+        lines.append("numerics (in-jit telemetry):")
+        un = n.get("update_norm") or {}
+        if un:
+            lines.append(
+                f"  global update norm: last {un['last']:.4g}"
+                + (f", peak {un['peak']:.4g}"
+                   if un.get("peak") is not None else ""))
+        for g, e in sorted((n.get("groups") or {}).items()):
+            hb = e.get("headroom_bits_last")
+            lines.append(
+                f"  group {g:<14} maxabs {e['maxabs_last']:.4g}"
+                + (f" (headroom {hb:.1f} bits)"
+                   if hb is not None else "")
+                + (f"  NONFINITE rounds {e['nonfinite_rounds']}"
+                   if e["nonfinite_rounds"] else ""))
+        m = n.get("mask") or {}
+        if m:
+            lines.append(
+                f"  mask: churn last {m['churn_last']:.4g} "
+                f"(max {m['churn_max']:.4g})"
+                + (f", cross-client agreement {m['agree_last']:.4g}"
+                   if m.get("agree_last") is not None else ""))
+        for w in (n.get("warnings") or ())[:8]:
+            lines.append(
+                f"  WARNING round {w['round']}: group {w['group']} "
+                f"maxabs {w['maxabs']:.4g}"
+                + (f" ({w['headroom_bits']:.1f} bits of headroom)"
+                   if w.get("headroom_bits") is not None else ""))
+        for fa in n.get("fault_attribution") or ():
+            who = (", ".join(f"client {c}" for c in fa["clients"])
+                   or ", ".join(f"slot {j}" for j in fa["slots"])
+                   or "unattributed")
+            grp = ", ".join(fa["layer_groups"]) or "unattributed"
+            lines.append(
+                f"  FAULT round {fa['round']} "
+                f"({'+'.join(fa['sources'])}): {who}; "
+                f"layer group {grp}; "
+                f"{fa['precursor_rounds']} round(s) of rising "
+                "precursor gauge before it")
+    table = a.get("outlier_table") or []
+    if table:
+        lines.append("outlier table (timing + numeric, ranked):")
+        for e in table:
+            bits = [f"round {e['round']}"]
+            if e["clients"]:
+                bits.append("clients " + ",".join(
+                    str(c) for c in e["clients"]))
+            if e["timing_sigmas"] is not None:
+                bits.append(f"timing {e['timing_sigmas']:+.1f}σ")
+            if e["drift_sigmas"] is not None:
+                bits.append(f"drift {e['drift_sigmas']:+.1f}σ")
+            if e["nonfinite"]:
+                bits.append("NONFINITE drift")
+            bits.append("[" + "+".join(e["sources"]) + "]")
+            lines.append("  " + ", ".join(bits))
     c = a["compile"]
     if c["present"]:
         lines.append(f"compile: {c['total_s']:.2f} s total"

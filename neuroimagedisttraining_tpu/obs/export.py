@@ -28,14 +28,33 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "OBS_SCHEMA_VERSION", "ObsSession", "RoundLogWriter",
-    "dedupe_rounds", "maybe_tensorboard_writer", "merge_host_jsonl",
+    "SUPPORTED_OBS_SCHEMAS", "dedupe_rounds",
+    "maybe_tensorboard_writer", "merge_host_jsonl", "record_schema",
     "write_metrics_json",
 ]
 
 #: version of the per-round JSONL record schema (stamped on every
 #: exported line; obs/analyze.py refuses records from a NEWER schema
-#: than it understands instead of misreading them)
-OBS_SCHEMA_VERSION = 1
+#: than it understands instead of misreading them).
+#: v2 adds the flat in-jit numerics keys (``num_*`` — obs/numerics.py:
+#: per-layer-group update/grad norms and max-abs precursor gauges,
+#: per-slot client drift/cosine, mask churn/agreement). v1 streams
+#: (PR-4-era run dirs) carry none of them and still read/analyze
+#: cleanly — every reader treats the keys as optional.
+OBS_SCHEMA_VERSION = 2
+
+#: every schema this module's readers (and obs/analyze.py) accept
+SUPPORTED_OBS_SCHEMAS = (1, 2)
+
+
+def record_schema(record: Dict[str, Any]) -> int:
+    """The LOWEST schema a record actually requires: v2 only when it
+    carries the numerics keys. A numerics-free line is stamped 1 so
+    PR-4-era analyzers (which refuse schemas newer than they
+    understand) keep reading the streams they can read perfectly —
+    the v2 keys are purely additive."""
+    return (OBS_SCHEMA_VERSION
+            if any(k.startswith("num_") for k in record) else 1)
 
 
 def _process_index() -> int:
@@ -278,7 +297,7 @@ class ObsSession:
             mem_sample = self.memory.maybe_sample(r)
         if self.writer is not None:
             out = dict(record)
-            out["obs_schema"] = OBS_SCHEMA_VERSION
+            out["obs_schema"] = record_schema(record)
             if mem_sample:
                 # per-round memory series: what obs/analyze.py's leak
                 # detector trends over (gauges are last-value-wins)

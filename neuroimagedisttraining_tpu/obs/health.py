@@ -153,6 +153,16 @@ def build_health_ledger(records: List[Dict[str, Any]],
     poisoned = np.zeros(num_clients, np.int64)
     straggled = np.zeros(num_clients, np.int64)
     byzantine = np.zeros(num_clients, np.int64)
+    # in-jit numerics drift (obs/numerics.py, obs_schema v2): per-slot
+    # ``num_drift_s<j>`` record keys map to global clients through the
+    # SAME participation replay — per-site drift trajectories join the
+    # ledger when the stream carries them (v1 streams simply have none)
+    rec_of = {int(r["round"]): r for r in records
+              if isinstance(r.get("round"), (int, float))
+              and int(r["round"]) >= 0}
+    drift_points = np.zeros(num_clients, np.int64)
+    drift_nonfinite = np.zeros(num_clients, np.int64)
+    drift_max = np.zeros(num_clients, np.float64)
 
     spec = None
     if fault_spec:
@@ -162,6 +172,10 @@ def build_health_ledger(records: List[Dict[str, Any]],
         if spec is not None and not spec.any_active:
             spec = None
     ledger["replay"]["faults"] = spec is not None
+
+    import math
+
+    from .numerics import drift_slots
 
     for r in rounds:
         sel = replay_client_indexes(r, num_clients, clients_per_round,
@@ -176,6 +190,15 @@ def build_health_ledger(records: List[Dict[str, Any]],
             straggled[sel] += _effective_straggled(tr)
             byzantine[sel] += (tr["byzantine"] & ~tr["poisoned"]
                                & ~tr["dropped"])
+        for j, v in drift_slots(rec_of.get(r) or {}).items():
+            if j >= len(sel):
+                continue
+            c = int(sel[j])
+            drift_points[c] += 1
+            if math.isfinite(v):
+                drift_max[c] = max(drift_max[c], float(v))
+            else:
+                drift_nonfinite[c] += 1
 
     # recorded per-site accuracy trajectories (eval rounds with obs on)
     acc_traj: Dict[int, List[float]] = {}
@@ -198,8 +221,17 @@ def build_health_ledger(records: List[Dict[str, Any]],
             "byzantine": int(byzantine[c]),
             "eval_points": len(traj),
             "last_acc": traj[-1] if traj else None,
+            "drift_points": int(drift_points[c]),
+            # max over FINITE samples only; None when none exist (an
+            # every-round-poisoned site must not read as zero drift)
+            "drift_max": (float(drift_max[c])
+                          if drift_points[c] > drift_nonfinite[c]
+                          else None),
+            "drift_nonfinite": int(drift_nonfinite[c]),
         }
         reasons = []
+        if drift_nonfinite[c]:
+            reasons.append("drift_nonfinite")
         faults = int(dropped[c] + poisoned[c])
         if participated[c] and \
                 faults / float(participated[c]) >= DEGRADED_FAULT_RATE:

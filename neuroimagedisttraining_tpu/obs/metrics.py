@@ -54,6 +54,13 @@ def mad(xs, center: Optional[float] = None) -> float:
     c = median(xs) if center is None else center
     return median([abs(x - c) for x in xs])
 
+
+def robust_sigma(xs, center: Optional[float] = None) -> float:
+    """``1.4826 * MAD`` — the robust standard-deviation estimator
+    every outlier threshold in obs/ derives from (one owner of the
+    normal-consistency constant; callers apply their own floors)."""
+    return 1.4826 * mad(xs, center)
+
 #: default bound on distinct label-sets per metric family
 MAX_LABEL_SETS = 64
 

@@ -1,0 +1,201 @@
+"""In-jit training-dynamics telemetry: what happens numerically INSIDE
+the jitted federated round.
+
+PRs 3-4 made rounds observable from the HOST side (spans, round wall
+time, memory watermarks) — but the guard quarantines a non-finite client
+and the watchdog rolls back a diverged aggregate without either being
+able to say which layer, which client, or how many rounds of warning
+there were. This module computes that evidence where it is cheapest: on
+the already-live arrays inside the round program, under the existing
+``jax.named_scope`` labels, returned as extra f32 scalars through the
+round outputs — so fused blocks stay sync-free and values surface at the
+DeferredRecords flush point like every other per-round metric.
+
+Per round, a :class:`NumericsPlan` emits:
+
+* ``num_update_norm`` — L2 norm of the realized global update
+  ``new_global − old_global`` (the exact quantity
+  ``robust.recovery._global_update_norm`` re-materializes on host; the
+  watchdog reuses this scalar when present);
+* ``num_upd/<group>`` — the same norm restricted to each layer group
+  (top-level module of the params pytree: ``Conv3d_0``, ``Dense_0``, …);
+* ``num_gnorm/<group>`` — cohort-mean per-group local-update norm (the
+  grad-norm proxy: a local delta is ``−lr · Σ grads``);
+* ``num_maxabs/<group>`` — max |value| over the stacked client MODELS
+  as they arrived at the server (post-fault, pre-guard — parameter
+  magnitude is what overflows compute, and poison shows here): the
+  non-finite *precursor* gauge (overflow headroom =
+  ``log2(f32_max / maxabs)``, derived by the analyzer) whose trend in
+  the rounds before a guard quarantine is the early warning;
+* ``num_drift_s<j>`` / ``num_cos_s<j>`` — per-cohort-slot client drift
+  ``‖local_j − global‖`` and cosine to the realized global update
+  (straggler/Byzantine early warning; slots map back to global client
+  ids offline via the deterministic participation replay,
+  ``obs.health.replay_client_indexes``);
+* with ``with_mask`` (SalientGrads): ``num_mask_churn`` — the effective
+  global mask's per-round churn, literally
+  ``ops.sparsity.mask_distance(new_global, old_global)`` on the nonzero
+  patterns — and ``num_mask_agree`` / ``num_mask_dist_max`` —
+  cross-client mask agreement, ``1 − mean_j mask_distance(local_j,
+  mask)`` (a NaN-poisoned client's nonzero pattern flips to all-ones
+  and its disagreement spikes).
+
+Everything is a pure readout: no extra device sync, no RNG consumption,
+no effect on the state computation — ``--obs_numerics`` off is
+bit-inert, and (like every obs knob) the flag never enters run or
+checkpoint identity.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DRIFT_KEY_PREFIX", "NUMERICS_PREFIX", "NumericsPlan",
+           "drift_slots", "group_of_path", "layer_groups"]
+
+#: every numerics metric name starts with this (the analyzer's and the
+#: flight recorder's key-space contract)
+NUMERICS_PREFIX = "num_"
+
+#: per-cohort-slot drift keys: ``num_drift_s<j>``
+DRIFT_KEY_PREFIX = "num_drift_s"
+
+
+def drift_slots(record) -> Dict[int, float]:
+    """``{slot: drift}`` from one (materialized) round record — the ONE
+    parser of the per-slot drift key format, shared by the flight
+    recorder, the health ledger, and the analyzer."""
+    out = {}
+    for k, v in record.items():
+        if k.startswith(DRIFT_KEY_PREFIX) and isinstance(
+                v, (int, float)):
+            try:
+                out[int(k[len(DRIFT_KEY_PREFIX):])] = float(v)
+            except ValueError:
+                continue
+    return out
+
+#: denominator floor for the cosine — only reached when the global
+#: update (or a client's drift) is exactly zero, where cosine 0 is the
+#: honest answer
+_COS_EPS = 1e-30
+
+
+def group_of_path(path) -> str:
+    """Layer-group label of one pytree leaf path: the top-level module
+    name of the flax params tree (``Conv3d_0``, ``Dense_0``,
+    ``GroupNorm_0``, …)."""
+    first = path[0]
+    key = getattr(first, "key", getattr(first, "name", None))
+    return str(key if key is not None else first)
+
+
+def layer_groups(params: Any) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """``(group_names, leaf_to_group)``: sorted group labels plus each
+    flattened leaf's group index, in ``tree_leaves`` order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    labels = [group_of_path(path) for path, _ in flat]
+    names = tuple(sorted(set(labels)))
+    index = {g: i for i, g in enumerate(names)}
+    return names, tuple(index[lb] for lb in labels)
+
+
+class NumericsPlan:
+    """The static layout of one algorithm's in-jit numerics telemetry.
+
+    Built host-side once (from the ``jax.eval_shape`` params template —
+    no compute), it fixes the metric NAMES (joined onto
+    ``_round_metric_names``, so the fused packed-metric contract sees
+    ordinary f32 scalars) and provides the traced :meth:`compute` the
+    round body calls on its live arrays.
+    """
+
+    def __init__(self, group_names: Tuple[str, ...],
+                 leaf_groups: Tuple[int, ...], slots: int,
+                 with_mask: bool = False):
+        if slots < 1:
+            raise ValueError(f"numerics plan needs >=1 cohort slot, "
+                             f"got {slots}")
+        if not group_names:
+            raise ValueError("numerics plan: empty params template")
+        self.group_names = tuple(group_names)
+        self.leaf_groups = tuple(leaf_groups)
+        self.slots = int(slots)
+        self.with_mask = bool(with_mask)
+        names: List[str] = ["num_update_norm"]
+        names += [f"num_upd/{g}" for g in self.group_names]
+        names += [f"num_gnorm/{g}" for g in self.group_names]
+        names += [f"num_maxabs/{g}" for g in self.group_names]
+        names += [f"num_drift_s{j}" for j in range(self.slots)]
+        names += [f"num_cos_s{j}" for j in range(self.slots)]
+        if self.with_mask:
+            names += ["num_mask_churn", "num_mask_agree",
+                      "num_mask_dist_max"]
+        self.metric_names: Tuple[str, ...] = tuple(names)
+
+    @classmethod
+    def from_params(cls, params_template: Any, slots: int,
+                    with_mask: bool = False) -> "NumericsPlan":
+        names, leaf_groups = layer_groups(params_template)
+        return cls(names, leaf_groups, slots, with_mask=with_mask)
+
+    # -- traced computation ----------------------------------------------
+    def compute(self, old_global: Any, new_global: Any, locals_: Any,
+                mask: Optional[Any] = None) -> Tuple[jax.Array, ...]:
+        """The in-jit numerics scalars for one round, in
+        ``metric_names`` order. ``locals_`` is the ``[S, ...]``-stacked
+        client models as they ARRIVED at the server (post-fault,
+        pre-guard — poison must show). All inputs are already live in
+        the round program; this adds reductions only, never a sync."""
+        old = jax.tree_util.tree_leaves(old_global)
+        new = jax.tree_util.tree_leaves(new_global)
+        loc = jax.tree_util.tree_leaves(locals_)
+        if not (len(old) == len(new) == len(loc) ==
+                len(self.leaf_groups)):
+            raise ValueError(
+                f"numerics plan built for {len(self.leaf_groups)} leaves "
+                f"but got {len(old)}/{len(new)}/{len(loc)} — rebuild the "
+                "plan from the live params template")
+        g = len(self.group_names)
+        zero = jnp.zeros((), jnp.float32)
+        upd_sq = [zero] * g                      # per-group ||Δglobal||²
+        drift_sq = [jnp.zeros((self.slots,), jnp.float32)] * g
+        dot = jnp.zeros((self.slots,), jnp.float32)
+        maxabs = [zero] * g
+        for gi, o, n, s in zip(self.leaf_groups, old, new, loc):
+            if s.shape[:1] != (self.slots,):
+                raise ValueError(
+                    f"numerics plan built for {self.slots} cohort slots "
+                    f"but locals_ leaf has leading axis {s.shape[:1]}")
+            o32 = o.astype(jnp.float32)
+            u = n.astype(jnp.float32) - o32
+            d = s.astype(jnp.float32) - o32[None]
+            axes = tuple(range(1, d.ndim))
+            upd_sq[gi] = upd_sq[gi] + jnp.sum(jnp.square(u))
+            drift_sq[gi] = drift_sq[gi] + jnp.sum(jnp.square(d),
+                                                  axis=axes)
+            dot = dot + jnp.sum(d * u[None], axis=axes)
+            maxabs[gi] = jnp.maximum(maxabs[gi], jnp.max(jnp.abs(
+                s.astype(jnp.float32))))
+        group_upd = [jnp.sqrt(sq) for sq in upd_sq]
+        upd_norm = jnp.sqrt(sum(upd_sq))
+        group_gnorm = [jnp.mean(jnp.sqrt(sq)) for sq in drift_sq]
+        drift = jnp.sqrt(sum(drift_sq))          # [S] total client drift
+        cos = dot / jnp.maximum(drift * upd_norm, _COS_EPS)
+        out: List[jax.Array] = [upd_norm]
+        out += group_upd + group_gnorm + maxabs
+        out += [drift[j] for j in range(self.slots)]
+        out += [cos[j] for j in range(self.slots)]
+        if self.with_mask:
+            if mask is None:
+                raise ValueError(
+                    "numerics plan built with_mask=True needs the round's "
+                    "mask pytree")
+            from ..ops.sparsity import mask_distance
+
+            churn = mask_distance(new_global, old_global)
+            dists = jax.vmap(lambda lo: mask_distance(lo, mask))(locals_)
+            out += [churn, 1.0 - jnp.mean(dists), jnp.max(dists)]
+        return tuple(x.astype(jnp.float32) for x in out)

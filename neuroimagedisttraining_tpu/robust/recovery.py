@@ -116,7 +116,18 @@ class RoundWatchdog:
             if self.loss_threshold and loss > self.loss_threshold:
                 return False
         if self.norm_threshold:
-            norm = _global_update_norm(new_state, prev_state)
+            # prefer the in-jit global-update norm the numerics
+            # telemetry already computed inside the round program
+            # (obs/numerics.py, --obs_numerics): materializing that ONE
+            # scalar replaces re-materializing every leaf of both states
+            # on host. Same quantity — tests/test_obs_numerics.py pins
+            # the parity. Fallback preserved when numerics is off.
+            norm = record.get("num_update_norm")
+            if norm is not None:
+                norm = float(norm)
+                record["num_update_norm"] = norm  # keep materialized
+            else:
+                norm = _global_update_norm(new_state, prev_state)
             if norm is not None and (
                     not math.isfinite(norm) or norm > self.norm_threshold):
                 return False

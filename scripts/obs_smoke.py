@@ -17,7 +17,11 @@ asserts the obs acceptance contract:
      round coverage, phase attribution, and compile metrics — so the
      bit-identity and overhead gates above also hold end-to-end through
      the new record enrichment (schema stamp, memory-in-JSONL, compile
-     listeners).
+     listeners),
+  5. the NUMERICS leg (--obs_numerics, obs/numerics.py): the in-jit
+     telemetry run is ALSO bit-identical to obs-off, its JSONL carries
+     the num_* keys, the analyzer's numerics section reads them, and
+     its per-round overhead vs obs-off stays within the same budget.
 
     python scripts/obs_smoke.py                     # CI gate
     python scripts/obs_smoke.py --clients 8 --rounds 8
@@ -203,11 +207,51 @@ def main(argv=None) -> dict:
             f"{args.max_overhead_pct:g}% budget "
             f"(off {off_s * 1e3:.1f} ms, on {on_s * 1e3:.1f} ms)")
 
+    # 4. numerics leg: obs + in-jit numerics telemetry. Bit-identity vs
+    # the obs-OFF run (numerics is a pure readout), num_* keys on every
+    # JSONL line, analyzer numerics section present, and the same
+    # per-round overhead budget measured against obs-off.
+    num_s, out_num = per_round(obs_flags + ["--obs_numerics", "1"],
+                               "num")
+    num_overhead_pct = 100.0 * (num_s - off_s) / max(off_s, 1e-9)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(out_off["state"].global_params),
+            jax.tree_util.tree_leaves(out_num["state"].global_params)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise SystemExit(
+                "obs_numerics run is not bit-identical to obs-off")
+    from neuroimagedisttraining_tpu.obs.export import read_jsonl
+
+    num_dir = os.path.join(tmp, f"num_2n{args.repeats - 1}")
+    num_jsonl = os.path.join(num_dir, "results", "synthetic",
+                             out_num["identity"] + ".obs.jsonl")
+    num_recs = read_jsonl(num_jsonl)
+    for r in num_recs:
+        if "num_update_norm" not in r or \
+                not any(k.startswith("num_maxabs/") for k in r):
+            raise SystemExit(
+                f"numerics JSONL record missing num_* keys: {sorted(r)}")
+    num_analyses = obs_analyze.analyze_run_dir(
+        os.path.join(num_dir, "results", "synthetic"),
+        trace_dir=trace_dir)
+    if len(num_analyses) != 1 or \
+            not num_analyses[0]["numerics"]["present"]:
+        raise SystemExit("analyzer found no numerics section in the "
+                         "obs_numerics run")
+    if num_overhead_pct > args.max_overhead_pct:
+        raise SystemExit(
+            f"obs_numerics per-round overhead {num_overhead_pct:.2f}% "
+            f"exceeds the {args.max_overhead_pct:g}% budget "
+            f"(off {off_s * 1e3:.1f} ms, numerics "
+            f"{num_s * 1e3:.1f} ms)")
+
     result = {
         "obs_ok": True, "clients": args.clients, "rounds": args.rounds,
         "model": args.model,
         "round_s_obs_off": off_s, "round_s_obs_on": on_s,
+        "round_s_obs_numerics": num_s,
         "obs_overhead_pct": round(overhead_pct, 2),
+        "numerics_overhead_pct": round(num_overhead_pct, 2),
         "bit_identical": True, **art,
     }
     print(json.dumps(result))

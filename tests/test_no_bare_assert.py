@@ -30,6 +30,8 @@ CONTRACT_PATHS = [
     "obs/health.py",
     "obs/regress.py",
     "obs/compile.py",
+    "obs/numerics.py",
+    "obs/recorder.py",
     "utils/checkpoint.py",
     "utils/records.py",
     "utils/flops.py",

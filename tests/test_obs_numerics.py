@@ -1,0 +1,414 @@
+"""In-jit numerics telemetry (obs/numerics.py) + anomaly flight
+recorder (obs/recorder.py).
+
+The contract surface: --obs_numerics off/on bit-identity of the round
+outputs, fused-vs-unfused parity of every numerics scalar, mask-churn /
+agreement pinned against ops.sparsity.mask_distance, the watchdog's
+reuse of the in-jit global-update norm, the flight-recorder bundle
+schema and bounds, the obs_schema v1/v2 compatibility fixtures, and the
+guard-quarantine e2e: a ``--fault_spec nan=`` chaos run must produce a
+flight-recorder bundle and an analyzer report that names the injected
+round, client, and layer group.
+"""
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import FedAvg, SalientGrads
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.obs import analyze, export
+from neuroimagedisttraining_tpu.obs.numerics import NumericsPlan
+from neuroimagedisttraining_tpu.obs.recorder import (
+    FlightRecorder,
+    parse_triggers,
+)
+from neuroimagedisttraining_tpu.ops.sparsity import mask_distance
+
+
+def _data():
+    return make_synthetic_federated(
+        n_clients=6, samples_per_client=16, test_per_client=8,
+        sample_shape=(8, 8, 8, 1),
+    )
+
+
+def _hp():
+    return HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9,
+                       local_epochs=1, steps_per_epoch=2, batch_size=8)
+
+
+def _tree_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+# ---------------------------------------------------------------------------
+# off/on bit-identity + record shape
+# ---------------------------------------------------------------------------
+
+def test_numerics_off_on_bit_identity_and_record_keys():
+    data, hp = _data(), _hp()
+    off = FedAvg(create_model("small3dcnn", num_classes=1), data, hp,
+                 loss_type="bce", frac=0.5, seed=3)
+    on = FedAvg(create_model("small3dcnn", num_classes=1), data, hp,
+                loss_type="bce", frac=0.5, seed=3, obs_numerics=True)
+    s_off = off.init_state(jax.random.PRNGKey(3))
+    s_on = on.init_state(jax.random.PRNGKey(3))
+    for r in range(3):
+        s_off, m_off = off.run_round(s_off, r)
+        s_on, m_on = on.run_round(s_on, r)
+    # the state trajectory is bit-identical: numerics is a pure readout
+    assert _tree_equal(s_off.global_params, s_on.global_params)
+    assert _tree_equal(s_off.personal_params, s_on.personal_params)
+    # off keeps the PR-4 record shape exactly; on adds only num_* keys
+    assert not any(k.startswith("num_") for k in m_off)
+    extra = set(m_on) - set(m_off)
+    assert extra and all(k.startswith("num_") for k in extra)
+    # the full numerics surface is present
+    for prefix in ("num_update_norm", "num_upd/", "num_gnorm/",
+                   "num_maxabs/", "num_drift_s", "num_cos_s"):
+        assert any(k.startswith(prefix) for k in m_on), prefix
+    # obs knobs never change identity: plan names are excluded from the
+    # packed contract only by being ordinary scalars
+    assert len(m_on) == len(on._round_metric_names)
+
+
+def test_numerics_flag_inert_for_unsupported_algorithms():
+    # DisPFL ignores obs_numerics (numerics_supported=False): no plan,
+    # no metric-name drift
+    from neuroimagedisttraining_tpu.algorithms import DisPFL
+
+    algo = DisPFL(create_model("small3dcnn", num_classes=1), _data(),
+                  _hp(), loss_type="bce", seed=0, obs_numerics=True)
+    assert algo._numerics_plan is None
+    assert not any(n.startswith("num_")
+                   for n in algo._round_metric_names)
+
+
+# ---------------------------------------------------------------------------
+# fused vs unfused parity
+# ---------------------------------------------------------------------------
+
+def test_fused_unfused_parity_of_every_numerics_scalar():
+    algo = SalientGrads(create_model("small3dcnn", num_classes=1),
+                        _data(), _hp(), loss_type="bce", frac=0.5,
+                        seed=3, obs_numerics=True)
+    s0 = algo.init_state(jax.random.PRNGKey(3))
+    s_u, recs = s0, []
+    for r in range(4):
+        s_u, m = algo.run_round(s_u, r)
+        recs.append({k: float(v) for k, v in m.items()})
+    s_f, ys = algo.run_rounds_fused(s0, 0, 4)
+    assert _tree_equal(s_u.global_params, s_f.global_params)
+    num_names = [n for n in algo._round_metric_names
+                 if n.startswith("num_")]
+    assert num_names
+    for name in num_names:
+        col = np.asarray(ys[name])
+        for r in range(4):
+            u, f = recs[r][name], float(col[r])
+            assert (u == f) or (math.isnan(u) and math.isnan(f)), \
+                (name, r, u, f)
+
+
+# ---------------------------------------------------------------------------
+# mask churn / agreement pinned against ops.sparsity.mask_distance
+# ---------------------------------------------------------------------------
+
+def test_mask_metrics_pin_mask_distance():
+    rng = np.random.RandomState(0)
+    template = {"A": {"kernel": jnp.zeros((4, 3))},
+                "B": {"kernel": jnp.zeros((5,))}}
+    slots = 3
+    plan = NumericsPlan.from_params(template, slots=slots,
+                                    with_mask=True)
+    mask = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(rng.rand(*x.shape) > 0.4, jnp.float32),
+        template)
+    old = jax.tree_util.tree_map(
+        lambda x, m: jnp.asarray(rng.randn(*x.shape), jnp.float32) * m,
+        template, mask)
+    # new global with a DIFFERENT nonzero pattern -> nonzero churn
+    new = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(
+            rng.randn(*x.shape) * (rng.rand(*x.shape) > 0.5),
+            jnp.float32), template)
+    locals_ = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(
+            rng.randn(slots, *x.shape) * (rng.rand(slots, *x.shape)
+                                          > 0.3), jnp.float32),
+        template)
+    vals = dict(zip(plan.metric_names,
+                    plan.compute(old, new, locals_, mask=mask)))
+    churn = float(mask_distance(new, old))
+    assert churn > 0
+    assert float(vals["num_mask_churn"]) == pytest.approx(churn)
+    dists = np.asarray(jax.vmap(
+        lambda lo: mask_distance(lo, mask))(locals_))
+    assert float(vals["num_mask_agree"]) == pytest.approx(
+        1.0 - float(np.mean(dists)))
+    assert float(vals["num_mask_dist_max"]) == pytest.approx(
+        float(np.max(dists)))
+
+
+def test_plan_contract_errors():
+    template = {"A": {"kernel": jnp.zeros((2, 2))}}
+    plan = NumericsPlan.from_params(template, slots=2, with_mask=True)
+    with pytest.raises(ValueError, match="mask"):
+        plan.compute(template, template,
+                     {"A": {"kernel": jnp.zeros((2, 2, 2))}})
+    wrong = {"A": {"kernel": jnp.zeros((3, 2, 2))}}  # 3 slots, not 2
+    with pytest.raises(ValueError, match="cohort slot"):
+        plan.compute(template, template, wrong,
+                     mask=template)
+
+
+# ---------------------------------------------------------------------------
+# watchdog reuses the in-jit norm (satellite: robust/recovery.py)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_reuses_in_jit_update_norm(monkeypatch):
+    from neuroimagedisttraining_tpu.robust import recovery
+
+    data, hp = _data(), _hp()
+    algo = FedAvg(create_model("small3dcnn", num_classes=1), data, hp,
+                  loss_type="bce", frac=1.0, seed=0, obs_numerics=True)
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    s1, m = algo.run_round(s0, 0)
+    # the in-jit scalar IS the host quantity (bitwise on CPU: the same
+    # f32 sum-of-squares reduction over the same leaves)
+    host = recovery._global_update_norm(s1, s0)
+    assert float(m["num_update_norm"]) == pytest.approx(host, rel=1e-6)
+
+    # with the scalar on the record, the watchdog never re-materializes
+    # the state leaves
+    def _boom(*a, **k):
+        raise AssertionError("fallback path used despite in-jit norm")
+
+    monkeypatch.setattr(recovery, "_global_update_norm", _boom)
+    wd = recovery.RoundWatchdog(norm_threshold=1e9)
+    rec = {"train_loss": 0.5,
+           "num_update_norm": m["num_update_norm"]}  # device scalar ok
+    assert wd.healthy(rec, s1, s0)
+    assert isinstance(rec["num_update_norm"], float)  # kept materialized
+    wd_tight = recovery.RoundWatchdog(
+        norm_threshold=float(rec["num_update_norm"]) / 2)
+    assert not wd_tight.healthy(dict(rec), s1, s0)
+    # non-finite in-jit norm trips too
+    assert not wd.healthy({"train_loss": 0.5,
+                           "num_update_norm": float("nan")}, s1, s0)
+    # fallback preserved when numerics is off
+    monkeypatch.undo()
+    wd2 = recovery.RoundWatchdog(norm_threshold=1e9)
+    assert wd2.healthy({"train_loss": 0.5}, s1, s0)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_parse_triggers_grammar():
+    t = parse_triggers("auto")
+    assert t["watchdog"] and t["guard"] and t["drift_k"] is None
+    t = parse_triggers("guard,drift>3.5")
+    assert not t["watchdog"] and t["guard"] and t["drift_k"] == 3.5
+    for bad in ("bogus", "drift>", "drift>-1", ""):
+        with pytest.raises(ValueError):
+            parse_triggers(bad)
+
+
+def test_flight_recorder_bundle_schema_and_bounds(tmp_path):
+    fr = FlightRecorder(str(tmp_path), "run", spec="guard,drift>3.0",
+                        window=4, max_bundles=2, num_clients=6,
+                        clients_per_round=6)
+    # quiet rounds build drift history, no bundles
+    for r in range(6):
+        fr.observe_record({
+            "round": r, "clients_quarantined": 0.0,
+            "num_drift_s0": 0.01 + 1e-4 * r, "num_drift_s1": 0.012})
+    assert fr.bundles == []
+    # guard trigger + non-finite drift on slot 1
+    fr.observe_record({
+        "round": 6, "clients_quarantined": 1.0,
+        "num_drift_s0": 0.01, "num_drift_s1": float("nan"),
+        "num_maxabs/Conv_0": float("nan")})
+    assert len(fr.bundles) == 2  # guard_quarantine + drift_nonfinite
+    bdir = fr.bundles[0]
+    trig = json.load(open(os.path.join(bdir, "trigger.json")))
+    assert trig["reason"] == "guard_quarantine"
+    assert trig["round"] == 6
+    assert trig["bundle_schema"] == 1
+    assert trig["detail"]["slots"] == [1]
+    # slot 1 of round 6's replayed cohort is global client 1 (full
+    # participation -> arange)
+    assert trig["detail"]["clients"] == [1]
+    assert trig["detail"]["layer_groups"] == ["Conv_0"]
+    assert trig["record"]["round"] == 6
+    window = [json.loads(line) for line in
+              open(os.path.join(bdir, "window.jsonl"))]
+    assert 1 <= len(window) <= 5  # window cap + triggering record
+    assert window[-1]["round"] == 6
+    # budget spent: further triggers are counted, not captured
+    fr.observe_record({"round": 7, "clients_quarantined": 2.0})
+    assert len(fr.bundles) == 2
+    assert fr.triggers_skipped == 1
+    # dedupe: same (round, reason) never re-captures
+    fr2 = FlightRecorder(str(tmp_path), "run2", spec="guard")
+    rec = {"round": 1, "clients_quarantined": 1.0}
+    fr2.observe_record(rec)
+    fr2.observe_record(rec)
+    assert len(fr2.bundles) == 1
+
+
+def test_flight_recorder_watchdog_bundle_uses_attempt_nonce(tmp_path):
+    # the verdict-path record carries no rounds_retried yet: the
+    # explicit retry nonce must drive the slot->client replay, or a
+    # re-drawn cohort's drift is pinned on clients that never ran
+    from neuroimagedisttraining_tpu.obs.health import (
+        replay_client_indexes,
+    )
+
+    fr = FlightRecorder(str(tmp_path), "run", spec="watchdog",
+                        num_clients=8, clients_per_round=4)
+    rec = {"round": 0, "train_loss": float("inf"),
+           "num_drift_s2": float("nan")}
+    fr.note_watchdog(0, "skip", rec, retry=1)
+    trig = json.load(open(os.path.join(fr.bundles[0], "trigger.json")))
+    sel1 = replay_client_indexes(0, 8, 4, retry=1)
+    assert trig["detail"]["clients"] == [int(sel1[2])]
+
+
+def test_flight_recorder_drift_trigger_robust_threshold(tmp_path):
+    fr = FlightRecorder(str(tmp_path), "run", spec="drift>3.0",
+                        window=8)
+    for r in range(8):
+        fr.observe_record({"round": r, "num_drift_s0": 0.01})
+    fr.observe_record({"round": 8, "num_drift_s0": 10.0})
+    assert len(fr.bundles) == 1
+    trig = json.load(open(os.path.join(fr.bundles[0], "trigger.json")))
+    assert trig["reason"] == "drift"
+    assert trig["detail"]["drift_sigmas"] > 3.0
+
+
+# ---------------------------------------------------------------------------
+# obs_schema v1/v2 compatibility (satellite: obs/export.py)
+# ---------------------------------------------------------------------------
+
+def test_schema_versions_and_v1_fixture_still_analyzes():
+    assert export.OBS_SCHEMA_VERSION == 2
+    assert export.SUPPORTED_OBS_SCHEMAS == (1, 2)
+    # a PR-4-era (v1) stream: no num_* keys anywhere — analyzes cleanly
+    v1 = [{"round": r, "train_loss": 0.5, "round_time_s": 0.1,
+           "obs_schema": 1} for r in range(6)]
+    a = analyze.analyze_records(v1)
+    analyze.validate_analysis(a)
+    assert a["schema_version"] == analyze.ANALYSIS_SCHEMA_VERSION
+    assert not a["numerics"]["present"]
+    assert a["outlier_table"] == []
+    # a mixed stream (v1 rounds then a v2 rerun append) analyzes too
+    v2 = v1 + [{"round": 6, "train_loss": 0.4, "round_time_s": 0.1,
+                "obs_schema": 2, "num_update_norm": 0.5,
+                "num_drift_s0": 0.1}]
+    a2 = analyze.analyze_records(v2)
+    assert a2["numerics"]["present"]
+    # a FUTURE schema is still refused
+    with pytest.raises(ValueError, match="obs_schema"):
+        analyze.analyze_records(
+            [{"round": 0, "obs_schema": export.OBS_SCHEMA_VERSION + 1}])
+    # a v1 analysis DOCUMENT (no numerics/outlier_table keys) validates
+    v1_doc = {k: t() for k, t in analyze._SCHEMA_KEYS.items()}
+    v1_doc.update(schema_version=1, identity="old")
+    analyze.validate_analysis(v1_doc)
+    # ... but a v2 document missing the v2 keys does not
+    v2_doc = dict(v1_doc, schema_version=2)
+    with pytest.raises(ValueError, match="numerics"):
+        analyze.validate_analysis(v2_doc)
+
+
+# ---------------------------------------------------------------------------
+# guard-quarantine e2e: the analyzer names the injected client + group
+# ---------------------------------------------------------------------------
+
+def test_nan_chaos_e2e_analyzer_names_injected_client_and_group(
+        tmp_path):
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+    from neuroimagedisttraining_tpu.obs.health import (
+        replay_client_indexes,
+    )
+    from neuroimagedisttraining_tpu.robust.faults import (
+        fault_trace_round,
+        parse_fault_spec,
+    )
+
+    clients, rounds, seed = 6, 6, 0
+    spec = parse_fault_spec("nan=0.25")
+    poisoned_by_round = {}
+    for r in range(rounds):
+        sel = np.asarray(replay_client_indexes(r, clients, clients))
+        tr = fault_trace_round(spec, seed, r, sel)
+        hit = sel[np.asarray(tr["poisoned"]).astype(bool)]
+        if hit.size:
+            poisoned_by_round[r] = sorted(int(c) for c in hit)
+    assert poisoned_by_round, "chaos config injected nothing; re-seed"
+
+    args = parse_args([
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", str(clients), "--batch_size", "8",
+        "--epochs", "1", "--comm_round", str(rounds), "--lr", "0.05",
+        "--frequency_of_the_test", "0", "--final_finetune", "0",
+        "--seed", str(seed), "--fault_spec", "nan=0.25",
+        "--obs", "1", "--obs_numerics", "1",
+        "--flight_recorder", "auto",
+        "--log_dir", str(tmp_path / "LOG"),
+        "--results_dir", str(tmp_path / "results"),
+    ], algo="fedavg")
+    out = run_experiment(args, "fedavg")
+
+    # flight-recorder bundles exist for the quarantine rounds
+    flight_dir = os.path.join(str(tmp_path), "results", "synthetic",
+                              out["identity"] + ".flight")
+    bundles = sorted(os.listdir(flight_dir))
+    assert bundles
+    assert all(b.endswith("guard_quarantine") for b in bundles)
+    first = json.load(open(os.path.join(
+        flight_dir, bundles[0], "trigger.json")))
+    r0 = min(poisoned_by_round)
+    assert first["round"] == r0
+    assert first["detail"]["clients"] == poisoned_by_round[r0]
+
+    # the analyzer's numerics section attributes every quarantine round
+    # to the exact injected clients, and names a layer group
+    run_dir = os.path.join(str(tmp_path), "results", "synthetic")
+    analyses = analyze.analyze_run_dir(run_dir)
+    assert len(analyses) == 1
+    a = analyses[0]
+    analyze.validate_analysis(a)
+    att = {e["round"]: e for e in a["numerics"]["fault_attribution"]}
+    assert sorted(att) == sorted(poisoned_by_round)
+    for r, clients_hit in poisoned_by_round.items():
+        assert att[r]["clients"] == clients_hit, (r, att[r])
+        assert att[r]["layer_groups"], (r, att[r])
+        assert "guard_quarantine" in att[r]["sources"]
+        assert f"numerics_fault_round_{r}" in a["flags"]
+    # the report names them in prose too
+    report = analyze.render_report(a)
+    some_round, some_clients = next(iter(poisoned_by_round.items()))
+    assert f"FAULT round {some_round}" in report
+    assert f"client {some_clients[0]}" in report
+    # per-site health picked up the non-finite drift attribution
+    for r, clients_hit in poisoned_by_round.items():
+        for c in clients_hit:
+            site = a["health"]["sites"][str(c)]
+            assert site["drift_nonfinite"] >= 1
