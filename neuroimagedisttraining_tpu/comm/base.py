@@ -22,11 +22,43 @@ class Observer(abc.ABC):
         ...
 
 
+class CommCounters:
+    """Per-manager transport accounting: serialized bytes and message
+    counts actually sent/received over the wire (the measured side of
+    obs/comm.py's analytical wire-cost model). Updated by every backend
+    at its send/receive sites; ``snapshot()`` is what a cross-silo
+    round loop folds into its telemetry."""
+
+    __slots__ = ("bytes_sent", "bytes_received", "messages_sent",
+                 "messages_received")
+
+    def __init__(self):
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.messages_sent = 0
+        self.messages_received = 0
+
+    def note_sent(self, nbytes: int) -> None:
+        self.bytes_sent += int(nbytes)
+        self.messages_sent += 1
+
+    def note_received(self, nbytes: int) -> None:
+        self.bytes_received += int(nbytes)
+        self.messages_received += 1
+
+    def snapshot(self) -> dict:
+        return {"comm_bytes_sent": self.bytes_sent,
+                "comm_bytes_received": self.bytes_received,
+                "comm_messages_sent": self.messages_sent,
+                "comm_messages_received": self.messages_received}
+
+
 class BaseCommunicationManager(abc.ABC):
     """send/receive + observer dispatch contract."""
 
     def __init__(self):
         self._observers: List[Observer] = []
+        self.counters = CommCounters()
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None:
@@ -113,8 +145,10 @@ class QueueInboxMixin(PollingReceiveLoopMixin):
                         payload = self._inbox.get_nowait()
                     except queue.Empty:
                         raise ConnectionError("transport lost") from None
+                    self.counters.note_received(len(payload))
                     return Message.from_bytes(payload)
                 if block_forever:
                     continue
                 return None
+            self.counters.note_received(len(payload))
             return Message.from_bytes(payload)

@@ -219,9 +219,14 @@ class GrpcCommManager(QueueInboxMixin, BaseCommunicationManager):
             return entry[1]
 
     def send_message(self, msg: Message) -> None:
+        payload = msg.to_bytes()
         req = self._pb2.CommRequest(
-            client_id=self.rank, message=msg.to_bytes())
+            client_id=self.rank, message=payload)
         self._stub(msg.receiver_id)(req)
+        # counted after the unary call returns (ack received) — the
+        # same sent-means-transport-accepted semantics as the TCP
+        # backend's post-rc check
+        self.counters.note_sent(len(payload))
 
     # -- receiving: recv/pump come from QueueInboxMixin (the servicer feeds
     # self._inbox) — the message_handling_subroutine equivalent, without the

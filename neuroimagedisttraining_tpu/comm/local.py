@@ -36,6 +36,7 @@ class LocalCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message) -> None:
         payload = msg.to_bytes()  # same wire format as the TCP backend
+        self.counters.note_sent(len(payload))
         self.router.queues[msg.receiver_id].put(payload)
 
     def handle_receive_message(self) -> None:
@@ -44,6 +45,7 @@ class LocalCommManager(BaseCommunicationManager):
                 payload = self.router.queues[self.rank].get(timeout=0.1)
             except queue.Empty:
                 continue
+            self.counters.note_received(len(payload))
             self._notify(Message.from_bytes(payload))
 
     def stop_receive_message(self) -> None:

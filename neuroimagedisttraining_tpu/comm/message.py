@@ -10,12 +10,35 @@ cross-silo round never pickles and never base64s.
 from __future__ import annotations
 
 import json
+import logging
 import struct as _struct
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 MAGIC = b"NIDT"
+
+#: serialized-size accounting hooks: every ``Message.to_bytes`` call
+#: invokes each with ``(msg_type, nbytes)`` — the obs layer's tap for
+#: measured wire bytes (obs/comm.py registers one per ObsSession when
+#: comm telemetry is on). Hooks must never kill a send: exceptions are
+#: logged and dropped.
+_NBYTES_HOOKS: List[Callable[[str, int], None]] = []
+
+
+def add_nbytes_hook(hook: Callable[[str, int], None]
+                    ) -> Callable[[str, int], None]:
+    _NBYTES_HOOKS.append(hook)
+    return hook
+
+
+def remove_nbytes_hook(hook: Callable[[str, int], None]) -> None:
+    try:
+        _NBYTES_HOOKS.remove(hook)
+    except ValueError:
+        pass  # already removed (idempotent teardown)
 
 
 class _SparseLeaf:
@@ -74,6 +97,8 @@ class Message:
             self.ARG_RECEIVER: receiver_id,
         }
         self.tensors: Dict[str, Any] = {}  # name -> pytree of np/jax arrays
+        #: serialized size of the last ``to_bytes`` call (None until one)
+        self.nbytes: Optional[int] = None
 
     # -- kv interface (message.py:30-52) --------------------------------------
     def add(self, key: str, value: Any) -> None:
@@ -194,8 +219,20 @@ class Message:
             }
         header = json.dumps(
             {"params": self.params, "tensors": tensor_index}).encode()
-        return b"".join([MAGIC, _struct.pack("<I", len(header)), header,
-                         *leaves_blob])
+        out = b"".join([MAGIC, _struct.pack("<I", len(header)), header,
+                        *leaves_blob])
+        # serialized-size accounting: the exact bytes a backend ships.
+        # ``nbytes`` stays on the message for callers that hold it; the
+        # module hooks feed the obs registry's measured-bytes counters
+        # (obs/comm.py — validated against the analytical wire model by
+        # tests/test_comm_model_properties.py)
+        self.nbytes = len(out)
+        for hook in list(_NBYTES_HOOKS):
+            try:
+                hook(self.type, self.nbytes)
+            except Exception:
+                logger.debug("message nbytes hook failed", exc_info=True)
+        return out
 
     @classmethod
     def from_bytes(cls, payload: bytes) -> "Message":

@@ -277,6 +277,9 @@ class PubSubCommManager(QueueInboxMixin, BaseCommunicationManager):
         frame = _pub_frame(topic, payload)
         with self._send_lock:
             self._sock.sendall(frame)
+        # Message payload bytes, not the framed size — the same
+        # serialized-message basis every other backend counts
+        self.counters.note_sent(len(payload))
 
     # recv/pump come from QueueInboxMixin (fed by _read_loop)
 

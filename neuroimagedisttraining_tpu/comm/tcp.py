@@ -125,6 +125,7 @@ class TcpCommManager(PollingReceiveLoopMixin, BaseCommunicationManager):
                                  len(payload))
         if rc != 0:
             raise OSError(f"comm_send to rank {msg.receiver_id} failed ({rc})")
+        self.counters.note_sent(len(payload))
 
     def recv(self, timeout_s: float = -1.0) -> Optional[Message]:
         """Blocking receive of one message (None on timeout)."""
@@ -140,6 +141,7 @@ class TcpCommManager(PollingReceiveLoopMixin, BaseCommunicationManager):
             payload = ctypes.string_at(buf, length.value)
         finally:
             self._lib.comm_free_buf(buf)
+        self.counters.note_received(len(payload))
         return Message.from_bytes(payload)
 
     # handle_receive_message/stop_receive_message from PollingReceiveLoopMixin

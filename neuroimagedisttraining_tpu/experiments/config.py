@@ -304,6 +304,22 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "(fused blocks stay sync-free). fedavg/"
                         "salientgrads only. Off (the default) is "
                         "bit-inert")
+    p.add_argument("--obs_comm", type=int, default=0,
+                   help="communication telemetry (obs/comm.py): the "
+                        "analytical wire-cost model's comm_* metrics "
+                        "(modeled bytes per agg_impl and per leaf "
+                        "group, live mask density) joined onto every "
+                        "JSONL line, a once-per-run timed aggregation "
+                        "probe (comm_agg_ms / per-round "
+                        "comm_agg_share), Message serialized-size "
+                        "accounting, and — with --profile_dir — the "
+                        "device-trace collective-time attribution "
+                        "(obs/devtrace.py) written as "
+                        "<identity>.devtrace.json. Requires --obs; "
+                        "central-aggregate algorithms (fedavg/"
+                        "salientgrads/ditto) only. Off (the default) "
+                        "is bit-inert; like every obs knob it never "
+                        "enters run/checkpoint identity")
     p.add_argument("--flight_recorder", type=str, default="",
                    help="anomaly flight recorder (obs/recorder.py): "
                         "comma-separated triggers — 'guard' (in-jit "

@@ -310,6 +310,16 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             "--obs_numerics threads the in-jit numerics telemetry "
             "through the central-aggregate round outputs "
             f"(fedavg/salientgrads); {algo_name} does not thread them")
+    if getattr(args, "obs_comm", 0):
+        if not getattr(args, "obs", 0):
+            raise SystemExit(
+                "--obs_comm rides the obs session (per-round JSONL + "
+                "registry); pass --obs 1")
+        if algo_name not in ("fedavg", "salientgrads", "ditto"):
+            raise SystemExit(
+                "--obs_comm models the CENTRAL aggregation wire "
+                f"(fedavg/salientgrads/ditto); {algo_name} has no "
+                "central aggregate to price")
     agg_impl = getattr(args, "agg_impl", "dense")
     if agg_impl != "dense" and algo_name not in (
             "fedavg", "salientgrads", "ditto"):
@@ -599,9 +609,14 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
             ckpt_mgr.save(end_round, state_out,
                           metadata=_ckpt_metadata(args, algo, cost))
 
+    # with obs on, fused records get round_time_s stamped at flush
+    # boundaries (block wall split evenly — the documented fused
+    # semantics), matching the unfused loop's DeferredRecords(timed=
+    # obs) rule; off keeps the pre-obs record shape exactly. The
+    # per-round comm_agg_share stamp (obs/comm.py) divides by it.
     return algo._fused_block_loop(
         state, start_round, total, block, ev_every, on_record,
-        on_block=on_block)
+        on_block=on_block, timed=obs_session is not None)
 
 
 def run_experiment(args: argparse.Namespace,
@@ -680,7 +695,8 @@ def run_experiment(args: argparse.Namespace,
                 trace_dir=getattr(args, "trace_dir", ""),
                 identity=identity,
                 sample_every=getattr(args, "obs_sample_every", 1),
-                tb_dir=getattr(args, "obs_tb_dir", ""))
+                tb_dir=getattr(args, "obs_tb_dir", ""),
+                comm=bool(getattr(args, "obs_comm", 0)))
             logger.info("obs: per-round JSONL -> %s", jsonl)
 
         with obs_trace.span("build"):
@@ -737,10 +753,71 @@ def run_experiment(args: argparse.Namespace,
             with obs_trace.span("init_state"):
                 state = algo.init_state(jax.random.PRNGKey(args.seed))
 
+        # comm telemetry (--obs_comm): price the aggregation wire ONCE —
+        # the analytical model from the params template + live mask
+        # density, plus the measured probe (one timed aggregation of a
+        # shape-matched synthetic cohort through the algorithm's own
+        # agg path; pure readout, bit-inert). The session joins the
+        # static comm_* metrics onto every JSONL line.
+        wire_model = None
+        if obs_session is not None and getattr(args, "obs_comm", 0):
+            from ..obs import comm as obs_comm
+
+            wire_model = obs_comm.WireCostModel.from_algorithm(
+                algo, state)
+            comm_metrics = wire_model.round_metrics()
+            # one probe, one synthetic cohort: timed agg ms plus the
+            # no-trace fallback's AOT cost-analysis numbers
+            # (obs/devtrace.py's share_from_cost_analysis consumes the
+            # flops/bytes against a round program's cost when no
+            # profiler capture exists)
+            probe = obs_comm.probe_aggregate(algo, state=state)
+            comm_metrics["comm_agg_ms"] = probe["agg_ms"]
+            for ck, mk in (("flops", "comm_agg_flops"),
+                           ("bytes_accessed",
+                            "comm_agg_bytes_accessed")):
+                if isinstance(probe.get(ck), (int, float)):
+                    comm_metrics[mk] = float(probe[ck])
+            obs_session.set_comm_metrics(comm_metrics)
+            logger.info(
+                "obs comm: %s wire %.2f MB/agg (density %.3f), probed "
+                "agg %.2f ms", algo.agg_impl,
+                comm_metrics["comm_bytes_wire"] / 1e6,
+                comm_metrics["comm_density"],
+                comm_metrics["comm_agg_ms"])
+
         if args.profile_dir:
             from ..utils.profiling import trace_one_round
 
             trace_one_round(algo, state, args.profile_dir)
+            if wire_model is not None:
+                # device-trace attribution (obs/devtrace.py): collective
+                # vs compute time from the jax.profiler capture, written
+                # as the <identity>.devtrace.json sidecar the analyzer's
+                # comm section reads. Best-effort: a truncated trace
+                # must not kill the run.
+                from ..obs import devtrace as obs_devtrace
+
+                try:
+                    summary = obs_devtrace.analyze_profile_dir(
+                        args.profile_dir,
+                        modeled_bytes=wire_model.bytes_for(
+                            algo.agg_impl))
+                    if summary.get("present") and obs_session.exports \
+                            and obs_session.jsonl_path:
+                        path = obs_devtrace.write_summary(
+                            summary, os.path.join(
+                                os.path.dirname(obs_session.jsonl_path)
+                                or ".", identity + ".devtrace.json"))
+                        obs_session.registry.gauge(
+                            "comm_devtrace_agg_share").set(
+                            summary["totals"]["agg_share"])
+                        logger.info(
+                            "obs comm: devtrace %.1f%% collective -> %s",
+                            100 * summary["totals"]["agg_share"], path)
+                except Exception:
+                    logger.warning("devtrace attribution failed",
+                                   exc_info=True)
 
         # per-round cost accounting (stat_info's sum_training_flops /
         # sum_comm_params, sailentgrads_api.py:137-138,334-346)

@@ -44,13 +44,27 @@ The NUMERICS half — what happens inside the jitted round:
   numerics JSONL + optional retry-round device trace) when the guard
   quarantines, the watchdog rolls back, or a drift trigger trips.
 
+The COMMUNICATION half — where the aggregation's bytes and time go:
+
+* :mod:`~.comm` — the analytical wire-cost model (``--obs_comm``):
+  bytes-on-the-wire per ``agg_impl`` and per top-level leaf group at
+  the live mask density, a once-per-run timed probe of the
+  algorithm's own aggregation path, and ``Message`` serialized-size
+  accounting — per-round ``comm_*`` JSONL stamps (obs schema v3).
+* :mod:`~.devtrace` — ``jax.profiler`` device-trace parsing:
+  collective-vs-compute time attribution (measured agg share,
+  achieved wire GB/s vs the model), with a ``jit_cost_analysis``
+  FLOPs/bytes fallback when no trace was captured.
+
 Nothing here enters run/checkpoint identity: telemetry never forks a
 lineage, and with ``--obs`` off every hook is a no-op (bit-identical to
 the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it).
 """
 from . import (
     analyze,
+    comm,
     compile,
+    devtrace,
     export,
     health,
     memory,
@@ -61,5 +75,6 @@ from . import (
     trace,
 )
 
-__all__ = ["analyze", "compile", "export", "health", "memory",
-           "metrics", "numerics", "recorder", "regress", "trace"]
+__all__ = ["analyze", "comm", "compile", "devtrace", "export",
+           "health", "memory", "metrics", "numerics", "recorder",
+           "regress", "trace"]

@@ -7,6 +7,12 @@
     python -m neuroimagedisttraining_tpu.obs analyze results/synthetic \
         [--trace-dir /tmp/trace] [--no-write] [--json]
 
+    # live-tail a running (or finished) run's per-round JSONL: one
+    # formatted line per round as it lands — round time, agg share,
+    # guard/watchdog/drift events (first step toward live SLO watching)
+    python -m neuroimagedisttraining_tpu.obs tail results/synthetic \
+        [--identity <run-identity>] [--poll 0.5] [--once]
+
     # regression-gate a value against the bench history
     # (scripts/perf_gate.py is the fuller CI surface)
     python -m neuroimagedisttraining_tpu.obs regress --value 1.66 \
@@ -14,14 +20,119 @@
         [--history results/bench_history.jsonl]
 
 Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
-regress — the perf-gate codes (0 pass, 1 regression, 2 no history).
+tail — 0 (interrupt to stop; --once prints what's there and exits, 2
+when no stream resolves); regress — the perf-gate codes (0 pass, 1
+regression, 2 no history).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Optional, Sequence
+import time
+from typing import Callable, Optional, Sequence
+
+
+def resolve_stream(target: str, identity: str = "") -> Optional[str]:
+    """``tail``'s stream resolution: an explicit JSONL path passes
+    through; a run dir picks ``<identity>.obs.jsonl`` when given, else
+    the most recently modified stream (the live run).
+
+    A NAMED stream (explicit ``.obs.jsonl`` path or dir+identity) need
+    not exist yet — a just-launched run opens its stream lazily at the
+    first flush, and ``tail_stream``'s follow mode waits for exactly
+    that; only the pick-the-newest mode needs something on disk."""
+    if os.path.isfile(target):
+        return target
+    if target.endswith(".obs.jsonl") and \
+            os.path.isdir(os.path.dirname(target) or "."):
+        return target
+    if not os.path.isdir(target):
+        return None
+    if identity:
+        return os.path.join(target, identity + ".obs.jsonl")
+    streams = [os.path.join(target, f) for f in os.listdir(target)
+               if f.endswith(".obs.jsonl")]
+    return max(streams, key=os.path.getmtime) if streams else None
+
+
+def format_tail_line(rec: dict) -> str:
+    """One round record -> one human line: round index, wall time,
+    loss, agg share, and any guard / watchdog / drift events."""
+    r = rec.get("round")
+    parts = ["final " if r == -1 else f"round {r:<4}"
+             if isinstance(r, (int, float)) else "?     "]
+    rt = rec.get("round_time_s")
+    if isinstance(rt, (int, float)):
+        parts.append(f"{rt * 1e3:8.1f} ms")
+    for key, label in (("train_loss", "loss"), ("global_acc", "acc"),
+                       ("personal_acc", "pacc")):
+        v = rec.get(key)
+        if isinstance(v, (int, float)):
+            parts.append(f"{label} {v:.4f}")
+    share = rec.get("comm_agg_share")
+    if isinstance(share, (int, float)):
+        agg_ms = rec.get("comm_agg_ms")
+        parts.append(f"agg {100 * share:.1f}%"
+                     + (f" ({agg_ms:.2f} ms)"
+                        if isinstance(agg_ms, (int, float)) else ""))
+    events = []
+    if (rec.get("clients_dropped") or 0) > 0:
+        events.append(f"DROP {rec['clients_dropped']:g}")
+    if (rec.get("clients_quarantined") or 0) > 0:
+        events.append(f"GUARD quarantined={rec['clients_quarantined']:g}")
+    if (rec.get("rounds_retried") or 0) > 0:
+        events.append(f"WATCHDOG retried={rec['rounds_retried']:g}")
+    if (rec.get("round_skipped") or 0) > 0:
+        events.append("WATCHDOG skipped")
+    from .numerics import drift_slots
+
+    bad = sorted(j for j, v in drift_slots(rec).items()
+                 if v != v or v in (float("inf"), float("-inf")))
+    if bad:
+        events.append("DRIFT nonfinite slots " +
+                      ",".join(str(j) for j in bad))
+    if events:
+        parts.append("[" + "; ".join(events) + "]")
+    return "  ".join(parts)
+
+
+def tail_stream(path: str, poll: float = 0.5, follow: bool = True,
+                out: Callable[[str], None] = print,
+                stop: Optional[Callable[[], bool]] = None) -> int:
+    """Follow one per-round JSONL stream, emitting a formatted line per
+    record as it lands (the file may not exist yet — a just-launched
+    run opens it lazily at the first flush). Returns records printed;
+    ``follow=False`` prints what is there and returns. ``stop`` is the
+    test hook (checked each idle poll)."""
+    while not os.path.exists(path):
+        if not follow or (stop is not None and stop()):
+            return 0
+        time.sleep(poll)
+    printed = 0
+    buf = ""
+    with open(path) as fh:
+        while True:
+            chunk = fh.readline()
+            if chunk:
+                buf += chunk
+                if not buf.endswith("\n"):
+                    continue  # partial line: the writer is mid-flush
+                line, buf = buf.strip(), ""
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    out(f"?? malformed line: {line[:80]}")
+                    continue
+                out(format_tail_line(rec))
+                printed += 1
+                continue
+            if not follow or (stop is not None and stop()):
+                return printed
+            time.sleep(poll)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -40,6 +151,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="do not write <identity>.analysis.json files")
     pa.add_argument("--json", action="store_true",
                     help="print the analysis JSON instead of the report")
+
+    pt = sub.add_parser("tail", help="live-tail a run's per-round JSONL")
+    pt.add_argument("target", help="run dir holding *.obs.jsonl streams, "
+                                   "or one stream path")
+    pt.add_argument("--identity", default="",
+                    help="stream to follow when the dir holds several "
+                         "(default: the most recently modified)")
+    pt.add_argument("--poll", type=float, default=0.5,
+                    help="seconds between polls of the stream")
+    pt.add_argument("--once", action="store_true",
+                    help="print the records already there and exit "
+                         "(the scriptable mode; default follows live)")
 
     pr = sub.add_parser("regress", help="bench-history regression gate")
     pr.add_argument("--history", default="results/bench_history.jsonl")
@@ -70,11 +193,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print()
         return 0
 
+    if args.cmd == "tail":
+        path = resolve_stream(args.target, args.identity)
+        if path is None:
+            print(f"no *.obs.jsonl stream under {args.target} "
+                  "(was the run launched with --obs 1?)",
+                  file=sys.stderr)
+            return 2
+        print(f"tailing {path}", file=sys.stderr)
+        try:
+            tail_stream(path, poll=args.poll, follow=not args.once)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
     from . import regress as obs_regress
 
+    # mirror scripts/perf_gate.py so the two regress surfaces cannot
+    # disagree on a verdict: the same per-metric defaults (comm SLO
+    # metrics are lower-is-better with their own band), the same
+    # fresh-clone auto-backfill of the default history from the
+    # committed BENCH_r*/MULTICHIP_r* artifacts, and the same
+    # own-commit exclusion (a rerun's just-appended measurement must
+    # not join its own baseline)
+    if not os.path.exists(args.history) and \
+            args.history == "results/bench_history.jsonl":
+        obs_regress.backfill_bench_files(os.getcwd(), args.history)
+        obs_regress.backfill_multichip_files(os.getcwd(), args.history)
+    defaults = obs_regress.metric_gate_defaults(args.metric)
     verdict = obs_regress.gate(
         args.history, args.metric, args.value,
-        higher_is_better=not args.lower_is_better)
+        rel_threshold=defaults.get(
+            "rel_threshold", obs_regress.DEFAULT_REL_THRESHOLD),
+        mad_k=defaults.get("mad_k", obs_regress.DEFAULT_MAD_K),
+        higher_is_better=(not args.lower_is_better
+                          and defaults.get("higher_is_better", True)),
+        exclude_git_sha=obs_regress.git_sha())
     print(json.dumps(verdict))
     return int(verdict["exit_code"])
 

@@ -49,9 +49,14 @@ __all__ = [
 #: per-layer-group precursor trends, per-client drift trajectories,
 #: fault/rollback attribution) and the combined ``outlier_table``
 #: (timing outliers + numeric drift outliers as one ranked table).
-#: v1 documents (and v1/PR-4-era ``obs_schema 1`` round streams) are
-#: still accepted — the v2 keys are required only of v2 documents.
-ANALYSIS_SCHEMA_VERSION = 2
+#: v3 adds the ``comm`` section (obs/comm.py wire-cost telemetry:
+#: modeled bytes per agg_impl and per leaf group, the what-if table at
+#: the live mask density, probed agg time/share, measured serialized
+#: bytes, and the obs/devtrace.py device-trace attribution when a
+#: profile was captured). Older documents (and older ``obs_schema``
+#: round streams) are still accepted — each version's keys are
+#: required only of documents at that version or newer.
+ANALYSIS_SCHEMA_VERSION = 3
 
 #: host span name -> phase bucket. Container / nested spans are mapped
 #: to None and skipped so phase totals never double-count (``round``
@@ -608,6 +613,98 @@ def _outlier_table(stragglers: List[Dict[str, Any]],
     return sorted(rows.values(), key=severity)
 
 
+#: the analyzer flags a round stream as aggregation-bound when the
+#: median probed/measured agg share exceeds this (ROADMAP Open item 3's
+#: "push agg share below 25% at scale-32" target makes >50% a finding)
+COMM_AGG_SHARE_FLAG = 0.5
+
+
+def _analyze_comm(records: List[Dict[str, Any]],
+                  metrics: Optional[Dict[str, Any]],
+                  devtrace: Optional[Dict[str, Any]] = None,
+                  config: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """The schema-v3 comm section: modeled bytes per wire and per leaf
+    group (obs/comm.py's per-round stamps), the what-if table at the
+    live density, probed agg time/share, measured serialized bytes
+    (Message accounting counters), and the device-trace attribution
+    sidecar when one was captured. ``present`` only when the stream
+    actually carries comm keys (comm telemetry was on) or a devtrace
+    summary exists — v1/v2 streams analyze with an empty section."""
+    out: Dict[str, Any] = {
+        "present": False, "impl": None, "density": None,
+        "n_params": None, "n_devices": None, "wire_bytes": None,
+        "modeled": {}, "groups": {}, "what_if": [],
+        "agg_ms": {}, "agg_share": {}, "probe_gbps": None,
+        "measured": {}, "devtrace": {},
+    }
+    rows = [r for r in records
+            if any(k.startswith("comm_") for k in r)]
+    if devtrace and devtrace.get("present"):
+        out["devtrace"] = {
+            "agg_share": devtrace.get("totals", {}).get("agg_share"),
+            "collective_s": devtrace.get("totals", {}).get(
+                "collective_s"),
+            "busy_s": devtrace.get("totals", {}).get("busy_s"),
+            "devices": len(devtrace.get("devices") or {}),
+            "achieved_gbps": devtrace.get("achieved_gbps"),
+            "top_collectives": devtrace.get("top_collectives") or [],
+        }
+        out["present"] = True
+    for name, entry in (metrics or {}).items():
+        if name.startswith("comm_msg") and isinstance(entry, dict):
+            out["measured"][name] = entry.get("value")
+    if not rows:
+        return out
+    out["present"] = True
+    last = rows[-1]
+    out["impl"] = (config or {}).get("agg_impl")
+    out["density"] = last.get("comm_density")
+    out["n_params"] = last.get("comm_n_params")
+    out["n_devices"] = last.get("comm_n_devices")
+    out["wire_bytes"] = last.get("comm_bytes_wire")
+    group_prefix = "comm_bytes_group/"
+    for k, v in last.items():
+        if not isinstance(v, (int, float)):
+            continue
+        if k.startswith(group_prefix):
+            out["groups"][k[len(group_prefix):]] = float(v)
+        elif k.startswith("comm_bytes_") and k != "comm_bytes_wire":
+            out["modeled"][k[len("comm_bytes_"):]] = float(v)
+    dense = out["modeled"].get("dense")
+    out["what_if"] = sorted(
+        ({"impl": impl, "bytes": b,
+          "vs_dense": (round(b / dense, 4) if dense else None)}
+         for impl, b in out["modeled"].items()),
+        key=lambda e: e["bytes"])
+    from .metrics import median as _median
+
+    for key, sect in (("comm_agg_ms", "agg_ms"),
+                      ("comm_agg_share", "agg_share")):
+        series = [float(r[key]) for r in rows
+                  if isinstance(r.get(key), (int, float))
+                  and math.isfinite(r[key])]
+        if series:
+            out[sect] = {"median": _median(series),
+                         "max": max(series), "min": min(series),
+                         "rounds": len(series)}
+    agg_ms = out["agg_ms"].get("median")
+    if isinstance(out["wire_bytes"], (int, float)) and agg_ms:
+        # EFFECTIVE bandwidth over the probe's FULL aggregation wall
+        # (compute included) — deliberately named apart from the
+        # devtrace's achieved_gbps, whose denominator is collective
+        # kernel time only; the two answer different questions
+        out["probe_gbps"] = out["wire_bytes"] / (agg_ms / 1e3) / 1e9
+    # the no-trace fallback's AOT cost-analysis numbers (obs/comm.py
+    # probe_agg_cost), when the backend reported them
+    cost = {k: last[k] for k in ("comm_agg_flops",
+                                 "comm_agg_bytes_accessed")
+            if isinstance(last.get(k), (int, float))}
+    if cost:
+        out["cost_analysis"] = cost
+    return out
+
+
 def _analyze_compile(metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     m = metrics or {}
     out: Dict[str, Any] = {"present": False, "total_s": 0.0,
@@ -641,7 +738,9 @@ def analyze_records(records: List[Dict[str, Any]],
                     trace_doc: Optional[Dict[str, Any]] = None,
                     metrics: Optional[Dict[str, Any]] = None,
                     config: Optional[Dict[str, Any]] = None,
-                    identity: str = "run") -> Dict[str, Any]:
+                    identity: str = "run",
+                    devtrace: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
     """Pure-function analyzer core over an already-loaded round stream
     (plus optional trace / metrics.json / run-config dicts)."""
     newer = [r.get("obs_schema") for r in records
@@ -664,6 +763,8 @@ def analyze_records(records: List[Dict[str, Any]],
     health = build_health_ledger(rounds, config)
     stragglers = _straggler_rounds(rounds, outliers, config)
     numerics = _analyze_numerics(rounds, config)
+    comm = _analyze_comm(rounds, metrics, devtrace=devtrace,
+                         config=config)
     analysis = {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
         "identity": identity,
@@ -678,6 +779,7 @@ def analyze_records(records: List[Dict[str, Any]],
         "health": health,
         "numerics": numerics,
         "outlier_table": _outlier_table(stragglers, numerics),
+        "comm": comm,
     }
     flags = []
     flags += [f"straggler_round_{s['round']}" for s in stragglers]
@@ -690,6 +792,13 @@ def analyze_records(records: List[Dict[str, Any]],
               for c in numerics["client_outliers"]]
     flags += [f"numerics_fault_round_{a['round']}"
               for a in numerics["fault_attribution"]]
+    # aggregation-bound flag: the probed share (or, preferred when a
+    # device trace was captured, the measured one) exceeds the SLO line
+    agg_share = comm["devtrace"].get("agg_share") if comm["devtrace"] \
+        else comm["agg_share"].get("median")
+    if isinstance(agg_share, (int, float)) and \
+            agg_share > COMM_AGG_SHARE_FLAG:
+        flags.append(f"agg_share_{int(round(100 * agg_share))}pct")
     analysis["flags"] = flags
     return analysis
 
@@ -707,6 +816,9 @@ _SCHEMA_KEYS = {
 #: analysis.json files (PR-4-era run dirs) still validate cleanly
 _SCHEMA_KEYS_V2 = {"numerics": dict, "outlier_table": list}
 
+#: keys ADDED by schema v3 — required only of v3+ documents
+_SCHEMA_KEYS_V3 = {"comm": dict}
+
 
 def validate_analysis(analysis: Dict[str, Any]) -> None:
     """Raise ValueError describing every schema violation (an explicit
@@ -716,9 +828,11 @@ def validate_analysis(analysis: Dict[str, Any]) -> None:
         raise ValueError(f"analysis is {type(analysis).__name__}, "
                          "expected dict")
     required = dict(_SCHEMA_KEYS)
-    if isinstance(analysis.get("schema_version"), int) and \
-            analysis["schema_version"] >= 2:
-        required.update(_SCHEMA_KEYS_V2)
+    if isinstance(analysis.get("schema_version"), int):
+        if analysis["schema_version"] >= 2:
+            required.update(_SCHEMA_KEYS_V2)
+        if analysis["schema_version"] >= 3:
+            required.update(_SCHEMA_KEYS_V3)
     for key, typ in required.items():
         if key not in analysis:
             problems.append(f"missing key {key!r}")
@@ -781,9 +895,14 @@ def analyze_run_dir(run_dir: str, trace_dir: str = "",
                 os.path.join(td, identity + ".trace.json"))
             if trace_doc is not None:
                 break
+        # obs/devtrace.py summary sidecar (written by the runner when
+        # --obs_comm + --profile_dir were both set)
+        devtrace = _maybe_json(
+            os.path.join(run_dir, identity + ".devtrace.json"))
         analysis = analyze_records(
             records, trace_doc=trace_doc, metrics=metrics,
-            config=(stat or {}).get("config"), identity=identity)
+            config=(stat or {}).get("config"), identity=identity,
+            devtrace=devtrace)
         if write:
             analysis["analysis_path"] = write_analysis(
                 analysis, os.path.join(run_dir,
@@ -905,6 +1024,49 @@ def render_report(analysis: Dict[str, Any]) -> str:
                 bits.append("NONFINITE drift")
             bits.append("[" + "+".join(e["sources"]) + "]")
             lines.append("  " + ", ".join(bits))
+    cm = a.get("comm") or {}
+    if cm.get("present"):
+        lines.append("comm (wire-cost telemetry):")
+        if cm.get("wire_bytes") is not None:
+            lines.append(
+                f"  active wire ({cm.get('impl') or 'dense'}): "
+                f"{cm['wire_bytes'] / 1e6:.2f} MB/agg"
+                + (f" at density {cm['density']:.3f}"
+                   if isinstance(cm.get("density"), (int, float))
+                   else "")
+                + (f", {cm['n_devices']:g} device(s)"
+                   if cm.get("n_devices") else ""))
+        for e in cm.get("what_if") or ():
+            lines.append(
+                f"  what-if {e['impl']:<9} {e['bytes'] / 1e6:9.2f} MB"
+                + (f"  ({e['vs_dense']:.2f}x dense)"
+                   if e.get("vs_dense") is not None else ""))
+        for g, b in sorted((cm.get("groups") or {}).items(),
+                           key=lambda kv: -kv[1]):
+            lines.append(f"  group {g:<16} {b / 1e6:9.2f} MB")
+        ashare = cm.get("agg_share") or {}
+        if ashare:
+            lines.append(
+                f"  probed agg: {cm['agg_ms']['median']:.2f} ms "
+                f"({100 * ashare['median']:.1f}% of round median"
+                + (f", {cm['probe_gbps']:.2f} GB/s effective over "
+                   "the probe wall"
+                   if cm.get("probe_gbps") is not None else "")
+                + ")")
+        dt = cm.get("devtrace") or {}
+        if dt:
+            lines.append(
+                f"  devtrace: collective {dt['collective_s']:.3f} s of "
+                f"{dt['busy_s']:.3f} s busy "
+                f"({100 * (dt['agg_share'] or 0):.1f}% measured share, "
+                f"{dt['devices']} device lane(s))"
+                + (f", achieved {dt['achieved_gbps']:.2f} GB/s"
+                   if dt.get("achieved_gbps") is not None else ""))
+        meas = cm.get("measured") or {}
+        if meas:
+            lines.append("  measured messages: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(meas.items())
+                if isinstance(v, (int, float))))
     c = a["compile"]
     if c["present"]:
         lines.append(f"compile: {c['total_s']:.2f} s total"

@@ -38,23 +38,26 @@ __all__ = [
 #: than it understands instead of misreading them).
 #: v2 adds the flat in-jit numerics keys (``num_*`` — obs/numerics.py:
 #: per-layer-group update/grad norms and max-abs precursor gauges,
-#: per-slot client drift/cosine, mask churn/agreement). v1 streams
-#: (PR-4-era run dirs) carry none of them and still read/analyze
-#: cleanly — every reader treats the keys as optional.
-OBS_SCHEMA_VERSION = 2
+#: per-slot client drift/cosine, mask churn/agreement). v3 adds the
+#: communication-telemetry keys (``comm_*`` — obs/comm.py: modeled
+#: wire bytes per agg_impl and per leaf group, live mask density, the
+#: probed agg time/share). Older streams carry none of them and still
+#: read/analyze cleanly — every reader treats the keys as optional.
+OBS_SCHEMA_VERSION = 3
 
 #: every schema this module's readers (and obs/analyze.py) accept
-SUPPORTED_OBS_SCHEMAS = (1, 2)
+SUPPORTED_OBS_SCHEMAS = (1, 2, 3)
 
 
 def record_schema(record: Dict[str, Any]) -> int:
-    """The LOWEST schema a record actually requires: v2 only when it
-    carries the numerics keys. A numerics-free line is stamped 1 so
-    PR-4-era analyzers (which refuse schemas newer than they
+    """The LOWEST schema a record actually requires: v3 only when it
+    carries comm keys, v2 when (only) numerics keys. A plain line is
+    stamped 1 so older analyzers (which refuse schemas newer than they
     understand) keep reading the streams they can read perfectly —
-    the v2 keys are purely additive."""
-    return (OBS_SCHEMA_VERSION
-            if any(k.startswith("num_") for k in record) else 1)
+    the v2/v3 keys are purely additive."""
+    if any(k.startswith("comm_") for k in record):
+        return 3
+    return 2 if any(k.startswith("num_") for k in record) else 1
 
 
 def _process_index() -> int:
@@ -245,10 +248,30 @@ class ObsSession:
 
     def __init__(self, jsonl_path: str = "", trace_dir: str = "",
                  identity: str = "run", sample_every: int = 1,
-                 tb_dir: str = ""):
+                 tb_dir: str = "", comm: bool = False):
         self.identity = identity
         self.registry = obs_metrics.MetricsRegistry()
         self.registry.gauge("obs_schema_version").set(OBS_SCHEMA_VERSION)
+        # comm telemetry (--obs_comm): the wire-cost model's static
+        # round metrics (set_comm_metrics) joined onto every JSONL
+        # line, plus a Message serialized-size hook feeding the
+        # measured-bytes counters — installed only for the session's
+        # lifetime so obs-off (and comm-off) runs never touch the
+        # message hot path
+        self._comm_metrics: Optional[Dict[str, Any]] = None
+        self._msg_hook = None
+        if comm:
+            from ..comm import message as comm_message
+
+            def _on_msg_bytes(msg_type: str, nbytes: int,
+                              _reg=self.registry) -> None:
+                _reg.counter("comm_msg_bytes_total").inc(float(nbytes))
+                _reg.counter("comm_msgs_total").inc()
+                d = _reg.distribution("comm_msg_bytes")
+                d.observe(float(nbytes))
+                d.labels(type=msg_type).observe(float(nbytes))
+
+            self._msg_hook = comm_message.add_nbytes_hook(_on_msg_bytes)
         self.tracer = obs_trace.Tracer()
         self._prev_tracer = obs_trace.get_tracer()
         obs_trace.set_tracer(self.tracer)
@@ -268,6 +291,18 @@ class ObsSession:
         self.metrics_json_path: Optional[str] = None
         self.trace_path: Optional[str] = None
         self._closed = False
+
+    # -- comm telemetry --------------------------------------------------
+    def set_comm_metrics(self, metrics: Dict[str, Any]) -> None:
+        """Install the wire-cost model's static ``comm_*`` round
+        metrics (obs/comm.py ``WireCostModel.round_metrics()``, plus
+        the runner's ``comm_agg_ms`` probe). They join every exported
+        round line — static per run, so the per-round cost is zero —
+        and land as registry gauges for the metrics.json view."""
+        self._comm_metrics = dict(metrics)
+        for k, v in self._comm_metrics.items():
+            if isinstance(v, (int, float)):
+                self.registry.gauge(k).set(float(v))
 
     # -- per-round hook --------------------------------------------------
     def record_round(self, record: Dict[str, Any],
@@ -297,13 +332,27 @@ class ObsSession:
             mem_sample = self.memory.maybe_sample(r)
         if self.writer is not None:
             out = dict(record)
-            out["obs_schema"] = record_schema(record)
             if mem_sample:
                 # per-round memory series: what obs/analyze.py's leak
                 # detector trends over (gauges are last-value-wins)
                 out.update(mem_sample)
             for k, v in (extra or {}).items():
                 out[k] = _json_safe_value(v)
+            if self._comm_metrics is not None and isinstance(r, int) \
+                    and r >= 0:
+                # comm telemetry: the static wire-model metrics join
+                # every round line, and the probed agg time turns the
+                # line's own wall time into a per-round agg share
+                out.update(self._comm_metrics)
+                agg_ms = self._comm_metrics.get("comm_agg_ms")
+                rt = record.get("round_time_s")
+                if isinstance(agg_ms, (int, float)) and \
+                        isinstance(rt, (int, float)) and rt > 0:
+                    share = agg_ms / 1e3 / rt
+                    out["comm_agg_share"] = share
+                    reg.distribution("comm_agg_share").observe(share)
+            # stamp from the ENRICHED line: comm keys promote it to v3
+            out["obs_schema"] = record_schema(out)
             self.writer.write(out)
         if self._tb is not None and isinstance(r, int):
             for k, v in record.items():
@@ -341,6 +390,11 @@ class ObsSession:
         self._closed = True
         obs_trace.set_tracer(self._prev_tracer)
         self.compile_watch.uninstall()
+        if self._msg_hook is not None:
+            from ..comm import message as comm_message
+
+            comm_message.remove_nbytes_hook(self._msg_hook)
+            self._msg_hook = None
         if self.writer is not None:
             self.writer.close()
         if self._tb is not None:

@@ -24,14 +24,17 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import time
 from typing import Any, Dict, List, Optional
 
 __all__ = [
     "EXIT_NO_HISTORY", "EXIT_OK", "EXIT_REGRESSION",
-    "append_history", "backfill_bench_files", "detect_regression",
-    "gate", "git_sha", "last_json_result", "read_history",
+    "METRIC_GATE_DEFAULTS", "MULTICHIP_METRICS", "append_history",
+    "backfill_bench_files", "backfill_multichip_files",
+    "detect_regression", "gate", "git_sha", "last_json_result",
+    "metric_gate_defaults", "parse_multichip_artifact", "read_history",
 ]
 
 EXIT_OK = 0
@@ -172,6 +175,103 @@ def backfill_bench_files(repo_root: str, history_path: str) -> int:
                        git_sha="")
         existing.add(key)
         appended += 1
+    return appended
+
+
+#: the scale-32 line a MULTICHIP_r*.json dry-run tail prints when the
+#: probe ran: "... scale32: 32 clients on 8 devices, round 1819.6 ms,
+#: train-only 803.9 ms, aggregation share 55.8%"
+_SCALE32_RE = re.compile(
+    r"scale32:.*?round ([0-9.]+) ms.*?aggregation share ([0-9.]+)%")
+
+#: comm SLO metrics seeded from the committed MULTICHIP artifacts
+MULTICHIP_METRICS = ("scale32_round_ms", "scale32_agg_ms",
+                     "scale32_agg_share")
+
+#: per-metric gate defaults. The comm SLO metrics are lower-is-better,
+#: and their committed history is three points with one known slow-host
+#: outlier (MULTICHIP_r04: round 3513 ms vs ~1.9 s on r03/r05), so a
+#: MAD-derived band would be blown open by it — the comm gate uses a
+#: pure 15% relative band on the median (mad_k=0) instead: wide enough
+#: for the r03-vs-r05 run-to-run spread (~14%), tight enough that a
+#: +20% agg_ms / +10pp agg_share regression fails. The ``agg_ms_``
+#: prefix covers the scripts/bench_agg.py microbench metrics (same
+#: lower-is-better orientation, default band).
+METRIC_GATE_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    m: {"higher_is_better": False, "rel_threshold": 0.15, "mad_k": 0.0}
+    for m in MULTICHIP_METRICS
+}
+
+
+def metric_gate_defaults(metric: str) -> Dict[str, Any]:
+    """Gate parameter defaults for ``metric`` (empty dict = the generic
+    higher-is-better bench defaults). scripts/perf_gate.py consults
+    this for every flag the caller did not set explicitly."""
+    if metric in METRIC_GATE_DEFAULTS:
+        return dict(METRIC_GATE_DEFAULTS[metric])
+    if metric.startswith("agg_ms_"):
+        return {"higher_is_better": False}
+    return {}
+
+
+def parse_multichip_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """One committed ``MULTICHIP_r*.json`` driver artifact -> the comm
+    SLO metric values its scale-32 probe line holds (None when the run
+    failed, was skipped, or predates the probe — r01/r02). ``agg_ms``
+    is derived as ``round_ms * share``: the two printed quantities the
+    probe measures."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("rc") not in (0, None) or doc.get("skipped"):
+        return None
+    m = _SCALE32_RE.search(str(doc.get("tail", "")))
+    if m is None:
+        return None
+    round_ms = float(m.group(1))
+    share_pct = float(m.group(2))
+    out: Dict[str, Any] = {
+        "scale32_round_ms": round_ms,
+        "scale32_agg_share": share_pct,
+        "scale32_agg_ms": round_ms * share_pct / 100.0,
+    }
+    rm = re.search(r"r(\d+)", os.path.basename(path))
+    if rm:
+        out["bench_round"] = int(rm.group(1))
+    return out
+
+
+def backfill_multichip_files(repo_root: str, history_path: str) -> int:
+    """One-shot seed of the comm SLO history from the repo's committed
+    ``MULTICHIP_r*.json`` artifacts — the baseline scripts/perf_gate.py
+    gates ``agg_ms`` / ``agg_share`` against (ROADMAP Open item 3's
+    regression floor). Idempotent via (metric, bench_round); git_sha is
+    blank like the bench backfill — seeded values were not measured at
+    the current checkout, so the own-commit exclusion must never drop
+    them. Returns entries appended."""
+    import glob
+
+    existing = {(e.get("metric"), e.get("bench_round"))
+                for e in read_history(history_path)
+                if e.get("bench_round") is not None}
+    appended = 0
+    for path in sorted(glob.glob(os.path.join(repo_root,
+                                              "MULTICHIP_r*.json"))):
+        parsed = parse_multichip_artifact(path)
+        if parsed is None:
+            continue
+        rnd = parsed.pop("bench_round", None)
+        for metric in MULTICHIP_METRICS:
+            key = (metric, rnd)
+            if key in existing or metric not in parsed:
+                continue
+            append_history(
+                history_path,
+                {"metric": metric, "value": parsed[metric],
+                 "unit": "pct" if metric.endswith("share") else "ms"},
+                source=os.path.basename(path), repo_root=repo_root,
+                bench_round=rnd, git_sha="")
+            existing.add(key)
+            appended += 1
     return appended
 
 

@@ -525,6 +525,37 @@ def masked_weighted_mean(stacked: Any, weights: jax.Array,
 # micro-bench
 # ---------------------------------------------------------------------------
 
+def time_weighted_agg(agg_fn, stacked: Any, weights: jax.Array,
+                      out_template: Any, iters: int = 8) -> float:
+    """Wall-clock seconds per aggregation of ``agg_fn(stacked,
+    weights, i)`` — THE timing harness for aggregation paths (shared
+    by :func:`agg_microbench` and obs/comm.py's ``probe_agg_ms``, so
+    probed and benched numbers stay methodology-comparable): an
+    in-graph ``fori_loop`` over ``iters`` calls with ``jnp.roll``-ed
+    weights so XLA cannot hoist the contraction, accumulated into an
+    ``out_template``-shaped f32 tree, timed after one compile+warmup
+    run (a scalar fetch forces completion — block_until_ready can
+    return early on the tunneled platform)."""
+
+    @jax.jit
+    def run(st, wv):
+        def body(i, acc):
+            out = agg_fn(st, jnp.roll(wv, i), i)
+            return jax.tree_util.tree_map(
+                lambda a, o: a + o.astype(a.dtype), acc, out)
+
+        acc0 = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), out_template)
+        return jax.lax.fori_loop(0, iters, body, acc0)
+
+    out = run(stacked, weights)  # compile + warmup
+    float(jax.tree_util.tree_leaves(out)[0].sum())
+    t0 = time.perf_counter()
+    out = run(stacked, weights)
+    float(jax.tree_util.tree_leaves(out)[0].sum())
+    return (time.perf_counter() - t0) / iters
+
+
 def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
                    dense_ratio: float = 0.5,
                    bucket_size: int = DEFAULT_BUCKET_SIZE,
@@ -592,23 +623,7 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
     }
 
     def time_agg(agg_fn):
-        @jax.jit
-        def run(st, wv):
-            def body(i, acc):
-                out = agg_fn(st, jnp.roll(wv, i), i)
-                return jax.tree_util.tree_map(
-                    lambda a, o: a + o.astype(a.dtype), acc, out)
-
-            acc0 = jax.tree_util.tree_map(
-                lambda l: jnp.zeros(l.shape, jnp.float32), shapes)
-            return jax.lax.fori_loop(0, iters, body, acc0)
-
-        out = run(stacked, w)  # compile + warmup
-        float(jax.tree_util.tree_leaves(out)[0].sum())
-        t0 = time.perf_counter()
-        out = run(stacked, w)
-        float(jax.tree_util.tree_leaves(out)[0].sum())
-        return (time.perf_counter() - t0) / iters
+        return time_weighted_agg(agg_fn, stacked, w, shapes, iters)
 
     # timings flow through the PROCESS-GLOBAL obs registry (labeled by
     # impl) and the bench dict is read back from it — the bench/tooling
@@ -620,7 +635,11 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
     result = {}
     for name in impls:
         if name not in agg_fns:
-            continue
+            # a typo'd --impls must fail loudly, not print a timing-less
+            # JSON line that appends nothing to the gated history
+            raise ValueError(
+                f"unknown agg impl {name!r}; choose from "
+                f"{tuple(agg_fns)}")
         agg_dist.labels(impl=name).observe(time_agg(agg_fns[name]) * 1e3)
         result[f"agg_ms_{name}"] = agg_dist.labels(impl=name).last
     result.update(

@@ -34,6 +34,14 @@ def main(argv=None) -> dict:
     p.add_argument("--model", type=str, default="3dcnn",
                    help="param-tree source model (3dcnn = the 2.57M-param "
                         "flagship; small3dcnn for a quick smoke)")
+    p.add_argument("--impls", type=str, default="",
+                   help="comma-separated agg_impl subset to time "
+                        "(default: all)")
+    p.add_argument("--history", type=str, default="",
+                   help="bench-history JSONL the per-impl timings append "
+                        "to (default: results/bench_history.jsonl — the "
+                        "same trajectory scripts/perf_gate.py gates); "
+                        "'none' disables the append")
     args = p.parse_args(argv)
 
     # default to a virtual CPU mesh (the dryrun convention) unless the
@@ -59,20 +67,63 @@ def main(argv=None) -> dict:
         make_mesh,
     )
 
+    from neuroimagedisttraining_tpu.parallel.collectives import AGG_IMPLS
+
     n_dev = fit_client_devices(args.clients, min(args.devices,
                                                  len(jax.devices())))
     mesh = make_mesh(n_dev) if n_dev > 1 else None
     sample_shape = (8, 8, 8, 1) if args.model == "small3dcnn" \
         else (121, 145, 121, 1)
+    impls = tuple(i for i in args.impls.split(",") if i) or AGG_IMPLS
     out = agg_microbench(
         mesh, n_clients=args.clients, iters=args.iters,
         dense_ratio=args.dense_ratio,
         bucket_size=args.bucket_size or DEFAULT_BUCKET_SIZE,
-        model_key=args.model, sample_shape=sample_shape)
+        model_key=args.model, sample_shape=sample_shape, impls=impls)
     out = {k: (round(v, 3) if isinstance(v, float) else v)
            for k, v in out.items()}
     print(json.dumps(out))
+    _append_history(out, args.history)
     return out
+
+
+def _append_history(out: dict, history: str) -> int:
+    """Append every ``agg_ms_<impl>`` timing to the bench-history
+    trajectory (the same path as bench.py's ``_emit_result``), one
+    entry per impl under a workload-qualified metric name, so
+    ``scripts/perf_gate.py`` can gate agg-microbench regressions
+    (lower-is-better — obs.regress.metric_gate_defaults resolves the
+    orientation from the ``agg_ms_`` prefix). Best-effort like the
+    bench: a read-only checkout must never fail the microbench."""
+    if history == "none":
+        return 0
+    appended = 0
+    try:
+        from neuroimagedisttraining_tpu.obs import regress
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = history or os.path.join(root, "results",
+                                       "bench_history.jsonl")
+        tag = (f"{out['model_key']}_c{out['n_clients']}"
+               f"_d{out['n_devices']}")
+        extra = {k: out[k] for k in ("n_params", "bucket_size",
+                                     "sparse_density", "iters")
+                 if k in out}
+        for key, value in out.items():
+            if not key.startswith("agg_ms_"):
+                continue
+            impl = key[len("agg_ms_"):]
+            regress.append_history(
+                path, {"metric": f"agg_ms_{impl}_{tag}",
+                       "value": value, "unit": "ms", "extra": extra},
+                source="bench_agg", repo_root=root)
+            appended += 1
+    except Exception as e:  # pragma: no cover - disk/permissions
+        # stderr, NOT stdout: the one-JSON-line stdout contract feeds
+        # `bench_agg.py | tail -1 | perf_gate.py --from-json -`
+        print(f"# bench_agg history append skipped: {e}",
+              file=sys.stderr, flush=True)
+    return appended
 
 
 if __name__ == "__main__":

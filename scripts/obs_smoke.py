@@ -21,7 +21,12 @@ asserts the obs acceptance contract:
   5. the NUMERICS leg (--obs_numerics, obs/numerics.py): the in-jit
      telemetry run is ALSO bit-identical to obs-off, its JSONL carries
      the num_* keys, the analyzer's numerics section reads them, and
-     its per-round overhead vs obs-off stays within the same budget.
+     its per-round overhead vs obs-off stays within the same budget,
+  6. the COMM leg (--obs_comm, obs/comm.py): the wire-cost telemetry
+     run is bit-identical to obs-off, every round line carries the
+     comm_bytes_* / comm_agg_* keys (stamped obs-schema v3), the
+     analyzer emits a schema-v3 comm section with the what-if table,
+     and the same per-round overhead budget holds.
 
     python scripts/obs_smoke.py                     # CI gate
     python scripts/obs_smoke.py --clients 8 --rounds 8
@@ -245,13 +250,63 @@ def main(argv=None) -> dict:
             f"(off {off_s * 1e3:.1f} ms, numerics "
             f"{num_s * 1e3:.1f} ms)")
 
+    # 5. comm leg: obs + wire-cost telemetry. Bit-identity vs obs-off
+    # (the model and probe are pure readouts), comm_* keys on every
+    # round line with the obs-schema v3 stamp, analyzer comm section
+    # present with the what-if table, same overhead budget.
+    comm_s, out_comm = per_round(obs_flags + ["--obs_comm", "1"],
+                                 "comm")
+    comm_overhead_pct = 100.0 * (comm_s - off_s) / max(off_s, 1e-9)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(out_off["state"].global_params),
+            jax.tree_util.tree_leaves(out_comm["state"].global_params)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise SystemExit(
+                "obs_comm run is not bit-identical to obs-off")
+    comm_dir = os.path.join(tmp, f"comm_2n{args.repeats - 1}")
+    comm_jsonl = os.path.join(comm_dir, "results", "synthetic",
+                              out_comm["identity"] + ".obs.jsonl")
+    comm_recs = [r for r in read_jsonl(comm_jsonl)
+                 if isinstance(r.get("round"), int) and r["round"] >= 0]
+    for r in comm_recs:
+        if "comm_bytes_wire" not in r or "comm_bytes_dense" not in r \
+                or not any(k.startswith("comm_bytes_group/")
+                           for k in r) \
+                or "comm_agg_share" not in r:
+            raise SystemExit(
+                f"comm JSONL record missing comm_* keys: {sorted(r)}")
+        if r.get("obs_schema") != 3:
+            raise SystemExit(
+                f"comm record not stamped obs-schema v3: {r['obs_schema']}")
+    comm_analyses = obs_analyze.analyze_run_dir(
+        os.path.join(comm_dir, "results", "synthetic"),
+        trace_dir=trace_dir)
+    if len(comm_analyses) != 1 or \
+            not comm_analyses[0]["comm"]["present"]:
+        raise SystemExit("analyzer found no comm section in the "
+                         "obs_comm run")
+    if comm_analyses[0]["schema_version"] < 3:
+        raise SystemExit(
+            f"comm analysis not schema v3: "
+            f"{comm_analyses[0]['schema_version']}")
+    if not comm_analyses[0]["comm"]["what_if"]:
+        raise SystemExit("comm analysis has an empty what-if table")
+    if comm_overhead_pct > args.max_overhead_pct:
+        raise SystemExit(
+            f"obs_comm per-round overhead {comm_overhead_pct:.2f}% "
+            f"exceeds the {args.max_overhead_pct:g}% budget "
+            f"(off {off_s * 1e3:.1f} ms, comm {comm_s * 1e3:.1f} ms)")
+
     result = {
         "obs_ok": True, "clients": args.clients, "rounds": args.rounds,
         "model": args.model,
         "round_s_obs_off": off_s, "round_s_obs_on": on_s,
-        "round_s_obs_numerics": num_s,
+        "round_s_obs_numerics": num_s, "round_s_obs_comm": comm_s,
         "obs_overhead_pct": round(overhead_pct, 2),
         "numerics_overhead_pct": round(num_overhead_pct, 2),
+        "comm_overhead_pct": round(comm_overhead_pct, 2),
+        "comm_wire_mb": round(
+            comm_recs[-1]["comm_bytes_wire"] / 1e6, 4),
         "bit_identical": True, **art,
     }
     print(json.dumps(result))

@@ -9,7 +9,8 @@ noise band) and exits
   2  not enough history to judge (bootstrap; pipelines may soft-pass)
 
 Usage:
-    # seed the history once from the committed BENCH_r*.json artifacts
+    # seed the history once from the committed BENCH_r*.json AND
+    # MULTICHIP_r*.json artifacts (the comm SLO baseline)
     python scripts/perf_gate.py --backfill
 
     # gate an explicit value
@@ -18,6 +19,12 @@ Usage:
 
     # gate a bench JSON line (file, or - for stdin):
     python bench.py | tail -1 | python scripts/perf_gate.py --from-json -
+
+    # comm SLO gates (seeded from MULTICHIP_r01..r05): lower-is-better
+    # and the comm band defaults resolve from the metric name, so the
+    # bare value is enough
+    python scripts/perf_gate.py --metric scale32_agg_ms --value 1015.3
+    python scripts/perf_gate.py --metric scale32_agg_share --value 55.8
 
     # record the gated value into the history after it passes
     python scripts/perf_gate.py --from-json out.json --append
@@ -52,14 +59,20 @@ def main(argv=None) -> int:
     p.add_argument("--from-json", default="",
                    help="bench JSON result to gate: a file path, or - "
                         "for stdin (reads the last JSON line)")
-    p.add_argument("--rel-threshold", type=float,
-                   default=regress.DEFAULT_REL_THRESHOLD)
-    p.add_argument("--mad-k", type=float, default=regress.DEFAULT_MAD_K)
+    p.add_argument("--rel-threshold", type=float, default=None,
+                   help="relative band (default: the metric's entry in "
+                        "obs.regress.METRIC_GATE_DEFAULTS, else "
+                        f"{regress.DEFAULT_REL_THRESHOLD})")
+    p.add_argument("--mad-k", type=float, default=None,
+                   help="MAD band multiplier (default: per-metric, else "
+                        f"{regress.DEFAULT_MAD_K})")
     p.add_argument("--window", type=int, default=regress.DEFAULT_WINDOW)
     p.add_argument("--lower-is-better", action="store_true",
-                   help="metric regresses UPWARD (e.g. ms/aggregation)")
+                   help="metric regresses UPWARD (e.g. ms/aggregation; "
+                        "auto for the comm SLO / agg_ms_* metrics)")
     p.add_argument("--backfill", action="store_true",
-                   help="seed the history from BENCH_r*.json and exit")
+                   help="seed the history from BENCH_r*.json + "
+                        "MULTICHIP_r*.json and exit")
     p.add_argument("--append", action="store_true",
                    help="append the gated value to the history when the "
                         "verdict is pass/no-history")
@@ -67,8 +80,10 @@ def main(argv=None) -> int:
 
     if args.backfill:
         n = regress.backfill_bench_files(REPO_ROOT, args.history)
+        nm = regress.backfill_multichip_files(REPO_ROOT, args.history)
         total = len(regress.read_history(args.history))
-        print(json.dumps({"backfilled": n, "history_points": total,
+        print(json.dumps({"backfilled": n, "backfilled_multichip": nm,
+                          "history_points": total,
                           "history": args.history}))
         return regress.EXIT_OK
 
@@ -95,14 +110,26 @@ def main(argv=None) -> int:
             os.path.abspath(args.history) == \
             os.path.abspath(DEFAULT_HISTORY):
         regress.backfill_bench_files(REPO_ROOT, args.history)
+        regress.backfill_multichip_files(REPO_ROOT, args.history)
+
+    # per-metric gate defaults (obs/regress.py): the comm SLO metrics
+    # are lower-is-better with a pure relative band; explicit flags win
+    defaults = regress.metric_gate_defaults(metric)
+    rel = (args.rel_threshold if args.rel_threshold is not None
+           else defaults.get("rel_threshold",
+                             regress.DEFAULT_REL_THRESHOLD))
+    mad_k = (args.mad_k if args.mad_k is not None
+             else defaults.get("mad_k", regress.DEFAULT_MAD_K))
+    higher = (not args.lower_is_better
+              and defaults.get("higher_is_better", True))
 
     sha = regress.git_sha(REPO_ROOT)
     try:
         verdict = regress.gate(
             args.history, metric, value,
-            rel_threshold=args.rel_threshold,
-            mad_k=args.mad_k, window=args.window,
-            higher_is_better=not args.lower_is_better,
+            rel_threshold=rel,
+            mad_k=mad_k, window=args.window,
+            higher_is_better=higher,
             exclude_git_sha=sha)  # never judge a commit against itself
     except ValueError as e:
         # a truncated/corrupted history line must read as "no usable

@@ -32,6 +32,10 @@ CONTRACT_PATHS = [
     "obs/compile.py",
     "obs/numerics.py",
     "obs/recorder.py",
+    "obs/comm.py",
+    "obs/devtrace.py",
+    "comm/message.py",
+    "comm/base.py",
     "utils/checkpoint.py",
     "utils/records.py",
     "utils/flops.py",

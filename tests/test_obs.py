@@ -370,7 +370,12 @@ def test_jsonl_fault_recovery_fields_and_fused(tmp_path):
     ) + ["--obs", "1", "--fuse_rounds", "2"], algo="fedavg"), "fedavg")
     jsonl_f = os.path.join(str(tmp_path / "fused"), "results", "synthetic",
                            out_f["identity"] + ".obs.jsonl")
-    assert [r["round"] for r in export.read_jsonl(jsonl_f)] == [0, 1, 2, 3]
+    recs_f = export.read_jsonl(jsonl_f)
+    assert [r["round"] for r in recs_f] == [0, 1, 2, 3]
+    # with obs on, the runner's fused loop stamps round_time_s at flush
+    # boundaries (block wall split evenly) like the unfused
+    # DeferredRecords(timed=obs) rule — the comm_agg_share stamp needs it
+    assert all(r.get("round_time_s", 0) > 0 for r in recs_f)
 
 
 def test_collectives_agg_timings_flow_through_registry():
