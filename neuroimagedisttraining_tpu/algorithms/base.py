@@ -125,6 +125,11 @@ class FedAlgorithm(abc.ABC):
         augment="auto",
         agg_impl: str = "dense",
         agg_bucket_size: int = 0,
+        agg_topk_density: float = 0.1,
+        agg_topk_sample: int = 0,
+        agg_hier_wire: str = "bf16",
+        agg_hier_inner: int = 0,
+        agg_overlap: bool = True,
         fault_spec: str = "",
         guard: Optional[bool] = None,
         obs_numerics: bool = False,
@@ -162,6 +167,52 @@ class FedAlgorithm(abc.ABC):
             raise ValueError(f"agg_impl {agg_impl!r} not in {AGG_IMPLS}")
         self.agg_impl = agg_impl
         self.agg_bucket_size = agg_bucket_size or DEFAULT_BUCKET_SIZE
+        # topk: error-feedback top-k sparsification — the residual is
+        # ALGORITHM STATE (State.agg_residual, checkpointed), so only
+        # algorithms that declare topk_supported (and thread the
+        # residual through their round bodies) may select it
+        from ..parallel.collectives import topk_count
+
+        # validated on EVERY impl, not just topk: the --obs_comm what-if
+        # table prices the topk wire at this density on every run, so an
+        # out-of-range value must fail here, not mid-run in WireCostModel
+        topk_count(1, agg_topk_density)
+        if agg_impl == "topk":
+            if not self.topk_supported:
+                raise ValueError(
+                    f"{self.name}: agg_impl='topk' carries an error-"
+                    "feedback residual in algorithm state; only the "
+                    "central-aggregate algorithms that thread it "
+                    "(fedavg/salientgrads) support it")
+        self.agg_topk_density = agg_topk_density
+        # 0 = exact per-group top-k; N = deterministic strided-subsample
+        # threshold estimate (~N candidates/group — the DGC sampling
+        # trick; EF absorbs the approximate shipped count)
+        self.agg_topk_sample = int(agg_topk_sample)
+        # hier: two-stage reduce — full-precision psum inside each
+        # agg_hier_inner-device slice, agg_hier_wire across slices
+        # (0 = auto slice split; 'sparse' wire = compressed-plan f32,
+        # static-mask algorithms only)
+        from ..parallel.collectives import HIER_WIRES
+
+        if agg_hier_wire not in HIER_WIRES:
+            raise ValueError(
+                f"agg_hier_wire {agg_hier_wire!r} not in {HIER_WIRES}")
+        self.agg_hier_wire = agg_hier_wire
+        if int(agg_hier_inner) < 0:
+            # the collectives layer uses -1 internally as the auto-split
+            # sentinel; from config, 0 IS auto — a negative here is a
+            # typo that would otherwise silently run the auto split
+            # while run_identity records the never-applied request
+            raise ValueError(
+                f"agg_hier_inner {agg_hier_inner} must be >= 0 "
+                "(0 = balanced auto split)")
+        self.agg_hier_inner = int(agg_hier_inner)
+        # overlap: group-ordered dispatch — each leaf-group bucket's
+        # collective is emitted right after its own local contraction
+        # (bit-identical math; scheduling freedom only, so it never
+        # enters run identity)
+        self.agg_overlap = bool(agg_overlap)
         self._agg_sparse_plan = None   # set by static-mask subclasses
         self._agg_mesh_known = False   # lazily discovered from the data
         self._agg_mesh_val = None
@@ -347,6 +398,14 @@ class FedAlgorithm(abc.ABC):
     #: cross-client agreement) — static-mask algorithms (SalientGrads)
     numerics_with_mask: bool = False
 
+    #: whether this algorithm's State carries the error-feedback
+    #: residual (``agg_residual``) and its round body threads it through
+    #: ``_train_selected_weighted`` — the ``agg_impl='topk'`` support
+    #: surface (FedAvg/SalientGrads). The residual is real state: it is
+    #: checkpointed, and a topk lineage is NOT interchangeable with
+    #: other impls' checkpoints (run_identity splits it).
+    topk_supported: bool = False
+
     def cost_trained_clients_per_round(self) -> int:
         """Client training passes one round actually runs (cost accounting).
         Default: the sampled subset. Decentralized/personalized algorithms
@@ -427,6 +486,15 @@ class FedAlgorithm(abc.ABC):
             self._agg_mesh_known = True
         return self._agg_mesh_val
 
+    def _require_plan(self, what: str):
+        if self._agg_sparse_plan is None:
+            raise ValueError(
+                f"{self.name}: {what} needs a static-mask gather plan "
+                "(_agg_sparse_plan) built from the concrete mask before "
+                "the round traces — only fixed-mask algorithms "
+                "(SalientGrads) support it")
+        return self._agg_sparse_plan
+
     def _aggregate(self, stacked, weights, rng=None):
         """The central weighted mean over the stacked client axis, routed
         by ``agg_impl`` (parallel/collectives.py). ``dense`` is bit-for-
@@ -434,7 +502,12 @@ class FedAlgorithm(abc.ABC):
         association (and, for bf16/int8, wire precision — f32 master
         weights and accumulation always) for smaller / pipelined
         cross-chip transfers. Robust defenses already transformed
-        ``stacked`` before this point, so they compose with every impl."""
+        ``stacked`` before this point, so they compose with every impl.
+
+        ``topk`` here is the WIRE KERNEL only — top-k selection + reduce
+        of whatever ``stacked`` holds (probes and benches time this
+        path); the round body's :meth:`_topk_aggregate` owns the
+        delta/residual bookkeeping around it."""
         with jax.named_scope("aggregate"):
             if self.agg_impl == "dense":
                 from ..core.state import weighted_tree_sum
@@ -443,17 +516,28 @@ class FedAlgorithm(abc.ABC):
             from ..parallel import collectives
 
             kw = dict(mesh=self._agg_mesh(),
-                      bucket_size=self.agg_bucket_size, rng=rng)
+                      bucket_size=self.agg_bucket_size,
+                      overlap=self.agg_overlap)
+            if self.agg_impl == "topk":
+                return collectives.topk_weighted_mean(
+                    stacked, weights, self.agg_topk_density,
+                    plan=self._agg_sparse_plan,
+                    sample=self.agg_topk_sample, **kw)[0]
+            if self.agg_impl == "hier":
+                if self.agg_hier_wire == "sparse":
+                    return collectives.sparse_weighted_mean(
+                        stacked, weights,
+                        self._require_plan("agg_hier_wire='sparse'"),
+                        wire="f32", hier_inner=self.agg_hier_inner or -1,
+                        **kw)
+                return collectives.weighted_mean(
+                    stacked, weights, wire=self.agg_hier_wire,
+                    hier_inner=self.agg_hier_inner or -1, rng=rng, **kw)
+            kw["rng"] = rng
             if self.agg_impl == "sparse":
-                if self._agg_sparse_plan is None:
-                    raise ValueError(
-                        f"{self.name}: agg_impl='sparse' needs a "
-                        "static-mask gather plan (_agg_sparse_plan) built "
-                        "from the concrete mask before the round traces — "
-                        "only fixed-mask algorithms (SalientGrads) "
-                        "support it")
                 return collectives.sparse_weighted_mean(
-                    stacked, weights, self._agg_sparse_plan, **kw)
+                    stacked, weights,
+                    self._require_plan("agg_impl='sparse'"), **kw)
             wire = {"bucketed": "f32", "bf16": "bf16", "int8": "int8"}[
                 self.agg_impl]
             return collectives.weighted_mean(
@@ -559,13 +643,15 @@ class FedAlgorithm(abc.ABC):
     def _train_selected_weighted(
         self, client_update, global_params, mask, sel_idx, round_idx,
         round_key, x_train, y_train, n_train, defense=None,
+        residual=None,
     ):
         """Shared round body for global-model algorithms (FedAvg,
         SalientGrads): gather the selected clients' shards, broadcast the
         global model (and mask) along the client axis, run vmapped local
         SGD, optionally apply a robust-aggregation defense to the local
         models, and return the sample-weighted average, the (pre-defense)
-        local models, the mean loss, and the fault/guard stats
+        local models, the mean loss, the fault/guard stats, and the
+        updated error-feedback residual
         (fedavg_api.py:40-117 / sailentgrads_api.py:112-147,212-227).
 
         Fault tolerance (robust/faults.py + robust/guard.py): when a
@@ -583,7 +669,13 @@ class FedAlgorithm(abc.ABC):
         The 4th return value is ``None`` when the guard is off, else a
         dict with ``ok`` ([S] survivor flags — callers use it to keep
         quarantined clients' previous personal models) and the f32
-        ``clients_dropped`` / ``clients_quarantined`` counters."""
+        ``clients_dropped`` / ``clients_quarantined`` counters.
+
+        ``residual`` is the [C, ...] error-feedback residual stack
+        (``agg_impl='topk'`` only — required there, ignored-and-returned
+        otherwise): the 5th return value is the updated stack. The topk
+        aggregate runs on compensated deltas and composes with the guard
+        by construction — see :meth:`_topk_aggregate`."""
         from ..core.state import broadcast_tree, zeros_like_tree
 
         if self.clients_per_round == self.num_clients:
@@ -626,11 +718,15 @@ class FedAlgorithm(abc.ABC):
         weights = n_sel.astype(jnp.float32)
         weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
         agg_rng = None
-        if self.agg_impl == "int8":  # stochastic-rounding draw; folded off
-            # round_key so the client/defense key consumption (and hence
-            # the default path's numerics) is untouched
+        if self.agg_impl == "int8" or (
+                self.agg_impl == "hier"
+                and self.agg_hier_wire == "int8"):
+            # stochastic-rounding draw; folded off round_key so the
+            # client/defense key consumption (and hence the default
+            # path's numerics) is untouched
             agg_rng = jax.random.fold_in(round_key, 0x616767)  # "agg"
         fstats = None
+        ok = None
         if self.guard_enabled:
             from ..robust import guard as _guard
 
@@ -649,15 +745,112 @@ class FedAlgorithm(abc.ABC):
                     n_dropped = jnp.asarray(0.0, jnp.float32)
                     n_quar = jnp.sum(
                         jnp.logical_not(finite).astype(jnp.float32))
+            fstats = {"ok": ok, "clients_dropped": n_dropped,
+                      "clients_quarantined": n_quar}
+        if self.agg_impl == "topk":
+            new_global, new_residual = self._topk_aggregate(
+                defended, global_params, residual, sel_idx, weights, ok)
+        elif self.guard_enabled:
+            from ..robust import guard as _guard
+
             new_global = _guard.guarded_aggregate(
                 defended, weights, ok,
                 lambda st, wv: self._aggregate(st, wv, agg_rng),
                 global_params)
-            fstats = {"ok": ok, "clients_dropped": n_dropped,
-                      "clients_quarantined": n_quar}
+            new_residual = residual
         else:
             new_global = self._aggregate(defended, weights, agg_rng)
-        return new_global, params_out, jnp.mean(losses), fstats
+            new_residual = residual
+        return (new_global, params_out, jnp.mean(losses), fstats,
+                new_residual)
+
+    def _topk_aggregate(self, locals_, global_params, residual, sel_idx,
+                        weights, ok):
+        """The ``agg_impl='topk'`` round aggregate with error feedback
+        (Deep Gradient Compression semantics on the federated round):
+
+        1. each selected client's delta = local − global, COMPENSATED by
+           its carried residual row;
+        2. per-leaf-group top-k selection + weighted mean of the
+           sparsified rows (``collectives.topk_weighted_mean`` — the
+           wire);
+        3. the unsent remainder (compensated − sparsified) becomes the
+           client's new residual row — nothing is dropped, only
+           deferred;
+        4. ``new_global = global + aggregate(sparsified)``.
+
+        Guard composition (``ok`` = the finite screen's survivor flags,
+        None when the guard is off): quarantined rows are select-zeroed
+        BEFORE selection and the weights renormalize over survivors —
+        the same ``lax.cond``-gated spelling as
+        ``guard.guarded_aggregate``, so a clean round runs topk on the
+        untouched inputs (bit-identical to guard-off) and never pays
+        the O(C x params) sanitize/merge; zero survivors carries the
+        previous global; and a quarantined client's residual row keeps
+        its PREVIOUS value (``guard.merge_residual`` — the poisoned
+        compensated delta must not leak into later rounds through the
+        residual)."""
+        from ..core.state import tree_index, tree_scatter_update
+        from ..parallel import collectives
+        from ..robust import guard as _guard
+
+        if residual is None:
+            raise ValueError(
+                f"{self.name}: agg_impl='topk' round body called without "
+                "the residual stack — init_state must seed "
+                "State.agg_residual (zeros_like the personal stack "
+                "layout) when agg_impl='topk'")
+        full = self.clients_per_round == self.num_clients
+        # full participation skips the identity gather (the same
+        # second-cohort-copy hazard as the data gathers above)
+        res_sel = residual if full else tree_index(residual, sel_idx)
+        comp = jax.tree_util.tree_map(
+            lambda loc, g, r: (loc - g[None]) + r,
+            locals_, global_params, res_sel)
+        if self._agg_sparse_plan is not None:
+            # static-mask composition: dead coordinates never ship (the
+            # compressed selection can't see them), so they must not
+            # enter the residual either — a select against the plan's
+            # live mask (round 0's dense init would otherwise sit in
+            # the residual forever)
+            comp = collectives.plan_dead_select(
+                comp, self._agg_sparse_plan)
+        def run_topk(comp_in, w):
+            agg_update, sp = collectives.topk_weighted_mean(
+                comp_in, w, self.agg_topk_density,
+                plan=self._agg_sparse_plan, mesh=self._agg_mesh(),
+                bucket_size=self.agg_bucket_size,
+                overlap=self.agg_overlap,
+                sample=self.agg_topk_sample)
+            new_global = jax.tree_util.tree_map(
+                lambda g, u: (g + u).astype(g.dtype), global_params,
+                agg_update)
+            new_rows = jax.tree_util.tree_map(
+                lambda c, s: c - s, comp_in, sp)
+            return new_global, new_rows
+
+        if ok is None:
+            new_global, new_rows = run_topk(comp, weights)
+        else:
+            # the guarded dense path's lax.cond spelling
+            # (guard.guarded_aggregate): the clean branch runs topk on
+            # the untouched inputs, so a clean round never pays the
+            # O(C x params) quarantine sanitize / residual merge — only
+            # the read-only finite screen that produced ``ok``
+            def bad(args):
+                c, wv = args
+                comp_in, w, survivors = _guard.quarantine(c, wv, ok)
+                ng, nr = run_topk(comp_in, w)
+                ng = _guard.carry_if_empty(ng, global_params, survivors)
+                nr = _guard.merge_residual(ok, nr, res_sel)
+                return ng, nr
+
+            new_global, new_rows = jax.lax.cond(
+                jnp.logical_not(jnp.all(ok)), bad,
+                lambda args: run_topk(*args), (comp, weights))
+        new_residual = new_rows if full else tree_scatter_update(
+            residual, sel_idx, new_rows)
+        return new_global, new_residual
 
     def _guarded_personal_update(self, personal, locals_, sel_idx, fstats):
         """Scatter the selected clients' trained models into the [C, ...]

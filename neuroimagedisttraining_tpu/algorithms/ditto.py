@@ -72,10 +72,12 @@ class Ditto(FedAlgorithm):
             # (a) global leg: standard FedAvg round (the guard, when on,
             # protects this aggregate too; Ditto does not thread the
             # quarantine counters into its metrics — guard_metrics_supported)
-            new_global, _, mean_loss, _fstats = self._train_selected_weighted(
-                self.client_update, state.global_params, state.global_params,
-                sel_idx, round_idx, k_global, x_train, y_train, n_train,
-            )
+            new_global, _, mean_loss, _fstats, _res = \
+                self._train_selected_weighted(
+                    self.client_update, state.global_params,
+                    state.global_params, sel_idx, round_idx, k_global,
+                    x_train, y_train, n_train,
+                )
             # (b) personal leg: prox-pulled toward the PRE-round global
             s = sel_idx.shape[0]
             p_sel = tree_index(state.personal_params, sel_idx)

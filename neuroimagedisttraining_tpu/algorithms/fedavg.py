@@ -43,6 +43,13 @@ class FedAvgState:
     # unless they need per-client personal models/eval.
     personal_params: Any
     rng: jax.Array
+    # [C, ...] error-feedback residual of agg_impl='topk' (the unsent
+    # remainder of each client's compensated delta — Deep Gradient
+    # Compression semantics), or None for every other impl. Real state:
+    # checkpointed with the same lineage rules as personal_params (a
+    # topk lineage is identity-split from the other impls, whose states
+    # have no residual — the r5 track_personal migration pattern).
+    agg_residual: Any = None
 
 
 class FedAvg(FedAlgorithm):
@@ -50,6 +57,7 @@ class FedAvg(FedAlgorithm):
     supports_fused = True
     guard_metrics_supported = True
     numerics_supported = True
+    topk_supported = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
                  **kwargs):
@@ -71,12 +79,13 @@ class FedAvg(FedAlgorithm):
         def round_fn(state: FedAvgState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, locals_, mean_loss, fstats = \
+            new_global, locals_, mean_loss, fstats, new_residual = \
                 self._train_selected_weighted(
                     self.client_update, state.global_params,
                     state.global_params,  # dense path: mask unused, DCE'd
                     sel_idx, round_idx, round_key, x_train, y_train,
                     n_train, defense=self.defense,
+                    residual=state.agg_residual,
                 )
             new_personal = self._guarded_personal_update(
                 state.personal_params, locals_, sel_idx, fstats)
@@ -86,7 +95,8 @@ class FedAvg(FedAlgorithm):
                 state.global_params, new_global, locals_)
             return self._round_outputs(
                 FedAvgState(global_params=new_global,
-                            personal_params=new_personal, rng=rng),
+                            personal_params=new_personal, rng=rng,
+                            agg_residual=new_residual),
                 mean_loss, fstats, nums)
 
         self._round_jit = jax.jit(round_fn)
@@ -104,7 +114,8 @@ class FedAvg(FedAlgorithm):
             )(params0, mom0, params0, keys, x_train, y_train, n_train,
               jnp.asarray(-1.0, jnp.float32), params0)
             return FedAvgState(global_params=state.global_params,
-                               personal_params=params_out, rng=rng)
+                               personal_params=params_out, rng=rng,
+                               agg_residual=state.agg_residual)
 
         self._finetune_jit = jax.jit(finetune_fn)
         self._eval_global = self._make_global_eval()
@@ -118,6 +129,11 @@ class FedAvg(FedAlgorithm):
             personal_params=(broadcast_tree(params, self.num_clients)
                              if self.track_personal else None),
             rng=s_rng,
+            # topk: zero residual per client (same [C, model] HBM
+            # footprint caveat as personal_params)
+            agg_residual=(zeros_like_tree(
+                broadcast_tree(params, self.num_clients))
+                if self.agg_impl == "topk" else None),
         )
 
     def run_round(self, state: FedAvgState, round_idx: int):

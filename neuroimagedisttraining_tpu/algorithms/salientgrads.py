@@ -51,6 +51,12 @@ class SalientGradsState:
     # weights. Same HBM caveat as FedAvgState.personal_params.
     personal_params: Any
     rng: jax.Array
+    # [C, ...] error-feedback residual of agg_impl='topk', or None for
+    # every other impl (see FedAvgState.agg_residual). Locals honor the
+    # static SNIP mask, so deltas — and inductively the residual — are
+    # exact zeros on dead coordinates: the top-k selection (compressed
+    # to the plan's live set) can never ship a dead coordinate.
+    agg_residual: Any = None
 
 
 class SalientGrads(FedAlgorithm):
@@ -59,6 +65,7 @@ class SalientGrads(FedAlgorithm):
     guard_metrics_supported = True
     numerics_supported = True
     numerics_with_mask = True
+    topk_supported = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
@@ -161,15 +168,19 @@ class SalientGrads(FedAlgorithm):
         def round_fn(state: SalientGradsState, sel_idx, round_idx,
                      x_train, y_train, n_train):
             rng, round_key = jax.random.split(state.rng)
-            new_global, locals_, mean_loss, fstats = \
+            new_global, locals_, mean_loss, fstats, new_residual = \
                 self._train_selected_weighted(
                     self.client_update, state.global_params, state.mask,
                     sel_idx, round_idx, round_key, x_train, y_train,
                     n_train, defense=self.defense,
+                    residual=state.agg_residual,
                 )
-            if self.defense is not None:
-                # weak-DP noise lands on every leaf; re-mask so the global
-                # model keeps the SNIP sparsity invariant
+            if self.defense is not None or self.agg_impl == "topk":
+                # weak-DP noise lands on every leaf — and the topk
+                # delta update leaves round 0's dense init on dead
+                # coordinates (g + update touches only live coords);
+                # re-mask so the global model keeps the SNIP sparsity
+                # invariant either way
                 new_global = jax.tree_util.tree_map(
                     lambda p, m: p * m, new_global, state.mask)
             # w_per_mdls[cur_clnt] = the client's (pre-defense) locally
@@ -185,7 +196,8 @@ class SalientGrads(FedAlgorithm):
             return self._round_outputs(
                 SalientGradsState(global_params=new_global,
                                   mask=state.mask,
-                                  personal_params=new_personal, rng=rng),
+                                  personal_params=new_personal, rng=rng,
+                                  agg_residual=new_residual),
                 mean_loss, fstats, nums)
 
         self._round_jit = jax.jit(round_fn)
@@ -205,6 +217,8 @@ class SalientGrads(FedAlgorithm):
                     params, self.data.x_train, self.data.y_train,
                     self.data.n_train, m_rng,
                 )
+        from ..core.state import zeros_like_tree
+
         return SalientGradsState(
             global_params=params, mask=mask,
             # w_per_mdls init: dense copies of the initial global model —
@@ -212,18 +226,27 @@ class SalientGrads(FedAlgorithm):
             # (sailentgrads_api.py:107-110)
             personal_params=(broadcast_tree(params, self.num_clients)
                              if self.track_personal else None),
-            rng=s_rng)
+            rng=s_rng,
+            # topk: zero residual per client (masked by construction —
+            # deltas of mask-honoring locals are zero on dead coords)
+            agg_residual=(zeros_like_tree(
+                broadcast_tree(params, self.num_clients))
+                if self.agg_impl == "topk" else None))
 
     def _ensure_agg_plan(self, state: SalientGradsState) -> None:
         """Host-side, before the round program traces: build the
         mask-aware sparse gather plan from the CONCRETE mask. Valid for
         the whole run — the SNIP mask is fixed after init
         (``masks_evolve=False``), which is exactly why SalientGrads can
-        run ``agg_impl='sparse'``: the live-coordinate set is static per
-        round-block. With a weak-DP defense the compressed reduce also
-        drops the noise landing on dead kernel coordinates — the same
-        invariant the explicit post-aggregation re-mask enforces."""
-        if self.agg_impl == "sparse" and self._agg_sparse_plan is None:
+        run ``agg_impl='sparse'`` (and compressed-selection
+        ``'topk'`` / the ``'hier'`` sparse cross-slice wire): the
+        live-coordinate set is static per round-block. With a weak-DP
+        defense the compressed reduce also drops the noise landing on
+        dead kernel coordinates — the same invariant the explicit
+        post-aggregation re-mask enforces."""
+        needs_plan = self.agg_impl in ("sparse", "topk") or (
+            self.agg_impl == "hier" and self.agg_hier_wire == "sparse")
+        if needs_plan and self._agg_sparse_plan is None:
             from ..parallel.collectives import build_sparse_plan
 
             self._agg_sparse_plan = build_sparse_plan(state.mask)

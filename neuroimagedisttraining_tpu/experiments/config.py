@@ -209,7 +209,8 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "resumable across fused/unfused runs); "
                         "1 = unfused")
     p.add_argument("--agg_impl", type=str, default="dense",
-                   choices=["dense", "bucketed", "bf16", "int8", "sparse"],
+                   choices=["dense", "bucketed", "bf16", "int8", "sparse",
+                            "topk", "hier"],
                    help="cross-chip aggregation path for the central "
                         "weighted mean (parallel/collectives.py): dense = "
                         "the exact monolithic contraction (default); "
@@ -217,13 +218,55 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "reduces (exact off-mesh); bf16/int8 = low-"
                         "precision wire with f32 accumulation + master "
                         "weights; sparse = mask-aware reduce on the SNIP "
-                        "mask's live coordinates (salientgrads only). "
-                        "Centralized algorithms (fedavg/salientgrads/"
-                        "ditto) only")
+                        "mask's live coordinates (salientgrads only); "
+                        "topk = error-feedback top-k sparsification of "
+                        "the client deltas (--agg_topk_density; the "
+                        "residual is carried in algorithm state — "
+                        "fedavg/salientgrads only, new checkpoint "
+                        "lineage); hier = two-stage hierarchical reduce "
+                        "(full-precision psum inside each "
+                        "--agg_hier_inner-device slice, --agg_hier_wire "
+                        "across slices). Centralized algorithms (fedavg/"
+                        "salientgrads/ditto) only")
     p.add_argument("--agg_bucket_size", type=int, default=0,
                    help="aggregation bucket size in elements for the "
                         "non-dense --agg_impl paths (0 = the 256k-element "
                         "default, 1 MiB f32 per bucket on the wire)")
+    p.add_argument("--agg_topk_density", type=float, default=0.1,
+                   help="--agg_impl topk: fraction of each leaf-group's "
+                        "coordinates shipped per client per round "
+                        "(selected by magnitude within the SNIP mask's "
+                        "live set when one exists); the unshipped "
+                        "remainder accumulates in the error-feedback "
+                        "residual")
+    p.add_argument("--agg_topk_sample", type=int, default=0,
+                   help="--agg_impl topk: estimate each leaf-group's "
+                        "selection threshold from a deterministic "
+                        "strided subsample of ~this many candidates "
+                        "instead of the exact top-k (the DGC "
+                        "hierarchical-sampling trick — top_k is "
+                        "sort-bound in group size; error feedback "
+                        "absorbs the approximate shipped count). "
+                        "0 = exact selection (default)")
+    p.add_argument("--agg_hier_wire", type=str, default="bf16",
+                   choices=["f32", "bf16", "int8", "sparse"],
+                   help="--agg_impl hier: the CROSS-SLICE wire (the "
+                        "intra-slice stage is always a full-precision "
+                        "psum); sparse = compressed-plan f32 across "
+                        "slices (salientgrads only)")
+    p.add_argument("--agg_hier_inner", type=int, default=0,
+                   help="--agg_impl hier: devices per intra-slice group "
+                        "(must divide the clients mesh axis; 0 = the "
+                        "balanced auto split, e.g. 8 devices -> 2x4)")
+    p.add_argument("--agg_overlap", type=int, default=1,
+                   help="group-ordered aggregation dispatch: emit each "
+                        "leaf-group bucket's collective right after its "
+                        "own local contraction so XLA can pipeline wire "
+                        "against compute (parallel/collectives.py). "
+                        "Bit-identical math — scheduling freedom only, "
+                        "never enters run identity; 0 restores the "
+                        "contract-everything-then-reduce order for A/B "
+                        "timing")
     p.add_argument("--eval_clients", type=int, default=0,
                    help="sampled-eval mode: evaluate only this many "
                         "(seeded) clients per eval instead of the whole "
@@ -560,14 +603,38 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
                 parts.append("noaug")  # un-augmented CIFAR/tiny ablation
         if getattr(args, "eval_clients", 0):
             parts.append(f"evK{args.eval_clients}")
-        if getattr(args, "agg_impl", "dense") != "dense":
-            # bf16/int8/sparse change the aggregate's numerics (bucketed
-            # only its association on-mesh) — metric lineages must split;
-            # the checkpointed f32 state stays interchangeable, so the
-            # checkpoint identity excludes it (resumable across impls)
-            parts.append(f"agg{args.agg_impl}")
+        agg_impl = getattr(args, "agg_impl", "dense")
+        if agg_impl != "dense":
+            # bf16/int8/sparse/topk/hier change the aggregate's numerics
+            # (bucketed only its association on-mesh) — metric lineages
+            # must split; the checkpointed f32 state stays
+            # interchangeable, so the checkpoint identity excludes it
+            # (resumable across impls) — EXCEPT topk, which carries the
+            # error-feedback residual in state (split below, outside
+            # this for_checkpoint-only block)
+            parts.append(f"agg{agg_impl}")
+            if agg_impl == "hier":
+                # the cross-slice wire (and an explicit slice split)
+                # change the aggregate's numerics too
+                parts.append(f"hw{getattr(args, 'agg_hier_wire', 'bf16')}")
+                if getattr(args, "agg_hier_inner", 0):
+                    parts.append(f"hi{args.agg_hier_inner}")
         if getattr(args, "data_dtype", ""):
             parts.append(f"dt{args.data_dtype}")
+    if getattr(args, "agg_impl", "dense") == "topk":
+        # topk splits the CHECKPOINT lineage too (unlike the other
+        # impls): its states carry the error-feedback residual stack —
+        # a different state STRUCTURE (the r5 personal-stack precedent)
+        # — and the residual is trajectory (a mid-lineage density change
+        # would silently re-weight deferred updates), so the density
+        # rides both identities
+        if for_checkpoint:
+            parts.append("aggtopk")
+        parts.append(f"tk{getattr(args, 'agg_topk_density', 0.1):g}")
+        if getattr(args, "agg_topk_sample", 0):
+            # the sampled threshold changes WHICH coordinates ship —
+            # trajectory, so it splits both lineages like the density
+            parts.append(f"tks{args.agg_topk_sample}")
     if not getattr(args, "final_finetune", 1):
         parts.append("noft")
     if algo in ("fedavg", "salientgrads") and \

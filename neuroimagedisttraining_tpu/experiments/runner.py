@@ -291,6 +291,11 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         augment="auto" if getattr(args, "augment", 1) else False,
         agg_impl=getattr(args, "agg_impl", "dense"),
         agg_bucket_size=getattr(args, "agg_bucket_size", 0),
+        agg_topk_density=getattr(args, "agg_topk_density", 0.1),
+        agg_topk_sample=getattr(args, "agg_topk_sample", 0),
+        agg_hier_wire=getattr(args, "agg_hier_wire", "bf16"),
+        agg_hier_inner=getattr(args, "agg_hier_inner", 0),
+        agg_overlap=bool(getattr(args, "agg_overlap", 1)),
         fault_spec=getattr(args, "fault_spec", ""),
         # None = let the algorithm auto-resolve (on iff faults injected);
         # parse_args always resolves the sentinel in derive()
@@ -331,6 +336,18 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         raise SystemExit(
             "--agg_impl sparse needs a static sparsity mask; only "
             "salientgrads (fixed SNIP mask) supports it")
+    if agg_impl == "topk" and algo_name not in ("fedavg", "salientgrads"):
+        raise SystemExit(
+            "--agg_impl topk carries an error-feedback residual in "
+            "algorithm state; only fedavg/salientgrads thread it "
+            f"({algo_name} does not)")
+    if agg_impl == "hier" and \
+            getattr(args, "agg_hier_wire", "bf16") == "sparse" and \
+            algo_name != "salientgrads":
+        raise SystemExit(
+            "--agg_hier_wire sparse compresses the cross-slice hop to a "
+            "static mask's live coordinates; only salientgrads (fixed "
+            "SNIP mask) supports it")
     defense = None
     if getattr(args, "defense_type", "none") != "none":
         from ..robust import RobustAggregator
@@ -548,7 +565,10 @@ def _ckpt_metadata(args, algo, cost):
     return {"cost": cost.snapshot_totals(),
             "batching": getattr(args, "batching", "epoch"),
             "augment": algo.augment_fn is not None,
-            "track_personal": bool(getattr(args, "track_personal", 1))}
+            "track_personal": bool(getattr(args, "track_personal", 1)),
+            # diagnostic only (topk lineages already split identity):
+            # records which impl wrote this lineage's states
+            "agg_impl": algo.agg_impl}
 
 
 def _cost_round_record(algo, cost, samples_per_client, state):
@@ -744,7 +764,14 @@ def run_experiment(args: argparse.Namespace,
         start_round = 0
         if ckpt_mgr is not None and args.resume:
             restored = ckpt_mgr.restore_latest(
-                algo.init_state(jax.random.PRNGKey(args.seed)))
+                algo.init_state(jax.random.PRNGKey(args.seed)),
+                schema_hint=(
+                    "(agg_impl='topk' states carry the error-feedback "
+                    "residual stack; topk lineages live under their own "
+                    "'aggtopk' checkpoint identity and are not "
+                    "interchangeable with other impls')"
+                    if getattr(args, "agg_impl", "dense") == "topk"
+                    else ""))
             if restored is not None:
                 state, start_round = restored
                 logger.info("resumed from round %d", start_round)

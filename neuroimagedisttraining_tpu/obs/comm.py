@@ -25,7 +25,20 @@ wires of ``parallel/collectives.py`` shrink):
 * **sparse** — 4 bytes per LIVE coordinate: kernel leaves shrink to the
   :class:`~..parallel.collectives.SparsePlan`'s gathered index size,
   non-kernel leaves stay dense — so sparse bytes scale with the live
-  mask density, not the parameter count.
+  mask density, not the parameter count;
+* **topk** — 8 bytes per SELECTED coordinate (f32 value + int32 index;
+  ``collectives.topk_count`` of each leaf's live set at the configured
+  density): the per-client shipped payload of the error-feedback top-k
+  wire. The residual never ships — it is algorithm state — so the
+  modeled bytes are residual-free by construction, and
+  :func:`topk_payload` builds exactly this serialization for the
+  ``Message`` pin tests;
+* **hier** — the CROSS-SLICE hop only, at the configured
+  ``agg_hier_wire`` precision (bf16 2 B/param default; int8 adds the
+  per-bucket-row scales; 'sparse' prices the compressed-plan f32
+  payload): the intra-slice full-precision psum rides the fast domain
+  and is deliberately excluded — pricing the slow-domain wire is the
+  model's point.
 
 The model is static per run (masks are static on every path that
 supports ``agg_impl='sparse'``), so the per-round "computation" is free:
@@ -55,7 +68,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "COMM_PREFIX", "MESSAGE_BASE_OVERHEAD", "MESSAGE_PER_LEAF_OVERHEAD",
     "WireCostModel", "message_overhead_budget", "message_payload_nbytes",
-    "probe_agg_cost", "probe_agg_ms", "probe_aggregate",
+    "probe_agg_cost", "probe_agg_ms", "probe_aggregate", "topk_payload",
 ]
 
 #: every wire-model metric key starts with this (the analyzer's and the
@@ -109,6 +122,46 @@ def message_payload_nbytes(tree: Any, mask: Any = None) -> int:
     return total
 
 
+def topk_payload(tree: Any, k_frac: float, mask: Any = None) -> Any:
+    """The SERIALIZED form of one client's error-feedback top-k update:
+    per leaf, the ``collectives.topk_count`` largest-|value| coordinates
+    of the (optionally mask-restricted) flat leaf as an int32 ``idx``
+    array plus a values array in the leaf's dtype — the residual-free
+    wire (the residual is algorithm state and never ships).
+
+    ``message_payload_nbytes`` of this payload equals
+    ``sum_i topk_count(live_i, k_frac) * (4 + itemsize)`` exactly —
+    i.e. :meth:`WireCostModel.leaf_bytes(..., 'topk')` for f32 leaves —
+    which is what the property pins in
+    tests/test_comm_model_properties.py verify against real
+    ``Message.to_bytes`` output. Host-side only (numpy argpartition);
+    ties at the k-th magnitude resolve by flat index — deterministic."""
+    import jax
+
+    from ..parallel.collectives import topk_count
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    mask_leaves = (jax.tree_util.tree_leaves(mask) if mask is not None
+                   else [None] * len(leaves))
+    if len(mask_leaves) != len(leaves):
+        raise ValueError(
+            f"mask has {len(mask_leaves)} leaves, tree has {len(leaves)}")
+    out = []
+    for leaf, m in zip(leaves, mask_leaves):
+        flat = np.asarray(leaf).reshape(-1)
+        live = np.arange(flat.size)
+        if m is not None:
+            live = np.flatnonzero(np.asarray(m).reshape(-1))
+        k = topk_count(max(int(live.size), 1), k_frac)
+        vals = flat[live] if live.size else np.zeros(1, flat.dtype)
+        cand = live if live.size else np.zeros(1, np.int64)
+        order = np.argpartition(-np.abs(vals), min(k, vals.size) - 1)
+        sel = np.sort(cand[order[:k]]).astype(np.int32)
+        out.append({"idx": sel, "val": flat[sel].astype(flat.dtype)
+                    if live.size else vals[:k]})
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 #: per-param wire bytes of the non-bucket-dependent impls (int8 and
 #: sparse are computed per leaf — see :meth:`WireCostModel.leaf_bytes`)
 WIRE_BYTES_PER_PARAM = {"dense": 4.0, "bucketed": 4.0, "bf16": 2.0}
@@ -132,11 +185,23 @@ class WireCostModel:
                  leaf_group_index: Tuple[int, ...], *,
                  agg_impl: str = "dense", bucket_size: int = 0,
                  n_devices: int = 1,
-                 density: Optional[float] = None):
-        from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
+                 density: Optional[float] = None,
+                 topk_density: float = 0.1,
+                 hier_wire: str = "bf16"):
+        from ..parallel.collectives import (
+            AGG_IMPLS,
+            DEFAULT_BUCKET_SIZE,
+            HIER_WIRES,
+        )
 
         if agg_impl not in AGG_IMPLS:
             raise ValueError(f"agg_impl {agg_impl!r} not in {AGG_IMPLS}")
+        if hier_wire not in HIER_WIRES:
+            raise ValueError(
+                f"hier_wire {hier_wire!r} not in {HIER_WIRES}")
+        if not 0.0 < topk_density <= 1.0:
+            raise ValueError(
+                f"topk_density {topk_density} not in (0, 1]")
         if not (len(leaf_sizes) == len(leaf_live)
                 == len(leaf_group_index)):
             raise ValueError(
@@ -153,13 +218,19 @@ class WireCostModel:
         self.n_params = sum(self.leaf_sizes)
         #: None = no mask/plan known — the sparse what-if is omitted
         self.density = density
+        #: topk's configured shipped fraction (defaulted so the what-if
+        #: table can project topk even on runs using another impl)
+        self.topk_density = float(topk_density)
+        #: hier's cross-slice wire precision (the priced hop)
+        self.hier_wire = hier_wire
         self._impls = AGG_IMPLS
 
     # -- construction ----------------------------------------------------
     @classmethod
     def from_params(cls, params_template: Any, *, agg_impl: str = "dense",
                     bucket_size: int = 0, n_devices: int = 1,
-                    plan=None) -> "WireCostModel":
+                    plan=None, topk_density: float = 0.1,
+                    hier_wire: str = "bf16") -> "WireCostModel":
         """Model from a params pytree (concrete or ``jax.eval_shape``
         template). ``plan`` is the live-coordinate
         :class:`~..parallel.collectives.SparsePlan` (None = no mask:
@@ -185,7 +256,8 @@ class WireCostModel:
             density = float(plan.density)
         return cls(sizes, live, names, index, agg_impl=agg_impl,
                    bucket_size=bucket_size, n_devices=n_devices,
-                   density=density)
+                   density=density, topk_density=topk_density,
+                   hier_wire=hier_wire)
 
     @classmethod
     def from_algorithm(cls, algo, state: Any = None
@@ -219,21 +291,45 @@ class WireCostModel:
         return cls.from_params(
             template, agg_impl=algo.agg_impl,
             bucket_size=algo.agg_bucket_size, n_devices=n_devices,
-            plan=plan)
+            plan=plan,
+            topk_density=getattr(algo, "agg_topk_density", 0.1),
+            hier_wire=getattr(algo, "agg_hier_wire", "bf16"))
 
     # -- the model -------------------------------------------------------
+    def _int8_bytes(self, n: int) -> float:
+        # collectives._wire_reduce_groups int8 layout: the leaf is
+        # padded to nb rows of b elements, one f32 scale per row
+        b = min(self.bucket_size, max(n, 1))
+        nb = -(-n // b) if n else 0
+        return float(nb * b) + INT8_SCALE_BYTES * nb
+
     def leaf_bytes(self, i: int, impl: str) -> float:
         """Modeled wire bytes of leaf ``i`` under ``impl``."""
         n = self.leaf_sizes[i]
+        live = self.leaf_live[i]
         if impl == "sparse":
-            live = self.leaf_live[i]
             return 4.0 * (n if live is None else live)
+        if impl == "topk":
+            # the shipped payload: topk_count of the LIVE set, 4 B f32
+            # value + 4 B int32 index each (residual-free — the
+            # remainder stays in state, never on the wire). The same
+            # topk_count rule builds topk_payload, so this prediction
+            # is EXACT against Message serialization.
+            from ..parallel.collectives import topk_count
+
+            return 8.0 * topk_count(n if live is None else live,
+                                    self.topk_density)
+        if impl == "hier":
+            # cross-slice hop only (intra-slice psum is the fast
+            # domain), at the configured wire precision
+            wire = self.hier_wire
+            if wire == "sparse":
+                return 4.0 * (n if live is None else live)
+            if wire == "int8":
+                return self._int8_bytes(n)
+            return {"f32": 4.0, "bf16": 2.0}[wire] * n
         if impl == "int8":
-            # collectives._wire_reduce_groups int8 layout: the leaf is
-            # padded to nb rows of b elements, one f32 scale per row
-            b = min(self.bucket_size, max(n, 1))
-            nb = -(-n // b) if n else 0
-            return float(nb * b) + INT8_SCALE_BYTES * nb
+            return self._int8_bytes(n)
         return WIRE_BYTES_PER_PARAM[impl] * n
 
     def bytes_for(self, impl: str) -> float:
@@ -255,9 +351,17 @@ class WireCostModel:
 
     def what_if(self) -> Dict[str, float]:
         """Every ``agg_impl``'s modeled bytes at the current density —
-        sparse only when a mask/plan is known."""
+        the mask-dependent wires (sparse; hier's sparse cross-slice
+        wire) only when a mask/plan is known. topk projects always (its
+        density is a config knob, defaulted when unconfigured)."""
+        def known(impl):
+            if impl == "sparse" or (impl == "hier"
+                                    and self.hier_wire == "sparse"):
+                return self.density is not None
+            return True
+
         return {impl: self.bytes_for(impl) for impl in self._impls
-                if impl != "sparse" or self.density is not None}
+                if known(impl)}
 
     def round_metrics(self) -> Dict[str, float]:
         """The per-round ``comm_*`` metric dict (all floats — static

@@ -8,7 +8,12 @@ writes (``--profile_dir`` / ``trace_one_round``: gzipped
 device-lane time to collective kernels (all-reduce / all-gather /
 reduce-scatter / collective-permute / all-to-all — the aggregation's
 on-wire operations) vs everything else, yielding the MEASURED agg share
-and, against the wire model's bytes, the achieved wire GB/s.
+and, against the wire model's bytes, the achieved wire GB/s — plus the
+collective-vs-compute interval OVERLAP per device pid (``overlap_s`` /
+``overlap_frac``: the share of collective seconds concurrent with
+compute on other rows of the same device — the evidence that the
+group-ordered aggregation dispatch actually pipelined wire against
+compute; 0 on single-stream captures that serialize everything).
 
 When no trace was captured, :func:`share_from_cost_analysis` gives the
 fallback estimate from ``obs/compile.py``'s ``jit_cost_analysis``
@@ -23,6 +28,7 @@ analyzer's schema-v3 ``comm`` section picks it up.
 """
 from __future__ import annotations
 
+import bisect
 import glob
 import gzip
 import json
@@ -113,25 +119,66 @@ def _aggregate_tids(events: List[Dict[str, Any]]) -> set:
     return out
 
 
+#: per-lane accumulator keys folded across files/devices (overlap_s =
+#: collective time concurrent with compute on OTHER rows of the same
+#: device pid — the compute/comm overlap evidence)
+_LANE_KEYS = ("busy_s", "collective_s", "compute_s", "overlap_s")
+
+
+def _interval_overlap_s(coll: List[tuple], comp: List[tuple]) -> float:
+    """Total seconds where a collective interval and a compute interval
+    are BOTH active (on any rows of one device pid): merge the compute
+    intervals into a disjoint union, then sum each collective
+    interval's intersection with it. Chrome-trace microseconds in,
+    seconds out."""
+    if not coll or not comp:
+        return 0.0
+    merged: List[List[float]] = []
+    for s, e in sorted(comp):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    total = 0.0
+    starts = [m[0] for m in merged]
+    for s, e in coll:
+        i = max(0, bisect.bisect_right(starts, s) - 1)
+        while i < len(merged) and merged[i][0] < e:
+            lo = max(s, merged[i][0])
+            hi = min(e, merged[i][1])
+            if hi > lo:
+                total += hi - lo
+            i += 1
+    return total / 1e6
+
+
 def _finalize_attribution(devices: Dict[str, Dict[str, float]],
                           top: Dict[str, Dict[str, float]],
                           top_k: Optional[int] = None
                           ) -> Dict[str, Any]:
     """Shared fold of per-lane sums into the summary shape: per-device
-    ``agg_share``, cross-device totals, ranked collectives (ONE
-    implementation — attribute_trace and analyze_profile_dir must not
-    drift). ``top_k=None`` keeps the FULL ranked kernel list:
-    per-file attributions stay untruncated so a cross-file fold never
-    drops a kernel that ranks low in every file but high globally;
-    only the final dir-level summary bounds its list."""
-    totals = {"busy_s": 0.0, "collective_s": 0.0, "compute_s": 0.0}
+    ``agg_share`` and ``overlap_frac``, cross-device totals, ranked
+    collectives (ONE implementation — attribute_trace and
+    analyze_profile_dir must not drift). ``top_k=None`` keeps the FULL
+    ranked kernel list: per-file attributions stay untruncated so a
+    cross-file fold never drops a kernel that ranks low in every file
+    but high globally; only the final dir-level summary bounds its
+    list."""
+    totals = {k: 0.0 for k in _LANE_KEYS}
     for d in devices.values():
+        d.setdefault("overlap_s", 0.0)
         d["agg_share"] = (d["collective_s"] / d["busy_s"]
                           if d["busy_s"] > 0 else 0.0)
+        d["overlap_frac"] = (d["overlap_s"] / d["collective_s"]
+                             if d["collective_s"] > 0 else 0.0)
         for k in totals:
             totals[k] += d[k]
     totals["agg_share"] = (totals["collective_s"] / totals["busy_s"]
                            if totals["busy_s"] > 0 else 0.0)
+    # share of collective seconds hidden behind concurrent compute —
+    # the measured compute/comm overlap (0 on single-stream captures)
+    totals["overlap_frac"] = (totals["overlap_s"] / totals["collective_s"]
+                              if totals["collective_s"] > 0 else 0.0)
     top_list = [{"name": k, "total_s": v["total_s"],
                  "count": int(v["count"])}
                 for k, v in sorted(top.items(),
@@ -156,6 +203,10 @@ def attribute_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
     skip_tids = _aggregate_tids(events)
     devices: Dict[str, Dict[str, float]] = {}
     top: Dict[str, Dict[str, float]] = {}
+    # per-lane (start, end) interval lists in trace microseconds, for
+    # the collective-vs-compute overlap measurement
+    coll_iv: Dict[str, List[tuple]] = {}
+    comp_iv: Dict[str, List[tuple]] = {}
     for e in events:
         if e.get("ph") != "X" or not isinstance(e.get("dur"),
                                                 (int, float)):
@@ -171,13 +222,23 @@ def attribute_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
         dur_s = float(e["dur"]) / 1e6
         d["busy_s"] += dur_s
         name = str(e.get("name", ""))
+        ts = e.get("ts")
+        iv = ((float(ts), float(ts) + float(e["dur"]))
+              if isinstance(ts, (int, float)) else None)
         if is_collective(name):
             d["collective_s"] += dur_s
+            if iv is not None:
+                coll_iv.setdefault(lane, []).append(iv)
             t = top.setdefault(name, {"total_s": 0.0, "count": 0})
             t["total_s"] += dur_s
             t["count"] += 1
         else:
             d["compute_s"] += dur_s
+            if iv is not None:
+                comp_iv.setdefault(lane, []).append(iv)
+    for lane, d in devices.items():
+        d["overlap_s"] = _interval_overlap_s(
+            coll_iv.get(lane, []), comp_iv.get(lane, []))
     return _finalize_attribution(devices, top)
 
 
@@ -205,10 +266,10 @@ def analyze_profile_dir(profile_dir: str,
             logger.warning("unreadable trace %s: %s", path, e)
             continue
         for lane, d in att["devices"].items():
-            agg = devices.setdefault(lane, {
-                "busy_s": 0.0, "collective_s": 0.0, "compute_s": 0.0})
-            for k in ("busy_s", "collective_s", "compute_s"):
-                agg[k] += d[k]
+            agg = devices.setdefault(
+                lane, {k: 0.0 for k in _LANE_KEYS})
+            for k in _LANE_KEYS:
+                agg[k] += d.get(k, 0.0)
         for t in att["top_collectives"]:
             e2 = top.setdefault(t["name"], {"total_s": 0.0, "count": 0})
             e2["total_s"] += t["total_s"]

@@ -206,11 +206,20 @@ METRIC_GATE_DEFAULTS: Dict[str, Dict[str, Any]] = {
 def metric_gate_defaults(metric: str) -> Dict[str, Any]:
     """Gate parameter defaults for ``metric`` (empty dict = the generic
     higher-is-better bench defaults). scripts/perf_gate.py consults
-    this for every flag the caller did not set explicitly."""
+    this for every flag the caller did not set explicitly.
+
+    ``agg_ms_`` covers the scripts/bench_agg.py microbench timings
+    (incl. the topk/hier impls); ``agg_bytes_`` the modeled wire bytes
+    recorded beside them — bytes are ANALYTIC (zero run-to-run noise),
+    so any upward drift is a real model/impl change and the band is
+    tight."""
     if metric in METRIC_GATE_DEFAULTS:
         return dict(METRIC_GATE_DEFAULTS[metric])
     if metric.startswith("agg_ms_"):
         return {"higher_is_better": False}
+    if metric.startswith("agg_bytes_"):
+        return {"higher_is_better": False, "rel_threshold": 0.01,
+                "mad_k": 0.0}
     return {}
 
 

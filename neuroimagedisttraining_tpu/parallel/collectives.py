@@ -35,6 +35,35 @@ three composable levers behind one ``weighted_mean`` surface:
   denominator (``sum(masks)``) is computed on the same compressed
   representation when per-client masks are supplied. With honored masks
   the result is bit-equal to the dense mask-weighted aggregate.
+* **error-feedback top-k** (``agg_impl='topk'``) — per-leaf-group top-k
+  magnitude selection on the clients' COMPENSATED deltas (delta plus the
+  error-feedback residual the algorithm carries in state — Deep Gradient
+  Compression, Lin et al. 2018). The wire cost scales with information
+  (k selected coordinates: value + index), not parameter count; the
+  unsent remainder accumulates in the residual so nothing is ever
+  dropped, only deferred. :func:`topk_sparsify` is the selection kernel,
+  :func:`topk_weighted_mean` the aggregate; the residual bookkeeping
+  lives in ``algorithms/base.py`` (it is state, not a wire concern).
+  With a :class:`SparsePlan` the selection runs on the compressed live
+  coordinates, so k is a fraction of the LIVE set (SalientGrads
+  composition).
+* **hierarchical two-stage reduce** (``agg_impl='hier'``) — BlueConnect
+  (Cho et al. 2019) style: a full-precision ``psum`` over
+  ``axis_index_groups`` of ``hier_inner`` adjacent devices (the fast
+  intra-slice domain), then ONE cross-slice collective per leaf-group
+  bucket in a configurable low-precision wire (bf16 / int8 — f32
+  accumulation) across the ``outer = devices/inner`` slices. Off-mesh
+  (or with one slice) it degrades to the exact f32 bucketed reduce.
+* **compute/comm overlap** (``overlap=True``, the default) — the
+  shard_map reduce issues each leaf-group bucket's collective
+  immediately after computing THAT group's local partials instead of
+  materializing every leaf's partial first: group k's collective and
+  group k+1's local contraction have no data dependency, so XLA's
+  scheduler can pipeline wire against compute (and, in the fused scan
+  path, against the tail of local training that produces later groups'
+  leaves). Scheduling-only: per-bucket math is bit-identical either
+  way, so the knob never enters run identity. Verified via
+  ``obs/devtrace.py``'s collective-vs-compute interval overlap.
 
 Everything is jit-traceable and composes with the Byzantine-robust defenses
 (``robust.aggregation`` transforms the stacked locals BEFORE aggregation, so
@@ -64,7 +93,12 @@ DEFAULT_BUCKET_SIZE = 1 << 18
 WIRE_FORMATS = ("f32", "bf16", "int8")
 
 #: the ``agg_impl`` hyperparameter surface (algorithms/base.py)
-AGG_IMPLS = ("dense", "bucketed", "bf16", "int8", "sparse")
+AGG_IMPLS = ("dense", "bucketed", "bf16", "int8", "sparse", "topk",
+             "hier")
+
+#: cross-slice wire choices of the hierarchical reduce ("sparse" =
+#: compressed-plan f32 across slices — SalientGrads only)
+HIER_WIRES = ("f32", "bf16", "int8", "sparse")
 
 
 class FlatSpec(NamedTuple):
@@ -196,6 +230,30 @@ def _leaf_groups(sizes, bucket_size: int) -> List[List[int]]:
     return groups
 
 
+def _group_vals(payload, g):
+    """One group's payload vectors; thunk entries (the overlap spelling:
+    each leaf's local contraction deferred until ITS group reduces, so
+    group k's collective and group k+1's contraction are independent
+    and XLA can pipeline them) are forced here, at issue time."""
+    return tuple(payload[i]() if callable(payload[i]) else payload[i]
+                 for i in g)
+
+
+def _int8_leaf_reduce(v, i, kd, axis_name, bucket_size, groups=None):
+    """One leaf's int8-wire reduce: pad to bucket rows, quantize with a
+    per-(device,leaf) stochastic-rounding key, all_gather payload +
+    scales (optionally over ``axis_index_groups``), f32 accumulate."""
+    n = v.shape[0]
+    b = min(bucket_size, max(n, 1))
+    nb = -(-n // b)
+    pad = nb * b - n
+    vb = jnp.pad(v, (0, pad)).reshape(nb, b)
+    q, s = _quantize_int8(vb, jax.random.fold_in(kd, i))
+    gq = jax.lax.all_gather(q, axis_name, axis_index_groups=groups)
+    gs = jax.lax.all_gather(s, axis_name, axis_index_groups=groups)
+    return jnp.sum(gq.astype(jnp.float32) * gs, axis=0).reshape(-1)[:n]
+
+
 def _wire_reduce_groups(payload, groups, *, axis_name: str, wire: str,
                         key, bucket_size: int):
     """INSIDE shard_map: reduce a list of per-device flat f32 local-
@@ -203,10 +261,12 @@ def _wire_reduce_groups(payload, groups, *, axis_name: str, wire: str,
     bucket — multi-operand ``psum`` for f32; ``all_gather`` of the
     wire-cast payload + f32 tree-sum for bf16/int8 (low-precision wire,
     f32 accumulation). Independent per-bucket collectives are what XLA
-    can pipeline against each other and the producing compute."""
+    can pipeline against each other and the producing compute; payload
+    entries may be thunks (see :func:`_group_vals`) so each group's
+    contraction is emitted right before its own collective."""
     out = [None] * len(payload)
     for g in groups:
-        vals = tuple(payload[i] for i in g)
+        vals = _group_vals(payload, g)
         if wire == "f32":
             red = jax.lax.psum(vals, axis_name)
         elif wire == "bf16":
@@ -216,19 +276,84 @@ def _wire_reduce_groups(payload, groups, *, axis_name: str, wire: str,
                         for x in gath)
         else:  # int8: per-bucket scales within each leaf payload
             kd = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-            red_l = []
-            for i, v in zip(g, vals):
-                n = v.shape[0]
-                b = min(bucket_size, max(n, 1))
-                nb = -(-n // b)
-                pad = nb * b - n
-                vb = jnp.pad(v, (0, pad)).reshape(nb, b)
-                q, s = _quantize_int8(vb, jax.random.fold_in(kd, i))
-                gq = jax.lax.all_gather(q, axis_name)
-                gs = jax.lax.all_gather(s, axis_name)
-                red_l.append(jnp.sum(
-                    gq.astype(jnp.float32) * gs, axis=0).reshape(-1)[:n])
-            red = tuple(red_l)
+            red = tuple(
+                _int8_leaf_reduce(v, i, kd, axis_name, bucket_size)
+                for i, v in zip(g, vals))
+        for i, r in zip(g, red):
+            out[i] = r
+    return out
+
+
+def resolve_hier_inner(n_devices: int, requested: int = 0) -> int:
+    """Devices per intra-slice group of the hierarchical reduce.
+
+    ``requested > 0`` must divide the axis size (a static config error
+    otherwise — raised at trace/build time, never silently adjusted);
+    ``requested`` of 1 or >= the axis size means one stage, returned as
+    0 (disabled). ``requested == 0`` auto-picks the largest divisor d
+    with ``d*d <= n_devices`` (the balanced two-stage split: 8 devices
+    -> 2x4, 16 -> 4x4); axes of <= 2 devices have no second stage."""
+    if requested and (requested < 0
+                      or (requested > 1 and n_devices % requested)):
+        # validated BEFORE the small-axis early return: a typo'd inner
+        # must fail on the 2-device dev mesh, not only when promoted
+        raise ValueError(
+            f"hier_inner {requested} must divide the {n_devices}-"
+            "device clients axis (intra-slice groups are equal-size "
+            "device blocks)")
+    if n_devices <= 2:
+        return 0
+    if requested:
+        return requested if 1 < requested < n_devices else 0
+    inner = 1
+    for d in range(2, n_devices):
+        if n_devices % d == 0 and d * d <= n_devices:
+            inner = d
+    return inner if inner > 1 else 0
+
+
+def _hier_index_groups(n_devices: int, inner: int):
+    """(intra, inter) ``axis_index_groups``: contiguous ``inner``-device
+    blocks are one slice; position-matched devices across the
+    ``n_devices // inner`` slices form the cross-slice groups."""
+    outer = n_devices // inner
+    intra = [[s * inner + i for i in range(inner)] for s in range(outer)]
+    inter = [[s * inner + i for s in range(outer)] for i in range(inner)]
+    return intra, inter
+
+
+def _hier_reduce_groups(payload, groups, *, axis_name: str, wire: str,
+                        key, bucket_size: int, n_devices: int,
+                        inner: int):
+    """INSIDE shard_map: the two-stage hierarchical reduce. Stage 1 is a
+    FULL-PRECISION multi-operand ``psum`` within each ``inner``-device
+    slice (the fast domain — ICI inside a slice); stage 2 moves each
+    slice's partial across the slow domain once per leaf-group bucket in
+    the configured ``wire`` (f32 psum, or bf16/int8 all_gather + f32
+    accumulation). Every device ends with the full reduction (the two
+    group partitions compose to the whole axis)."""
+    intra, inter = _hier_index_groups(n_devices, inner)
+    out = [None] * len(payload)
+    for g in groups:
+        vals = _group_vals(payload, g)
+        part = jax.lax.psum(vals, axis_name, axis_index_groups=intra)
+        if wire == "f32":
+            red = jax.lax.psum(part, axis_name, axis_index_groups=inter)
+        elif wire == "bf16":
+            gath = jax.lax.all_gather(
+                tuple(v.astype(jnp.bfloat16) for v in part), axis_name,
+                axis_index_groups=inter)
+            red = tuple(jnp.sum(x.astype(jnp.float32), axis=0)
+                        for x in gath)
+        else:  # int8 cross-slice wire: key per slice, not per device —
+            # every device in a slice holds the identical partial and
+            # must quantize it identically
+            kd = jax.random.fold_in(
+                key, jax.lax.axis_index(axis_name) // inner)
+            red = tuple(
+                _int8_leaf_reduce(v, i, kd, axis_name, bucket_size,
+                                  groups=inter)
+                for i, v in zip(g, part))
         for i, r in zip(g, red):
             out[i] = r
     return out
@@ -334,6 +459,154 @@ def _expand_vec(vec: jax.Array, stacked: Any, plan: SparsePlan) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# error-feedback top-k selection
+# ---------------------------------------------------------------------------
+
+def plan_dead_select(stacked: Any, plan: SparsePlan) -> Any:
+    """Select-zero the DEAD coordinates of a [C, ...]-stacked pytree
+    (a ``jnp.where`` against the plan's static live mask — never
+    arithmetic, so NaN rows cannot smear). The topk round body applies
+    it to the compensated deltas when a plan exists: dead coordinates
+    must neither enter the residual (they would sit there forever —
+    selection never ships them) nor the selection itself."""
+    _plan_check(stacked, plan)
+    leaves, treedef = jax.tree_util.tree_flatten(stacked)
+    out = []
+    for x, ix in zip(leaves, plan.idx):
+        if ix is None:
+            out.append(x)
+            continue
+        shape = x.shape[1:]
+        size = int(np.prod(shape)) if shape else 1
+        live = np.zeros(size, bool)
+        live[ix] = True
+        mask = jnp.asarray(live.reshape(shape))
+        out.append(jnp.where(mask, x, jnp.zeros_like(x)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def topk_count(n: int, k_frac: float) -> int:
+    """Selected-coordinate count for a segment of ``n`` coordinates at
+    fraction ``k_frac`` — ``min(n, max(1, ceil(k_frac * n)))``. ONE
+    rounding rule shared by the in-jit selection, the wire-cost model
+    (obs/comm.py) and the serialization payload builder — but applied
+    to different partitions: the model and ``topk_payload`` price/ship
+    per LEAF (byte-exact against each other, pinned), while
+    :func:`topk_sparsify` selects per leaf-GROUP bucket (many small
+    leaves can share one threshold). The counts coincide when a group
+    holds one leaf; when a bucket packs several small leaves the
+    per-leaf ceil/``max(1,..)`` floors (and exact-threshold ties,
+    which selection keeps) bound the difference — drift the
+    error-feedback residual absorbs by construction."""
+    if not 0.0 < k_frac <= 1.0:
+        raise ValueError(f"topk density {k_frac} not in (0, 1]")
+    return min(max(int(n), 1), max(1, int(np.ceil(k_frac * n))))
+
+
+def topk_sparsify(stacked: Any, k_frac: float, *,
+                  plan: Optional[SparsePlan] = None,
+                  bucket_size: int = DEFAULT_BUCKET_SIZE,
+                  sample: int = 0) -> Any:
+    """Per-leaf-group top-k magnitude selection over a [C, ...]-stacked
+    pytree: within each leaf-group bucket (the same
+    :func:`_leaf_groups` partition every collective uses), each client
+    keeps its ``topk_count(group_size, k_frac)`` largest-|value|
+    coordinates and zeroes the rest. With a ``plan`` the selection runs
+    on the COMPRESSED live coordinates (SalientGrads: k is a fraction
+    of the live set, and dead coordinates — exact zeros on every
+    honored-mask input — can never be selected ahead of live ones).
+
+    Deterministic and trace-safe: the threshold is the k-th largest
+    magnitude per (client, group); coordinates tying it exactly are all
+    kept (a measure-zero event on continuous deltas, and the
+    all-zero-row edge keeps the row unchanged — sparsifying an exact
+    zero contributes exactly zero to wire and residual alike).
+
+    ``sample > 0`` estimates each group's threshold from a strided
+    ~``sample``-element subsample instead of the full row — the Deep
+    Gradient Compression hierarchical-sampling trick: ``top_k`` is
+    sort-bound in n (measured 2.1 s per 32x262k group on XLA:CPU at ANY
+    k vs 0.11 s on a 16k subsample), the estimate is deterministic
+    (fixed stride, no RNG), and the shipped count is only
+    approximately k — which error feedback absorbs by construction
+    (over- or under-selection just shifts coordinates between wire and
+    residual). 0 (the default) keeps the exact selection."""
+    if plan is not None:
+        _plan_check(stacked, plan)
+    leaves = jax.tree_util.tree_leaves(stacked)
+    idxs = plan.idx if plan is not None else (None,) * len(leaves)
+    psizes = [
+        (int(np.prod(x.shape[1:])) if x.ndim > 1 else 1)
+        if ix is None else int(ix.size)
+        for x, ix in zip(leaves, idxs)]
+    groups = _leaf_groups(psizes, bucket_size)
+    offs = np.concatenate([[0], np.cumsum(psizes)]).astype(int)
+    mat = _compress(stacked, plan) if plan is not None \
+        else stacked_to_mat(stacked)
+    cols = []
+    for g in groups:
+        start, end = offs[g[0]], offs[g[-1] + 1]
+        seg = mat[:, start:end]
+        n = int(end - start)
+        k = topk_count(n, k_frac)
+        av = jnp.abs(seg)
+        if sample and n > sample:
+            stride = max(1, n // int(sample))
+            cand = av[:, ::stride]
+            ks = min(cand.shape[1], max(1, int(round(k / stride))))
+            thr = jax.lax.top_k(cand, ks)[0][:, -1:]
+        else:
+            thr = jax.lax.top_k(av, k)[0][:, -1:]
+        cols.append(jnp.where(av >= thr, seg, jnp.zeros_like(seg)))
+    sp_mat = jnp.concatenate(cols, axis=1)
+    # rebuild the stacked tree layout (dense leaves reshape; compressed
+    # leaves expand by the static inverse-permutation gather per client)
+    treedef = jax.tree_util.tree_flatten(stacked)[1]
+    out = []
+    for i, (x, ix) in enumerate(zip(leaves, idxs)):
+        block = sp_mat[:, offs[i]:offs[i + 1]]
+        if ix is None:
+            out.append(block.reshape(x.shape).astype(x.dtype))
+        else:
+            size = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+            dense = jnp.take(block, jnp.asarray(_inverse_idx(ix, size)),
+                             axis=1, mode="fill", fill_value=0)
+            out.append(dense.reshape(x.shape).astype(x.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def topk_weighted_mean(stacked: Any, weights: jax.Array, k_frac: float,
+                       *, plan: Optional[SparsePlan] = None, mesh=None,
+                       axis_name: str = "clients",
+                       bucket_size: int = DEFAULT_BUCKET_SIZE,
+                       overlap: bool = True,
+                       sample: int = 0) -> Tuple[Any, Any]:
+    """The ``agg_impl='topk'`` aggregate: sparsify each client's row
+    (:func:`topk_sparsify`), then the weighted mean of the sparsified
+    rows through the bucketed (plan-compressed when given) reduce.
+    Returns ``(aggregate, sparsified)`` — the caller owns the
+    error-feedback bookkeeping (``residual' = compensated -
+    sparsified``); callers without residual state use index [0].
+
+    The selection is per-client-local (element-wise after the
+    per-group threshold), so on a ``clients`` mesh it runs where each
+    client's row lives and only the sparsified contraction crosses
+    chips; the simulated reduce moves the dense-layout zeros, while the
+    INFORMATION cost (k values + k indices per group) is what
+    ``obs.comm.WireCostModel`` prices and a cross-silo transport ships
+    (``obs.comm.topk_payload``)."""
+    sp = topk_sparsify(stacked, k_frac, plan=plan,
+                       bucket_size=bucket_size, sample=sample)
+    kw = dict(mesh=mesh, axis_name=axis_name, bucket_size=bucket_size,
+              overlap=overlap)
+    if plan is not None:
+        agg = sparse_weighted_mean(sp, weights, plan, **kw)
+    else:
+        agg = weighted_mean(sp, weights, **kw)
+    return agg, sp
+
+
+# ---------------------------------------------------------------------------
 # the public weighted means
 # ---------------------------------------------------------------------------
 
@@ -366,14 +639,22 @@ def _reduce_mat(mat: jax.Array, weights: jax.Array, *,
 def _mesh_reduce_leaves(stacked: Any, weights: jax.Array, *, mesh,
                         axis_name: str, bucket_size: int, wire: str, rng,
                         plan: Optional[SparsePlan] = None,
-                        masks: Any = None) -> List[jax.Array]:
+                        masks: Any = None, hier_inner: int = 0,
+                        overlap: bool = True) -> List[jax.Array]:
     """shard_map weighted reduce over the mesh-sharded client axis,
     returning the flat reduced payload per leaf (compressed to the plan's
     live coordinates when given; with ``masks`` the payload list is
     num-leaves followed by den-leaves). Each device contracts only its
     LOCAL clients — compressed BEFORE the contraction on the sparse path,
     so local compute and wire both scale with density — and each
-    leaf-group bucket is one collective."""
+    leaf-group bucket is one collective.
+
+    ``hier_inner > 1`` routes each bucket through the two-stage
+    hierarchical reduce (:func:`_hier_reduce_groups`: full-precision
+    intra-slice psum, ``wire`` across slices). ``overlap`` (default)
+    defers each leaf's local contraction into its group's reduce step so
+    group k's collective and group k+1's contraction interleave in
+    emission order — scheduling freedom only, bit-identical results."""
     key = rng if rng is not None else jax.random.PRNGKey(0)
     leaves = jax.tree_util.tree_leaves(stacked)
     idxs = plan.idx if plan is not None else (None,) * len(leaves)
@@ -385,40 +666,71 @@ def _mesh_reduce_leaves(stacked: Any, weights: jax.Array, *, mesh,
         psizes = psizes * 2
     groups = _leaf_groups(psizes, bucket_size)
     jidx = [None if ix is None else jnp.asarray(ix) for ix in idxs]
+    # hier_inner: 0 = single-stage (the default reduce); -1 = hier with
+    # the auto slice split; > 1 = hier with that many devices per slice
+    n_devices = int(mesh.shape[axis_name])
+    inner = resolve_hier_inner(n_devices, max(hier_inner, 0)) \
+        if hier_inner else 0
+    if hier_inner and not inner:
+        # one slice (hier_inner >= axis, or a <= 2-device axis): the
+        # whole reduce lives inside the full-precision fast domain and
+        # the configured CROSS-slice wire never fires — degrade to the
+        # exact f32 bucketed reduce, the same degeneration as the
+        # off-mesh fallback (weighted_mean's "wire never fires"
+        # contract), instead of silently quantizing the intra-slice hop
+        wire = "f32"
+    if inner:
+        def reduce_groups(payload, k):
+            return _hier_reduce_groups(
+                payload, groups, axis_name=axis_name, wire=wire, key=k,
+                bucket_size=bucket_size, n_devices=n_devices,
+                inner=inner)
+    else:
+        def reduce_groups(payload, k):
+            return _wire_reduce_groups(
+                payload, groups, axis_name=axis_name, wire=wire, key=k,
+                bucket_size=bucket_size)
 
     def local_payload(st_leaves, wv):
-        out = []
-        for x, ix in zip(st_leaves, jidx):
-            xf = x.reshape(x.shape[0], -1).astype(jnp.float32)
-            if ix is not None:
-                xf = jnp.take(xf, ix, axis=1)
-            out.append(jnp.tensordot(wv, xf, axes=1))
-        return out
+        """Per-leaf local-contraction thunks: with ``overlap`` they are
+        forced inside the group loop (contraction emitted right before
+        its own collective); without, all up front (the serialized
+        contract-everything-then-reduce order)."""
+        def make(x, ix):
+            def thunk():
+                xf = x.reshape(x.shape[0], -1).astype(jnp.float32)
+                if ix is not None:
+                    xf = jnp.take(xf, ix, axis=1)
+                return jnp.tensordot(wv, xf, axes=1)
+            return thunk
 
+        thunks = [make(x, ix) for x, ix in zip(st_leaves, jidx)]
+        return thunks if overlap else [t() for t in thunks]
+
+    # hier's axis_index_groups psums produce slice-varying intermediates
+    # the static rep-checker cannot see through, so it is disabled there
+    # like on the all_gather wires
+    smap_kw = dict(_NOCHECK_KW) if inner else _shard_map_kw(wire)
     in_specs = (P(axis_name), P(axis_name), P())
     if masks is None:
         @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                 **_shard_map_kw(wire))
+                 **smap_kw)
         def agg(st, wv, k):
             payload = local_payload(jax.tree_util.tree_leaves(st), wv)
-            return tuple(_wire_reduce_groups(
-                payload, groups, axis_name=axis_name, wire=wire, key=k,
-                bucket_size=bucket_size))
+            return tuple(reduce_groups(payload, k))
 
         return list(agg(stacked, weights.astype(jnp.float32), key))
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis_name),) + in_specs, out_specs=P(),
-             **_shard_map_kw(wire))
+             **smap_kw)
     def agg_masked(st, mk, wv, k):
         xm = jax.tree_util.tree_map(
             lambda x, m: x.astype(jnp.float32) * m.astype(jnp.float32),
             st, mk)
         payload = local_payload(jax.tree_util.tree_leaves(xm), wv) + \
             local_payload(jax.tree_util.tree_leaves(mk), wv)
-        return tuple(_wire_reduce_groups(
-            payload, groups, axis_name=axis_name, wire=wire, key=k,
-            bucket_size=bucket_size))
+        return tuple(reduce_groups(payload, k))
 
     return list(agg_masked(stacked, masks, weights.astype(jnp.float32),
                            key))
@@ -427,28 +739,38 @@ def _mesh_reduce_leaves(stacked: Any, weights: jax.Array, *, mesh,
 def weighted_mean(stacked: Any, weights: jax.Array, *, mesh=None,
                   axis_name: str = "clients",
                   bucket_size: int = DEFAULT_BUCKET_SIZE,
-                  wire: str = "f32", rng: Optional[jax.Array] = None) -> Any:
+                  wire: str = "f32", rng: Optional[jax.Array] = None,
+                  hier_inner: int = 0, overlap: bool = True) -> Any:
     """Weighted mean over the leading client axis, via the bucketed
     (optionally low-precision-wire) reduce. Drop-in for
     ``core.state.weighted_tree_sum`` (callers pass already-normalized
     weights); ``wire='f32'`` off-mesh is bit-equal to it. With a usable
     ``clients`` mesh the whole reduce runs inside ``shard_map`` on
     per-leaf local partials with one collective per leaf-group bucket —
-    the [C, N] client matrix is never materialized."""
+    the [C, N] client matrix is never materialized.
+
+    ``hier_inner`` enables the two-stage hierarchical reduce on-mesh
+    (full-precision psum inside each ``hier_inner``-device slice, then
+    ``wire`` across slices; 0 = auto-split via
+    :func:`resolve_hier_inner`). Off-mesh there are no slices and the
+    fallback is the EXACT f32 bucketed contraction — the one-slice
+    degeneration, in which the cross-slice wire never fires."""
     _check_wire(wire, rng)
     leaves = jax.tree_util.tree_leaves(stacked)
     c = leaves[0].shape[0]
     if _mesh_axis_rows(mesh, axis_name, c):
         red = _mesh_reduce_leaves(
             stacked, weights, mesh=mesh, axis_name=axis_name,
-            bucket_size=bucket_size, wire=wire, rng=rng)
+            bucket_size=bucket_size, wire=wire, rng=rng,
+            hier_inner=hier_inner, overlap=overlap)
         _, treedef = jax.tree_util.tree_flatten(stacked)
         return jax.tree_util.tree_unflatten(treedef, [
             r.reshape(x.shape[1:]).astype(x.dtype)
             for r, x in zip(red, leaves)])
     spec = flat_spec(stacked, stacked=True)
     vec = _reduce_mat(stacked_to_mat(stacked), weights,
-                      bucket_size=bucket_size, wire=wire, rng=rng)
+                      bucket_size=bucket_size,
+                      wire="f32" if hier_inner else wire, rng=rng)
     return vec_to_tree(vec, spec)
 
 
@@ -457,7 +779,9 @@ def sparse_weighted_mean(stacked: Any, weights: jax.Array, plan: SparsePlan,
                          axis_name: str = "clients",
                          bucket_size: int = DEFAULT_BUCKET_SIZE,
                          wire: str = "f32",
-                         rng: Optional[jax.Array] = None) -> Any:
+                         rng: Optional[jax.Array] = None,
+                         hier_inner: int = 0,
+                         overlap: bool = True) -> Any:
     """Mask-aware sparse weighted mean: reduce only the plan's live
     coordinates — local compute and the cross-chip transfer scale with
     ~density — then rebuild the dense layout with one static inverse-
@@ -480,7 +804,7 @@ def sparse_weighted_mean(stacked: Any, weights: jax.Array, plan: SparsePlan,
         red = _mesh_reduce_leaves(
             stacked, weights, mesh=mesh, axis_name=axis_name,
             bucket_size=bucket_size, wire=wire, rng=rng, plan=plan,
-            masks=masks)
+            masks=masks, hier_inner=hier_inner, overlap=overlap)
         if masks is not None:
             num, den = red[:len(leaves)], red[len(leaves):]
             red = [jnp.where(d > 0, n / jnp.where(d > 0, d, 1.0), 0.0)
@@ -488,7 +812,8 @@ def sparse_weighted_mean(stacked: Any, weights: jax.Array, plan: SparsePlan,
         return jax.tree_util.tree_unflatten(treedef, [
             _expand_leaf(r, ix, x.shape[1:], x.dtype)
             for r, ix, x in zip(red, plan.idx, leaves)])
-    kw = dict(bucket_size=bucket_size, wire=wire, rng=rng)
+    kw = dict(bucket_size=bucket_size,
+              wire="f32" if hier_inner else wire, rng=rng)
     if masks is None:
         vec = _reduce_mat(_compress(stacked, plan), weights, **kw)
         return _expand_vec(vec, stacked, plan)
@@ -561,15 +886,20 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
                    bucket_size: int = DEFAULT_BUCKET_SIZE,
                    model_key: str = "3dcnn",
                    sample_shape: Tuple[int, ...] = (121, 145, 121, 1),
-                   impls: Tuple[str, ...] = AGG_IMPLS) -> dict:
+                   impls: Tuple[str, ...] = AGG_IMPLS,
+                   topk_density: float = 0.1, topk_sample: int = 0,
+                   hier_inner: int = 0, hier_wire: str = "bf16",
+                   overlap: bool = True) -> dict:
     """Time one weighted-mean aggregation per ``agg_impl`` on the flagship
     parameter tree stacked over ``n_clients`` (honored-mask locals at
     ``dense_ratio``), sharded over ``mesh`` when given. Methodology
     follows ``__graft_entry__._agg_realparams_probe``: in-graph
     ``fori_loop`` bodies with ``jnp.roll``-ed weights so XLA cannot hoist
     the contraction, timed over ``iters`` aggregations after a
-    compile+warmup run. Returns ``{"agg_ms_<impl>": ms, ...}`` plus the
-    workload descriptors."""
+    compile+warmup run. Returns ``{"agg_ms_<impl>": ms, ...}`` plus, per
+    timed impl, the ``obs.comm.WireCostModel``'s modeled per-device wire
+    bytes as ``wire_bytes_<impl>`` (so the gated bench history tracks
+    time AND bytes together) and the workload descriptors."""
     from ..core.state import weighted_tree_sum
     from ..models import create_model, init_params
     from ..ops.sparsity import kernel_flags
@@ -610,7 +940,8 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
     w = put(jnp.asarray(w / w.sum()))
     plan = build_sparse_plan(mask)
 
-    kw = dict(mesh=mesh, bucket_size=bucket_size)
+    kw = dict(mesh=mesh, bucket_size=bucket_size, overlap=overlap)
+    hw = "f32" if hier_wire == "sparse" else hier_wire
     agg_fns = {
         "dense": lambda st, wv, i: weighted_tree_sum(st, wv),
         "bucketed": lambda st, wv, i: weighted_mean(st, wv, wire="f32",
@@ -620,6 +951,18 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
             st, wv, wire="int8", rng=jax.random.fold_in(key, i), **kw),
         "sparse": lambda st, wv, i: sparse_weighted_mean(st, wv, plan,
                                                          wire="f32", **kw),
+        "topk": lambda st, wv, i: topk_weighted_mean(
+            st, wv, topk_density, plan=plan, sample=topk_sample,
+            **kw)[0],
+        # hier: auto slice split unless requested; int8 cross-slice wire
+        # draws its stochastic-rounding key like the int8 impl
+        "hier": lambda st, wv, i: (
+            sparse_weighted_mean(st, wv, plan, wire="f32",
+                                 hier_inner=hier_inner or -1, **kw)
+            if hier_wire == "sparse" else weighted_mean(
+                st, wv, wire=hw, hier_inner=hier_inner or -1,
+                rng=(jax.random.fold_in(key, i) if hw == "int8"
+                     else None), **kw)),
     }
 
     def time_agg(agg_fn):
@@ -633,6 +976,15 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
 
     agg_dist = obs_metrics.get_registry().distribution("agg_ms")
     result = {}
+    n_devices = (int(mesh.shape["clients"]) if mesh is not None
+                 and "clients" in mesh.axis_names else 1)
+    # modeled per-device wire bytes per impl (obs/comm.py) — recorded
+    # beside the timings so the gated history tracks ms AND bytes
+    from ..obs.comm import WireCostModel
+
+    wire_model = WireCostModel.from_params(
+        shapes, bucket_size=bucket_size, n_devices=n_devices, plan=plan,
+        topk_density=topk_density, hier_wire=hier_wire)
     for name in impls:
         if name not in agg_fns:
             # a typo'd --impls must fail loudly, not print a timing-less
@@ -642,10 +994,11 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
                 f"{tuple(agg_fns)}")
         agg_dist.labels(impl=name).observe(time_agg(agg_fns[name]) * 1e3)
         result[f"agg_ms_{name}"] = agg_dist.labels(impl=name).last
+        result[f"wire_bytes_{name}"] = wire_model.bytes_for(name)
     result.update(
-        n_params=n_params, n_clients=n_clients,
-        n_devices=(int(mesh.shape["clients"]) if mesh is not None
-                   and "clients" in mesh.axis_names else 1),
+        n_params=n_params, n_clients=n_clients, n_devices=n_devices,
         bucket_size=bucket_size, sparse_density=plan.density,
-        model_key=model_key, iters=iters)
+        topk_density=topk_density, topk_sample=topk_sample,
+        hier_wire=hier_wire, hier_inner=hier_inner,
+        overlap=int(overlap), model_key=model_key, iters=iters)
     return result

@@ -123,6 +123,20 @@ def carry_if_empty(aggregate: Any, fallback: Any,
         aggregate, fallback)
 
 
+def merge_residual(ok: jax.Array, new_rows: Any, prev_rows: Any) -> Any:
+    """Error-feedback residual × quarantine (``agg_impl='topk'``): a
+    quarantined client never shipped anything this round, and its
+    compensated delta may carry the very poison the screen caught — so
+    its residual row KEEPS the previous value. A pure row select (never
+    arithmetic): NaN in ``new_rows`` cannot propagate through it, which
+    is the 'a quarantined client's residual must not leak into later
+    rounds' invariant (tests/test_agg_topk_hier.py pins it). Clean
+    rounds (all ok) select every new row bitwise."""
+    return jax.tree_util.tree_map(
+        lambda n, p: jnp.where(_row_select(ok, n.ndim), n, p),
+        new_rows, prev_rows)
+
+
 def merge_updates(ok: jax.Array, updates: Any, personal: Any,
                   sel_idx: jax.Array) -> Any:
     """The personal-stack protection: the rows to scatter back into the

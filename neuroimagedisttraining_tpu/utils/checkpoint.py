@@ -118,7 +118,8 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self.mgr.latest_step()
 
-    def restore_latest(self, template: Any) -> Optional[Tuple[Any, int]]:
+    def restore_latest(self, template: Any,
+                       schema_hint: str = "") -> Optional[Tuple[Any, int]]:
         """Restore the newest restorable checkpoint, shaped like
         ``template`` (an ``algo.init_state(...)`` pytree); returns
         (state, round_idx) or None when the directory is empty.
@@ -128,7 +129,10 @@ class CheckpointManager:
         for) falls back to the next older retained step, logging which
         step was skipped; only when EVERY retained step fails does the
         error propagate (with the schema-mismatch diagnosis, its most
-        common cause)."""
+        common cause). ``schema_hint`` lets the caller name the
+        state-schema feature most likely to explain an all-steps
+        failure (e.g. the agg_impl='topk' error-feedback residual the
+        runner's template carries only under that impl)."""
         steps = sorted(self.mgr.all_steps(), reverse=True)
         if not steps:
             return None
@@ -155,13 +159,14 @@ class CheckpointManager:
         # every retained step failed: most common cause is a state-schema
         # change between framework versions (e.g. a new field on an
         # algorithm's State dataclass)
+        hint = f" {schema_hint}" if schema_hint else ""
         raise RuntimeError(
             f"no retained checkpoint at {self.directory} is restorable "
             f"(tried steps {steps}) — if every step fails the same way, "
             "the lineage was likely written by an older framework version "
             "whose state structure no longer matches. Restart without "
             "--resume (or point --checkpoint_dir elsewhere) to begin a "
-            "fresh lineage.") from last_err
+            f"fresh lineage.{hint}") from last_err
 
     def close(self) -> None:
         self.mgr.close()

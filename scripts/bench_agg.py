@@ -37,6 +37,23 @@ def main(argv=None) -> dict:
     p.add_argument("--impls", type=str, default="",
                    help="comma-separated agg_impl subset to time "
                         "(default: all)")
+    p.add_argument("--topk_density", type=float, default=0.1,
+                   help="shipped-coordinate fraction of the topk impl")
+    p.add_argument("--topk_sample", type=int, default=0,
+                   help="topk threshold-estimate subsample size (0 = "
+                        "exact selection; ~16384 recommended on "
+                        "sort-bound backends — see collectives."
+                        "topk_sparsify)")
+    p.add_argument("--hier_inner", type=int, default=0,
+                   help="devices per intra-slice group of the hier impl "
+                        "(0 = balanced auto split)")
+    p.add_argument("--hier_wire", type=str, default="bf16",
+                   choices=["f32", "bf16", "int8", "sparse"],
+                   help="hier's cross-slice wire")
+    p.add_argument("--overlap", type=int, default=1,
+                   help="group-ordered dispatch (collective emitted "
+                        "right after its group's contraction); 0 = the "
+                        "serialized order, for A/B timing")
     p.add_argument("--history", type=str, default="",
                    help="bench-history JSONL the per-impl timings append "
                         "to (default: results/bench_history.jsonl — the "
@@ -79,7 +96,10 @@ def main(argv=None) -> dict:
         mesh, n_clients=args.clients, iters=args.iters,
         dense_ratio=args.dense_ratio,
         bucket_size=args.bucket_size or DEFAULT_BUCKET_SIZE,
-        model_key=args.model, sample_shape=sample_shape, impls=impls)
+        model_key=args.model, sample_shape=sample_shape, impls=impls,
+        topk_density=args.topk_density, topk_sample=args.topk_sample,
+        hier_inner=args.hier_inner, hier_wire=args.hier_wire,
+        overlap=bool(args.overlap))
     out = {k: (round(v, 3) if isinstance(v, float) else v)
            for k, v in out.items()}
     print(json.dumps(out))
@@ -87,14 +107,44 @@ def main(argv=None) -> dict:
     return out
 
 
+def _impl_qual(impl: str, out: dict, unit: str) -> str:
+    """Non-default config knobs folded into the metric NAME (not just
+    ``extra``): identical metric name = identical workload is the gated
+    history's contract, so a ``--topk_density`` / ``--topk_sample`` /
+    ``--hier_inner`` / ``--hier_wire`` / ``--overlap 0`` sweep must
+    gate against its own trajectory, not get compared to (or pollute
+    the baseline of) the default config under the same name. Defaults
+    stay unqualified so the already-seeded history keeps matching.
+    Byte metrics skip the timing-only knobs (sample / overlap do not
+    change what the wire ships)."""
+    q = ""
+    if impl == "topk":
+        if out.get("topk_density", 0.1) != 0.1:
+            q += f"-tk{out['topk_density']}"
+        if unit == "ms" and out.get("topk_sample", 0):
+            q += f"-tks{out['topk_sample']}"
+    elif impl == "hier":
+        if out.get("hier_wire", "bf16") != "bf16":
+            q += f"-hw{out['hier_wire']}"
+        if out.get("hier_inner", 0):
+            q += f"-hi{out['hier_inner']}"
+    if unit == "ms" and impl != "dense" and not out.get("overlap", 1):
+        q += "-ov0"
+    return q
+
+
 def _append_history(out: dict, history: str) -> int:
-    """Append every ``agg_ms_<impl>`` timing to the bench-history
-    trajectory (the same path as bench.py's ``_emit_result``), one
-    entry per impl under a workload-qualified metric name, so
-    ``scripts/perf_gate.py`` can gate agg-microbench regressions
-    (lower-is-better — obs.regress.metric_gate_defaults resolves the
-    orientation from the ``agg_ms_`` prefix). Best-effort like the
-    bench: a read-only checkout must never fail the microbench."""
+    """Append every ``agg_ms_<impl>`` timing AND its modeled
+    ``wire_bytes_<impl>`` (obs.comm.WireCostModel, computed by
+    ``agg_microbench``) to the bench-history trajectory (the same path
+    as bench.py's ``_emit_result``), one entry per (impl, quantity)
+    under a workload-qualified metric name (:func:`_impl_qual` adds the
+    non-default impl knobs), so ``scripts/perf_gate.py``
+    gates time and bytes together (lower-is-better —
+    obs.regress.metric_gate_defaults resolves the orientation and band
+    from the ``agg_ms_`` / ``agg_bytes_`` prefixes; bytes are analytic,
+    so their band is tight). Best-effort like the bench: a read-only
+    checkout must never fail the microbench."""
     if history == "none":
         return 0
     appended = 0
@@ -107,17 +157,24 @@ def _append_history(out: dict, history: str) -> int:
         tag = (f"{out['model_key']}_c{out['n_clients']}"
                f"_d{out['n_devices']}")
         extra = {k: out[k] for k in ("n_params", "bucket_size",
-                                     "sparse_density", "iters")
+                                     "sparse_density", "topk_density",
+                                     "topk_sample", "hier_wire",
+                                     "hier_inner", "overlap", "iters")
                  if k in out}
-        for key, value in out.items():
-            if not key.startswith("agg_ms_"):
-                continue
-            impl = key[len("agg_ms_"):]
-            regress.append_history(
-                path, {"metric": f"agg_ms_{impl}_{tag}",
-                       "value": value, "unit": "ms", "extra": extra},
-                source="bench_agg", repo_root=root)
-            appended += 1
+        for prefix, metric_prefix, unit in (
+                ("agg_ms_", "agg_ms_", "ms"),
+                ("wire_bytes_", "agg_bytes_", "bytes")):
+            for key, value in out.items():
+                if not key.startswith(prefix):
+                    continue
+                impl = key[len(prefix):]
+                name = (f"{metric_prefix}{impl}"
+                        f"{_impl_qual(impl, out, unit)}_{tag}")
+                regress.append_history(
+                    path, {"metric": name,
+                           "value": value, "unit": unit, "extra": extra},
+                    source="bench_agg", repo_root=root)
+                appended += 1
     except Exception as e:  # pragma: no cover - disk/permissions
         # stderr, NOT stdout: the one-JSON-line stdout contract feeds
         # `bench_agg.py | tail -1 | perf_gate.py --from-json -`

@@ -21,7 +21,9 @@ from neuroimagedisttraining_tpu.comm.message import Message
 from neuroimagedisttraining_tpu.obs.comm import (
     message_overhead_budget,
     message_payload_nbytes,
+    topk_payload,
 )
+from neuroimagedisttraining_tpu.parallel.collectives import topk_count
 
 _DTYPES = [np.float32, np.float16, np.int32, np.uint8]
 
@@ -95,6 +97,36 @@ def test_bf16_payload_within_header_budget(tree):
     pred = message_payload_nbytes(cast)
     assert pred == sum(l.size * 2 for l in _leaves(cast))
     _check_bounds(len(raw), pred, len(_leaves(cast)))
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(),
+       shape=st.tuples(st.integers(1, 8), st.integers(1, 8)),
+       frac=st.floats(0.01, 1.0))
+def test_topk_payload_within_header_budget(data, shape, frac):
+    """The error-feedback top-k wire (PR 7): per leaf, topk_count(n,
+    frac) coordinates as int32 idx + f32 values — RESIDUAL-FREE (the
+    residual is algorithm state, never serialized). The model's 8
+    bytes/selected-coordinate prediction is exact on the raw payload;
+    the serialized Message lands within the documented budget on top."""
+    n = shape[0] * shape[1]
+    vals = np.asarray(
+        data.draw(st.lists(st.integers(-9, 9), min_size=n, max_size=n)),
+        np.float64).astype(np.float32).reshape(shape)
+    tree = {"w": vals, "b": vals.reshape(-1)[:shape[0]].copy()}
+    payload = topk_payload(tree, frac)
+    pred = sum(topk_count(int(np.prod(l.shape)), frac) * (4 + 4)
+               for l in tree.values())
+    assert message_payload_nbytes(payload) == pred
+    msg = Message("t", 0, 1)
+    msg.add_tensor("p", payload)
+    raw = msg.to_bytes()
+    _check_bounds(len(raw), pred, 2 * len(tree))  # idx + val per leaf
+    # round-trip: shipped values match the source at the shipped indices
+    back = Message.from_bytes(raw).get_tensor("p")
+    for key, leaf in tree.items():
+        np.testing.assert_array_equal(
+            back[key]["val"], leaf.reshape(-1)[back[key]["idx"]])
 
 
 @settings(max_examples=60, deadline=None)

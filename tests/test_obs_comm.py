@@ -666,16 +666,40 @@ def test_bench_agg_appends_history(tmp_path):
         "--devices", "1", "--impls", "dense,bf16",
         "--history", hist])
     assert "agg_ms_dense" in out and "agg_ms_bf16" in out
+    # modeled wire bytes recorded beside the timings (PR 7): the gated
+    # history tracks time AND bytes per impl
+    assert out["wire_bytes_bf16"] == out["wire_bytes_dense"] / 2
     entries = regress.read_history(hist)
     metrics = {e["metric"] for e in entries}
     tag = f"small3dcnn_c4_d{out['n_devices']}"
-    assert metrics == {f"agg_ms_dense_{tag}", f"agg_ms_bf16_{tag}"}
+    assert metrics == {f"agg_ms_dense_{tag}", f"agg_ms_bf16_{tag}",
+                       f"agg_bytes_dense_{tag}", f"agg_bytes_bf16_{tag}"}
     for e in entries:
-        assert e["source"] == "bench_agg" and e["unit"] == "ms"
+        assert e["source"] == "bench_agg"
         assert e["extra"]["n_params"] == out["n_params"]
-        # the microbench metrics gate lower-is-better by prefix
-        assert regress.metric_gate_defaults(e["metric"]) == {
-            "higher_is_better": False}
+        if e["metric"].startswith("agg_ms_"):
+            assert e["unit"] == "ms"
+            # the microbench timings gate lower-is-better by prefix
+            assert regress.metric_gate_defaults(e["metric"]) == {
+                "higher_is_better": False}
+        else:
+            assert e["unit"] == "bytes"
+            # bytes are analytic — lower-is-better with a tight band
+            d = regress.metric_gate_defaults(e["metric"])
+            assert d["higher_is_better"] is False
+            assert d["rel_threshold"] < 0.05
+    # non-default impl knobs qualify the metric NAME, so a sweep run
+    # gates against its own trajectory, not the default config's
+    # (identical name = identical workload); timing-only knobs (sample,
+    # overlap) stay out of the byte metric's name
+    out2 = bench_agg.main([
+        "--model", "small3dcnn", "--clients", "4", "--iters", "1",
+        "--devices", "1", "--impls", "topk", "--topk_density", "0.2",
+        "--topk_sample", "64", "--overlap", "0", "--history", hist])
+    assert "agg_ms_topk" in out2
+    metrics2 = {e["metric"] for e in regress.read_history(hist)}
+    assert f"agg_ms_topk-tk0.2-tks64-ov0_{tag}" in metrics2
+    assert f"agg_bytes_topk-tk0.2_{tag}" in metrics2
 
 
 # ---------------------------------------------------------------------------
