@@ -1,0 +1,112 @@
+"""Finding records, the reviewed suppression baseline, and reports.
+
+A finding is one analyzer verdict with a *stable suppression key* —
+``rule:file:detail`` where ``detail`` is the offending source line
+(stripped) for AST findings or a rule-specific symbol for the semantic
+analyzers. Keys deliberately exclude line numbers: a baseline pinned to
+line numbers rots on every unrelated edit, which is how hand-maintained
+suppression lists (the old ``CONTRACT_PATHS``) drift.
+
+The baseline file (``results/lint_baseline.json``, committed — see the
+``.gitignore`` negation) pins pre-existing deliberate findings with a
+one-line justification each. Suppressions are exact-key matches; a
+baseline entry matching nothing is itself a finding (``stale-baseline``)
+so the file stays an honest ledger instead of a grave of dead excuses.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: baseline schema version (bump on incompatible key-format changes)
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str          # analyzer rule id, e.g. "bare-assert"
+    file: str          # repo-relative path ("" for repo-level findings)
+    line: int          # 1-based line (0 when not line-anchored)
+    message: str       # human explanation with the fix direction
+    detail: str = ""   # stable key component (source line / symbol)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.file}:{self.detail}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "key": self.key}
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else (
+            self.file or "<repo>")
+        return f"[{self.rule}] {loc}: {self.message}"
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """``key -> justification``. Missing file = empty baseline (a fresh
+    checkout with no pinned findings). Malformed JSON or a schema drift
+    raises ``ValueError`` — the gate maps that to exit code 2 (config
+    error), never to a silent all-clear."""
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"unreadable lint baseline {path}: {e}") from e
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"lint baseline {path}: expected version {BASELINE_VERSION}, "
+            f"got {doc.get('version') if isinstance(doc, dict) else doc!r}")
+    out: Dict[str, str] = {}
+    for e in doc.get("entries", ()):
+        if not isinstance(e, dict) or "key" not in e \
+                or not str(e.get("justification", "")).strip():
+            raise ValueError(
+                f"lint baseline {path}: every entry needs a key and a "
+                f"non-empty one-line justification, got {e!r}")
+        out[str(e["key"])] = str(e["justification"])
+    return out
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, str],
+) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """``(live, suppressed, stale)``: findings not pinned, findings
+    pinned by the baseline, and synthetic ``stale-baseline`` findings
+    for pins that matched nothing this run (fix: delete the entry)."""
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            seen.add(f.key)
+        else:
+            live.append(f)
+    stale = [
+        Finding(rule="stale-baseline", file="", line=0, detail=key,
+                message=f"baseline entry matched no finding this run "
+                        f"(delete it from the baseline): {key!r}")
+        for key in baseline if key not in seen]
+    return live, suppressed, stale
+
+
+def render_report(live: Sequence[Finding], suppressed: Sequence[Finding],
+                  stale: Sequence[Finding],
+                  analyzers: Sequence[str],
+                  notes: Optional[Sequence[str]] = None) -> str:
+    lines = [f"lint_gate: analyzers={','.join(analyzers)} "
+             f"findings={len(live)} suppressed={len(suppressed)} "
+             f"stale_baseline={len(stale)}"]
+    for note in notes or ():
+        lines.append(f"  note: {note}")
+    for f in list(live) + list(stale):
+        lines.append("  " + f.render())
+    if not live and not stale:
+        lines.append("  clean")
+    return "\n".join(lines)

@@ -1,0 +1,390 @@
+"""Jaxpr auditor: dtype, callback, and SPMD-collective contracts.
+
+Traces the central algorithms' ``_round_jit`` and fused-scan entry
+points with ``jax.make_jaxpr`` on tiny synthetic shapes (trace only —
+no training compute; CPU-safe on the 8-virtual-device test mesh) and
+checks the contracts the runtime tests can only sample:
+
+* **dtype whitelist** — no f64 promotion anywhere in the round jaxpr.
+  The TPU-native dtype set is f32/bf16/i8/i32/u32/bool (+ PRNG key
+  dtypes); a stray Python float or np scalar that promotes under x64
+  doubles wire and HBM cost silently.
+* **no host callbacks on the hot path** — ``pure_callback`` /
+  ``io_callback`` / ``debug_callback`` primitives serialize the round
+  against the host; the fused-scan design exists to remove exactly
+  that.
+* **collective consistency** — the SPMD race-detector analog this
+  codebase needs: the multiset of collective primitives (``psum`` /
+  ``psum2``, ``all_gather``, ``ppermute``, ``reduce_scatter``, ...)
+  with their axis names must be (a) identical between the fused and
+  unfused round programs and (b) identical across the branches of
+  every ``lax.cond`` (the guard's clean/quarantine split, watchdog
+  retry gating). A branch-dependent collective deadlocks real
+  multi-host SPMD — the exact hazard the PR-2 recovery docs flag as
+  "per-process retry would break SPMD collective matching". On the
+  CPU sim every process traces both branches identically, so only a
+  static check can see the divergence before pod hardware does.
+* **donation audit** (report, not findings) — every jit entry point
+  without ``donate_argnums`` and the state bytes it re-allocates per
+  call: the measurement ROADMAP Open item 2's donation refactor
+  starts from. Reported, not gated: today *no* entry point donates
+  (the bench/test harnesses re-run from saved states, so donation
+  needs the explicit ownership protocol first).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+#: explicit collective primitives (shard_map spells psum as psum2)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "pmin", "pmax", "pgather", "pbroadcast",
+})
+
+#: dtypes legal on the round hot path (str(aval.dtype)); PRNG key
+#: dtypes (``key<fry>`` etc.) are matched by prefix
+DTYPE_WHITELIST = frozenset({
+    "float32", "bfloat16", "int8", "int32", "uint32", "bool",
+    "float0",  # jax's zero-tangent marker, never materialized
+})
+
+
+def _dtype_ok(d: str) -> bool:
+    return d in DTYPE_WHITELIST or d.startswith("key<")
+
+
+class JaxprSummary:
+    """Recursive walk of one traced program."""
+
+    def __init__(self) -> None:
+        self.collectives: Counter = Counter()   # (prim, axes) -> count
+        self.dtypes: Dict[str, str] = {}        # dtype -> first path
+        self.callbacks: List[Tuple[str, str]] = []
+        self.cond_mismatches: List[Tuple[str, List[dict]]] = []
+
+    @staticmethod
+    def _axes_key(eqn) -> str:
+        axes = eqn.params.get("axes",
+                              eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        key = ",".join(str(a) for a in axes)
+        if eqn.params.get("axis_index_groups") is not None:
+            key += "|grouped"
+        return key
+
+    @staticmethod
+    def _sub_jaxprs(eqn):
+        for name, v in eqn.params.items():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                # ClosedJaxpr first: it forwards .eqns, so the order
+                # matters (unwrapping gets the invars/outvars too)
+                if hasattr(item, "jaxpr") and \
+                        hasattr(item.jaxpr, "eqns"):  # ClosedJaxpr
+                    yield name, item.jaxpr
+                elif hasattr(item, "eqns"):           # core.Jaxpr
+                    yield name, item
+
+    def _record_dtypes(self, jaxpr, path: str) -> None:
+        for v in list(jaxpr.invars) + list(jaxpr.constvars) + \
+                list(jaxpr.outvars):
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None:
+                self.dtypes.setdefault(str(dt), path)
+
+    def walk(self, jaxpr, path: str = "") -> Counter:
+        """Returns this subtree's collective multiset (used by the
+        cond-branch comparison) while accumulating globals."""
+        local: Counter = Counter()
+        self._record_dtypes(jaxpr, path)
+        for eqn in jaxpr.eqns:
+            nm = eqn.primitive.name
+            for v in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(v, "aval", None)
+                dt = getattr(aval, "dtype", None)
+                if dt is not None:
+                    self.dtypes.setdefault(str(dt), f"{path}/{nm}")
+            if nm in COLLECTIVE_PRIMS:
+                local[(nm, self._axes_key(eqn))] += 1
+            if "callback" in nm:
+                self.callbacks.append((nm, path))
+            if nm == "cond":
+                branches: List[Counter] = []
+                for sub_name, sub in self._sub_jaxprs(eqn):
+                    branches.append(self.walk(
+                        sub, f"{path}/cond.{sub_name}"))
+                sigs = {tuple(sorted(b.items())) for b in branches}
+                if len(sigs) > 1:
+                    self.cond_mismatches.append(
+                        (path or "<top>",
+                         [dict(b) for b in branches]))
+                for b in branches:
+                    local.update(b)
+            else:
+                for sub_name, sub in self._sub_jaxprs(eqn):
+                    local.update(self.walk(
+                        sub, f"{path}/{nm}.{sub_name}"))
+        return local
+
+    def collective_multiset(self) -> Dict[str, int]:
+        total: Counter = Counter()
+        # note: cond branches were verified identical (or reported),
+        # so counting every branch once each is the per-execution
+        # multiset scaled by branch count — equal across programs with
+        # equal structure, which is what the parity check compares
+        return {f"{p}@{a}": c
+                for (p, a), c in sorted(self.collectives.items())}
+
+
+def summarize(fn: Callable, *args, x64: bool = False) -> JaxprSummary:
+    """Trace ``fn(*args)`` (no compute) and summarize its jaxpr.
+
+    ``x64=True`` traces under ``jax.experimental.enable_x64`` so latent
+    f64 promotions (Python floats, np scalars) surface as f64 in the
+    jaxpr instead of being silently demoted by the global x64-off
+    default — the mode the seeded-violation fixtures run in."""
+    import jax
+
+    ctx = jax.experimental.enable_x64() if x64 \
+        else contextlib.nullcontext()
+    with ctx:
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    s = JaxprSummary()
+    total = s.walk(jaxpr.jaxpr)
+    s.collectives = total
+    return s
+
+
+def audit_summary(s: JaxprSummary, label: str) -> List[Finding]:
+    """The per-program contract findings for one traced entry point."""
+    out: List[Finding] = []
+    for dt, path in sorted(s.dtypes.items()):
+        if not _dtype_ok(dt):
+            out.append(Finding(
+                rule="jaxpr-dtype", file=label, line=0,
+                detail=f"{dt}",
+                message=f"{label}: dtype {dt} at {path or '<top>'} is "
+                        "outside the hot-path whitelist "
+                        "(f32/bf16/i8/i32/u32/bool) — an accidental "
+                        "promotion doubles wire and HBM cost"))
+    for nm, path in s.callbacks:
+        out.append(Finding(
+            rule="jaxpr-callback", file=label, line=0,
+            detail=f"{nm}@{path}",
+            message=f"{label}: host callback primitive {nm} at "
+                    f"{path or '<top>'} serializes the round against "
+                    "the host — hoist it out of the jitted body"))
+    for path, branches in s.cond_mismatches:
+        out.append(Finding(
+            rule="jaxpr-cond-collective", file=label, line=0,
+            detail=f"cond@{path}",
+            message=f"{label}: lax.cond at {path} has branch-dependent "
+                    f"collectives {branches} — a data-dependent branch "
+                    "choice deadlocks multi-host SPMD (all processes "
+                    "must issue the identical collective sequence)"))
+    return out
+
+
+# -- central-algorithm audit ------------------------------------------------
+
+def build_central_algo(name: str, agg_impl: str = "bucketed",
+                       n_clients: int = 8, use_mesh: bool = True):
+    """A tiny audit instance of fedavg/salientgrads with the guard on
+    (so the quarantine ``lax.cond`` is in the program) and a collective-
+    emitting ``agg_impl``, its training data sharded over the test mesh
+    so ``_aggregate`` takes the ``shard_map`` path."""
+    import jax
+
+    from ..algorithms import FedAvg, SalientGrads
+    from ..core.state import HyperParams
+    from ..data import make_synthetic_federated
+    from ..models import create_model
+    from ..parallel import make_mesh, shard_over_clients
+
+    data = make_synthetic_federated(
+        n_clients=n_clients, samples_per_client=8, test_per_client=4,
+        sample_shape=(8, 8, 8, 1))
+    n_dev = len(jax.devices())
+    mesh = None
+    if use_mesh and n_dev >= 2:
+        n_axis = n_dev if n_clients % n_dev == 0 else 2
+        mesh = make_mesh(n_axis)
+        data = data.replace(
+            x_train=shard_over_clients(data.x_train, mesh),
+            y_train=shard_over_clients(data.y_train, mesh),
+            n_train=shard_over_clients(data.n_train, mesh))
+    hp = HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9,
+                     local_epochs=1, steps_per_epoch=1, batch_size=8)
+    cls = {"fedavg": FedAvg, "salientgrads": SalientGrads}[name]
+    algo = cls(create_model("small3dcnn", num_classes=1), data, hp,
+               loss_type="bce", frac=1.0, seed=0, agg_impl=agg_impl,
+               guard=True)
+    return algo, mesh
+
+
+def round_args(algo, state=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if state is None:
+        state = algo.init_state(jax.random.PRNGKey(0))
+    sel = jnp.asarray(np.arange(algo.num_clients, dtype=np.int32))
+    d = algo.data
+    return (state, sel, jnp.asarray(0.0, jnp.float32),
+            d.x_train, d.y_train, d.n_train)
+
+
+def fused_args(algo, state, block: int = 2):
+    """Args for a fused block program. The eval cadence is baked into
+    the traced program by ``_get_fused_fn(block, eval_every)``, not
+    the argument list — callers pair this with that call."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    host = [algo._fused_host_inputs(r) for r in range(block)]
+    host_stack = tuple(
+        jnp.asarray(np.stack([h[i] for h in host]))
+        for i in range(len(host[0])))
+    round_ids = jnp.arange(block, dtype=jnp.float32)
+    d = algo.data
+    return (state, host_stack, round_ids, *algo._fused_data_args(),
+            d.x_test, d.y_test, d.n_test)
+
+
+def audit_central_algorithm(
+    name: str, agg_impl: str = "bucketed", block: int = 2,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Full audit of one algorithm: unfused round + fused block traced,
+    per-program contracts checked, fused-vs-unfused collective multiset
+    equality proven, donation report assembled."""
+    import jax
+
+    algo, mesh = build_central_algo(name, agg_impl=agg_impl)
+    if name == "salientgrads":
+        state = algo.init_state(jax.random.PRNGKey(0))
+        algo._ensure_agg_plan(state)
+    else:
+        state = algo.init_state(jax.random.PRNGKey(0))
+    rargs = round_args(algo, state)
+    unfused = summarize(algo._round_jit, *rargs)
+    fused_fn = algo._get_fused_fn(block, 1)
+    fargs = fused_args(algo, state, block=block)
+    fused = summarize(fused_fn, *fargs)
+
+    label_u = f"jaxpr:{name}:round"
+    label_f = f"jaxpr:{name}:fused"
+    findings = audit_summary(unfused, label_u) + \
+        audit_summary(fused, label_f)
+    mu = unfused.collective_multiset()
+    mf = fused.collective_multiset()
+    if mu != mf:
+        findings.append(Finding(
+            rule="jaxpr-collective-parity", file=f"jaxpr:{name}",
+            line=0, detail="fused-vs-unfused",
+            message=f"{name}: collective multiset differs between the "
+                    f"fused scan ({mf}) and the unfused round ({mu}) — "
+                    "a fused block on a pod would issue a different "
+                    "collective sequence than the per-round path it is "
+                    "bit-pinned against"))
+    report = {
+        "algorithm": name,
+        "agg_impl": agg_impl,
+        "on_mesh": mesh is not None,
+        "collectives_round": mu,
+        "collectives_fused": mf,
+        "dtypes_round": sorted(unfused.dtypes),
+        "dtypes_fused": sorted(fused.dtypes),
+        "donation": donation_audit(algo, state, rargs),
+    }
+    return findings, report
+
+
+# -- donation audit ---------------------------------------------------------
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(
+        int(getattr(x, "size", 0)) * int(getattr(x, "dtype", None)
+                                         and x.dtype.itemsize or 0)
+        for x in jax.tree_util.tree_leaves(tree))
+
+
+def _donated_args(fn, args) -> Optional[List[bool]]:
+    """Per-argument donation flags via ``Lowered.args_info`` (trace
+    only, no compile). None when this jax version hides them."""
+    import jax
+
+    try:
+        info = fn.lower(*args).args_info
+        return [bool(a.donated)
+                for a in jax.tree_util.tree_leaves(
+                    info, is_leaf=lambda x: hasattr(x, "donated"))]
+    except Exception:
+        return None
+
+
+def donation_audit(algo, state, rargs) -> List[Dict[str, Any]]:
+    """Rows: every jit entry point, whether any argument is donated,
+    and the state bytes a non-donated call re-allocates (the [C, model]
+    personal stack dominates — RESULTS.md item 6's ~7%-of-round full
+    rewrite)."""
+    import jax
+
+    d = algo.data
+    state_bytes = _tree_bytes(state)
+    entries: List[Tuple[str, Any, Tuple, int]] = [
+        ("_round_jit", algo._round_jit, rargs, state_bytes),
+    ]
+    if hasattr(algo, "_finetune_jit"):
+        entries.append(("_finetune_jit", algo._finetune_jit,
+                        (state, d.x_train, d.y_train, d.n_train),
+                        state_bytes))
+    if hasattr(algo, "_global_mask_jit"):
+        entries.append((
+            "_global_mask_jit", algo._global_mask_jit,
+            (state.global_params, d.x_train, d.y_train, d.n_train,
+             jax.random.PRNGKey(0)),
+            _tree_bytes(state.global_params)))
+    entries.append(("_eval_global", algo._eval_global,
+                    (state.global_params, d.x_test, d.y_test, d.n_test),
+                    0))  # eval outputs are scalars; nothing to donate
+    if state.personal_params is not None:
+        entries.append(("_eval_personal", algo._eval_personal,
+                        (state.personal_params, d.x_test, d.y_test,
+                         d.n_test), 0))
+    fused_fn = algo._get_fused_fn(2, 1)
+    entries.append(("fused[2,1]", fused_fn,
+                    fused_args(algo, state, 2), state_bytes))
+    rows = []
+    for name, fn, args, realloc in entries:
+        flags = _donated_args(fn, args)
+        donated = any(flags) if flags else False
+        rows.append({
+            "entry_point": f"{algo.name}.{name}",
+            "donated": donated,
+            "donation_introspection": flags is not None,
+            "state_bytes": realloc,
+            "realloc_bytes_per_call": 0 if donated else realloc,
+        })
+    return rows
+
+
+def audit_algorithms(
+    names: Sequence[str] = ("fedavg", "salientgrads"),
+    agg_impl: str = "bucketed",
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    findings: List[Finding] = []
+    reports: Dict[str, Any] = {}
+    for name in names:
+        f, rep = audit_central_algorithm(name, agg_impl=agg_impl)
+        findings.extend(f)
+        reports[name] = rep
+    return findings, reports

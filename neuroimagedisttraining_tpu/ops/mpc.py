@@ -119,7 +119,12 @@ def lcc_encode(
     alpha nodes for the N workers. Returns [N, len//K, ...]."""
     rng = rng or np.random.RandomState()
     m = x.shape[0]
-    assert m % k_split == 0, "leading axis must divide into K chunks"
+    if m % k_split:
+        # explicit raise, not assert: python -O must not strip the
+        # shape contract of the secure-sum encoding (ADVICE r5)
+        raise ValueError(
+            f"LCC encoding needs the leading axis ({m}) to divide "
+            f"into K={k_split} chunks")
     chunk = m // k_split
     subs = [np.mod(np.asarray(x[i * chunk:(i + 1) * chunk], np.int64), p)
             for i in range(k_split)]

@@ -1,0 +1,143 @@
+"""Identity-inertness gate (analysis/identity.py): the real config must
+cross-reference cleanly, and each failure mode — a leaked obs flag, an
+unclassified flag, classification drift, a stale table entry — must
+produce its finding on a fixture config."""
+import textwrap
+
+from neuroimagedisttraining_tpu.analysis import identity
+
+#: a minimal config.py-shaped fixture: flag registry + run_identity
+FIXTURE = textwrap.dedent("""
+    def build_parser(p):
+        p.add_argument("--model", type=str, default="3dcnn")
+        p.add_argument("--lr", type=float, default=1e-3)
+        p.add_argument("--obs", type=int, default=0)
+        p.add_argument("--obs_comm", type=int, default=0)
+        p.add_argument("--mystery_knob", type=int, default=0)
+        return p
+
+
+    def run_identity(args, for_checkpoint=False):
+        parts = [args.model, f"lr{args.lr:g}"]
+        return "-".join(parts)
+""")
+
+FIXTURE_CLASSES = {
+    "model": ("identity", "identity component"),
+    "lr": ("identity", "identity component"),
+    "obs": ("inert", "telemetry"),
+    "obs_comm": ("inert", "telemetry"),
+    "mystery_knob": ("unkeyed", "fixture"),
+}
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_fixture_config_clean_with_matching_classes():
+    assert identity.audit_config_source(
+        FIXTURE, classes=FIXTURE_CLASSES) == []
+
+
+def test_real_config_cross_references_clean():
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "neuroimagedisttraining_tpu")
+    fs = identity.audit_package(pkg)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_real_config_classifies_every_flag():
+    """Completeness the clean-audit already implies, stated directly:
+    every registered flag is in exactly one bucket."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "neuroimagedisttraining_tpu")
+    with open(os.path.join(pkg, "experiments", "config.py")) as f:
+        flags = identity.collect_flags(f.read())
+    unclassified = sorted(set(flags) - set(identity.FLAG_CLASSES))
+    assert unclassified == []
+
+
+def test_leaked_obs_flag_fails():
+    """An obs flag appended to the identity string must fail even when
+    the classification table says inert (the hard prefix rule)."""
+    leaked = FIXTURE.replace(
+        'parts = [args.model, f"lr{args.lr:g}"]',
+        'parts = [args.model, f"lr{args.lr:g}", f"o{args.obs_comm}"]')
+    fs = identity.audit_config_source(leaked, classes=FIXTURE_CLASSES)
+    assert _rules(fs) == ["identity-leak"]
+    assert fs[0].detail == "obs_comm"
+
+
+def test_leaked_obs_flag_fails_even_if_table_says_identity():
+    """A misedited table cannot authorize a telemetry leak: the
+    obs/flight prefix rule is enforced regardless."""
+    leaked = FIXTURE.replace(
+        'parts = [args.model, f"lr{args.lr:g}"]',
+        'parts = [args.model, f"lr{args.lr:g}", f"o{args.obs}"]')
+    classes = dict(FIXTURE_CLASSES, obs=("identity", "bogus"))
+    fs = identity.audit_config_source(leaked, classes=classes)
+    assert _rules(fs) == ["identity-leak"]
+
+
+def test_unclassified_flag_fails():
+    src = FIXTURE.replace(
+        'p.add_argument("--mystery_knob", type=int, default=0)',
+        'p.add_argument("--mystery_knob", type=int, default=0)\n'
+        '    p.add_argument("--new_flag", type=int, default=0)')
+    fs = identity.audit_config_source(src, classes=FIXTURE_CLASSES)
+    assert _rules(fs) == ["identity-unclassified"]
+    assert fs[0].detail == "new_flag"
+
+
+def test_identity_classified_but_unread_is_drift():
+    classes = dict(FIXTURE_CLASSES,
+                   mystery_knob=("identity", "should be keyed"))
+    fs = identity.audit_config_source(FIXTURE, classes=classes)
+    assert _rules(fs) == ["identity-drift"]
+
+
+def test_unkeyed_flag_read_by_identity_is_leak():
+    src = FIXTURE.replace(
+        'parts = [args.model, f"lr{args.lr:g}"]',
+        'parts = [args.model, f"lr{args.lr:g}", '
+        'str(args.mystery_knob)]')
+    fs = identity.audit_config_source(src, classes=FIXTURE_CLASSES)
+    assert _rules(fs) == ["identity-leak"]
+
+
+def test_stale_class_entry_flagged():
+    classes = dict(FIXTURE_CLASSES,
+                   removed_flag=("inert", "gone"))
+    fs = identity.audit_config_source(FIXTURE, classes=classes)
+    assert _rules(fs) == ["identity-stale-class"]
+
+
+def test_extras_table_keys_are_not_identity_reads():
+    """_IDENTITY_EXTRAS maps ALGO NAMES to flag tuples; only the
+    values are reads — a future flag sharing an algo name must not be
+    silently treated as identity-read."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "neuroimagedisttraining_tpu")
+    with open(os.path.join(pkg, "experiments", "config.py")) as f:
+        reads = identity.identity_reads(f.read())
+    for algo_key in ("dispfl", "ditto", "dpsgd", "subavg",
+                     "turboaggregate", "salientgrads"):
+        assert algo_key not in reads, algo_key
+    assert "dense_ratio" in reads and "lamda" in reads
+
+
+def test_getattr_reads_count_as_identity_reads():
+    src = FIXTURE.replace(
+        'parts = [args.model, f"lr{args.lr:g}"]',
+        'parts = [args.model, f"lr{args.lr:g}"]\n'
+        '    if getattr(args, "mystery_knob", 0):\n'
+        '        parts.append("mk")')
+    fs = identity.audit_config_source(src, classes=FIXTURE_CLASSES)
+    assert _rules(fs) == ["identity-leak"]

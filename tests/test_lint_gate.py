@@ -1,0 +1,157 @@
+"""The lint gate as a tier-1 test (runs the full gate in-process) plus
+the three seeded violations from the acceptance criteria: a bare assert
+in a contract module, an f64-promoting op in a round-body fixture, and
+an obs flag added to run identity — each must exit 1 through the
+``scripts/lint_gate.py`` CLI itself."""
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "neuroimagedisttraining_tpu")
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "jaxpr_fixtures.py")
+
+
+def _gate_main(argv):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint_gate
+    finally:
+        sys.path.pop(0)
+    return lint_gate.main(argv)
+
+
+def _copy_pkg(tmp_path):
+    """A linting copy of the package tree under the SAME basename, so
+    the baseline's pre-existing pins keep matching and the only live
+    finding is the seeded one."""
+    dst = tmp_path / "neuroimagedisttraining_tpu"
+    shutil.copytree(
+        PKG, dst,
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+    return dst
+
+
+def test_full_gate_exits_0_on_head(eight_devices):
+    """The tier-1 contract: the complete gate — astlint, identity,
+    xfail hygiene, jaxpr audit of fedavg+salientgrads on the test mesh
+    — is clean on HEAD (pre-existing deliberate findings ride the
+    reviewed baseline)."""
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    verdict = gate.run_gate()
+    assert verdict["exit_code"] == 0, verdict["report"]
+    assert verdict["findings"] == []
+    assert verdict["stale"] == []
+    # the baseline is load-bearing, not vestigial
+    assert len(verdict["suppressed"]) >= 5
+    # every analyzer actually ran
+    assert verdict["reports"]["astlint"]["modules"] > 80
+    assert verdict["reports"]["identity"]["ran"]
+    assert verdict["reports"]["xfail"]["ran"]
+    assert verdict["reports"]["jaxpr"]["fedavg"]["on_mesh"]
+    # and the flagship SPMD pin held for both algorithms
+    for algo in ("fedavg", "salientgrads"):
+        rep = verdict["reports"]["jaxpr"][algo]
+        assert rep["collectives_round"] == rep["collectives_fused"]
+
+
+def test_seeded_bare_assert_exits_1(tmp_path):
+    dst = _copy_pkg(tmp_path)
+    guard = dst / "robust" / "guard.py"
+    guard.write_text(guard.read_text()
+                     + "\n\ndef _seeded(x):\n    assert x\n")
+    rc = _gate_main(["--only", "astlint", "--pkg-root", str(dst),
+                     "--json", str(tmp_path / "v.json")])
+    assert rc == 1
+    verdict = json.loads((tmp_path / "v.json").read_text())
+    assert [f["rule"] for f in verdict["findings"]] == ["bare-assert"]
+    assert "robust/guard.py" in verdict["findings"][0]["file"]
+
+
+def test_seeded_f64_round_body_exits_1(tmp_path):
+    rc = _gate_main(["--only", "jaxpr",
+                     "--jaxpr-fixture", f"{FIXTURES}::f64_round",
+                     "--x64", "--json", str(tmp_path / "v.json")])
+    assert rc == 1
+    verdict = json.loads((tmp_path / "v.json").read_text())
+    assert any(f["rule"] == "jaxpr-dtype" and "float64" in f["key"]
+               for f in verdict["findings"])
+
+
+def test_seeded_obs_flag_in_identity_exits_1(tmp_path):
+    cfg = tmp_path / "config.py"
+    src = open(os.path.join(PKG, "experiments", "config.py")).read()
+    anchor = "    if args.tag:"
+    assert anchor in src
+    cfg.write_text(src.replace(
+        anchor, "    parts.append(f\"obs{args.obs_comm}\")\n" + anchor))
+    rc = _gate_main(["--only", "identity", "--config", str(cfg),
+                     "--json", str(tmp_path / "v.json")])
+    assert rc == 1
+    verdict = json.loads((tmp_path / "v.json").read_text())
+    assert [f["rule"] for f in verdict["findings"]] == ["identity-leak"]
+    assert verdict["findings"][0]["key"].endswith("obs_comm")
+
+
+def test_clean_fixture_exits_0(tmp_path):
+    rc = _gate_main(["--only", "jaxpr",
+                     "--jaxpr-fixture", f"{FIXTURES}::clean_round"])
+    assert rc == 0
+
+
+def test_bad_baseline_is_config_error_not_clean(tmp_path):
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    bad = tmp_path / "baseline.json"
+    bad.write_text("{not json")
+    verdict = gate.run_gate(only=("astlint",),
+                            baseline_path=str(bad))
+    assert verdict["exit_code"] == 2
+
+
+def test_unknown_analyzer_is_config_error():
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    verdict = gate.run_gate(only=("astlint", "nonsense"))
+    assert verdict["exit_code"] == 2
+
+
+def test_changed_only_skips_unrelated_analyzers():
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    verdict = gate.run_gate(changed_files=["README.md"])
+    assert verdict["exit_code"] == 0
+    assert not verdict["reports"]["identity"]["ran"]
+    assert not verdict["reports"]["xfail"]["ran"]
+    assert not verdict["reports"]["jaxpr"].get("ran", True)
+
+
+def test_changed_only_runs_identity_when_config_changes():
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    verdict = gate.run_gate(
+        only=("astlint", "identity", "xfail"),
+        changed_files=[
+            "neuroimagedisttraining_tpu/experiments/config.py"])
+    assert verdict["exit_code"] == 0
+    assert verdict["reports"]["identity"]["ran"]
+    assert not verdict["reports"]["xfail"]["ran"]
+
+
+def test_tampered_xfail_ledger_exits_1(tmp_path):
+    from neuroimagedisttraining_tpu.analysis import gate
+
+    real = json.load(open(
+        os.path.join(REPO, "tests", "xfail_ledger.json")))
+    real["entries"] = real["entries"][1:]  # un-pin one xfail
+    tampered = tmp_path / "ledger.json"
+    tampered.write_text(json.dumps(real))
+    verdict = gate.run_gate(only=("xfail",),
+                            xfail_ledger=str(tampered))
+    assert verdict["exit_code"] == 1
+    assert [f["rule"] for f in verdict["findings"]] == ["xfail-ledger"]
