@@ -166,6 +166,9 @@ def _timed_rounds(algo, state, n_rounds=10, eval_every_round=False):
 
     from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 
+    # ownership: the harness CONSUMES the state chain (run_round donates
+    # under donate_state); callers re-running several harnesses from one
+    # saved state pass algo.clone_state(state) — the borrow API
     state, _ = algo.run_round(state, 0)
     if eval_every_round:
         float(_acc(algo.evaluate(state)))  # compile outside timed region
@@ -205,14 +208,25 @@ def _timed_rounds_fused(algo, state, n_rounds=10, eval_every=0):
     # warmups must replay the timed call verbatim, not a sibling
     from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 
+    # ownership: each fused dispatch CONSUMES its input state under
+    # donate_state, and the warmups + timed call all replay the SAME
+    # call — so every dispatch gets a borrowed clone (cloned OUTSIDE
+    # the timed region; the caller's state survives for later cells)
+    donating = getattr(algo, "_donate", False)
+
+    def borrowed():
+        return algo.clone_state(state) if donating else state
+
     for w in range(3):
-        state_w, ys = algo.run_rounds_fused(state, n_rounds, n_rounds,
+        state_w, ys = algo.run_rounds_fused(borrowed(), n_rounds,
+                                            n_rounds,
                                             eval_every=eval_every)
         ys.materialize()
         _sync_state(state_w)
+    s_in = borrowed()
     with obs_metrics.get_registry().timer("bench_timed_rounds_fused") \
             as tm:
-        state, ys = algo.run_rounds_fused(state, n_rounds, n_rounds,
+        state, ys = algo.run_rounds_fused(s_in, n_rounds, n_rounds,
                                           eval_every=eval_every)
         # one transfer materializes every round's metrics; the packed
         # stack is a scan output, so its arrival proves the block completed
@@ -277,10 +291,15 @@ def main(uneven: bool = False, test_per_client: int = None):
         chunk = int(os.environ["BENCH_CHUNK"]) or None
     remat = bool(int(os.environ.get("BENCH_REMAT", "0")))
     fused = bool(int(os.environ.get("BENCH_FUSED", "0")))
+    # donate_state: the state-ownership protocol (the product default —
+    # the round's [C, model] stack aliases in place instead of being
+    # re-allocated); harness re-runs from `state` go through the
+    # clone_state borrow API below
     algo = SalientGrads(model, data, hp, loss_type="bce", frac=1.0, seed=0,
                         client_chunk=chunk, dense_ratio=0.5,
                         itersnip_iterations=1, compute_dtype="bfloat16",
-                        remat_local=remat, fused_kernels=fused)
+                        remat_local=remat, fused_kernels=fused,
+                        donate_state=True)
     state = algo.init_state(jax.random.PRNGKey(0))  # includes the SNIP pass
     def _try_fused(a, s, **kw):
         """Fused-spelling timing, or None when the K-round program does
@@ -299,25 +318,51 @@ def main(uneven: bool = False, test_per_client: int = None):
                   flush=True)
             return None
 
-    rps_loop = _timed_rounds(algo, state)
+    rps_loop = _timed_rounds(algo, algo.clone_state(state))
     # eval-inclusive rate: the same workload at frequency_of_the_test=1
     # — since r5 this prices the FULL reference protocol (global +
     # per-client personal models, sailentgrads_api.py:262-283)
-    rps_with_eval_loop = _timed_rounds(algo, state, n_rounds=8,
+    rps_with_eval_loop = _timed_rounds(algo, algo.clone_state(state),
+                                       n_rounds=8,
                                        eval_every_round=True)
     # fused round loop (run_rounds_fused): K rounds as one program —
     # semantically identical (tests/test_fused_rounds.py), dispatch/fetch
     # amortized. The headline is the better of the two spellings; both
-    # are recorded.
+    # are recorded. (_timed_rounds_fused borrows per dispatch itself.)
     rps_fused = _try_fused(algo, state, n_rounds=10)
     rps_with_eval_fused = _try_fused(algo, state, n_rounds=8, eval_every=1)
+    # the donated fused runs rebound algo.data to the aliased outputs;
+    # re-read it so the instances below see valid arrays, not the
+    # donated originals
+    data = algo.data
+    # --eval_cache cell: the in-state incremental personal eval — the
+    # eval_every=1 protocol pays O(trained-clients) forwards per round
+    # instead of O(C) per eval (full participation here makes it a
+    # wash on FORWARD count; the win it prices is the per-round eval
+    # program shrinking to the cache re-reduce)
+    algo_ec = SalientGrads(model, data, hp, loss_type="bce", frac=1.0,
+                           seed=0, client_chunk=chunk, dense_ratio=0.5,
+                           itersnip_iterations=1,
+                           compute_dtype="bfloat16",
+                           remat_local=remat, fused_kernels=fused,
+                           donate_state=True, eval_cache=True)
+    state_ec = algo_ec.init_state(jax.random.PRNGKey(0))
+    rps_eval_cache_fused = _try_fused(algo_ec, state_ec, n_rounds=8,
+                                      eval_every=1)
+    rps_eval_cache_loop = _timed_rounds(
+        algo_ec, algo_ec.clone_state(state_ec), n_rounds=8,
+        eval_every_round=True)
+    data = algo_ec.data
+    rps_eval_cache = max(x for x in (rps_eval_cache_loop,
+                                     rps_eval_cache_fused)
+                         if x is not None)
     # secondary: the global-only half (what r3/r4 benches priced) — a
     # personal-less instance isolates the personal half's cost
     algo_g = SalientGrads(model, data, hp, loss_type="bce", frac=1.0,
                           seed=0, client_chunk=chunk, dense_ratio=0.5,
                           itersnip_iterations=1, compute_dtype="bfloat16",
                           remat_local=remat, fused_kernels=fused,
-                          track_personal=False)
+                          track_personal=False, donate_state=True)
     state_g = algo_g.init_state(jax.random.PRNGKey(0))
     # best-of-both-spellings, SAME selection rule as the full-protocol
     # number — mixing spellings would corrupt the personal-half delta
@@ -348,6 +393,10 @@ def main(uneven: bool = False, test_per_client: int = None):
         "extra": {
             # full reference eval protocol (global + personal halves)
             "rounds_per_sec_eval_every_1": round(rps_with_eval, 4),
+            # same protocol with the in-state incremental eval cache
+            # (--eval_cache): the RESULTS.md Round-14 A/B cell
+            "rounds_per_sec_eval_every_1_eval_cache": round(
+                rps_eval_cache, 4),
             # global-only eval (the r3/r4 definition), kept as secondary
             "rounds_per_sec_eval_every_1_global_only": round(
                 rps_eval_global_only, 4),
@@ -507,6 +556,111 @@ def tracked_config(name: str):
         # primary eval-free rate is the tracked number).
         N_CLIENTS = 32
         return main(test_per_client=4)
+    if name == "cohort":
+        # Cohort-scale cell (ROADMAP Open item 2 / ISSUE 9): C=32/64/
+        # 128/256 synthetic small-model cohorts on one chip through the
+        # DONATED fused path with the in-state eval cache — the
+        # "hundreds of clients per chip" configuration whose OOM line
+        # this PR's fused-carry restructure moves. Per-round trained
+        # work is held constant (8 clients/round at every C) so the
+        # sweep isolates cohort RESIDENCY: rounds/sec plus the peak-
+        # device-memory ledger (obs/memory.py — memory_stats peak on
+        # TPU/GPU, live-arrays watermark on CPU), both appended to the
+        # gated results/bench_history.jsonl (perf_gate prefix rules:
+        # cohort_mem_bytes_* lower-is-better).
+        from neuroimagedisttraining_tpu.algorithms import FedAvg
+        from neuroimagedisttraining_tpu.core.state import HyperParams
+        from neuroimagedisttraining_tpu.models import create_model
+        from neuroimagedisttraining_tpu.obs import memory as obs_memory
+        from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+        from neuroimagedisttraining_tpu.obs import regress
+
+        sizes = tuple(int(c) for c in os.environ.get(
+            "BENCH_COHORTS", "32,64,128,256").split(","))
+        n_per, vol = 8, (16, 16, 16)
+        block = int(os.environ.get("BENCH_COHORT_BLOCK", "4"))
+        rounds = int(os.environ.get("BENCH_COHORT_ROUNDS", "8"))
+        # at least one whole block, and whole blocks only (a remainder
+        # would make the timed region's round count disagree with the
+        # dispatched blocks; flooring to zero would append a 0.0
+        # rounds/sec cell to the gated history)
+        rounds = max(block, rounds - rounds % block)
+        hp = HyperParams(lr=1e-3, momentum=0.9, local_epochs=1,
+                         steps_per_epoch=2, batch_size=4)
+        model = create_model("small3dcnn", num_classes=1)
+        root = os.path.dirname(os.path.abspath(__file__))
+        history = os.path.join(root, "results", "bench_history.jsonl")
+        cells = {}
+        for n_clients in sizes:
+            data = _device_synth_data(
+                n_clients, n_per, vol, jax.random.PRNGKey(0),
+                model_key="small3dcnn", test_per_client=4)
+            algo = FedAvg(model, data, hp, loss_type="bce",
+                          frac=min(1.0, 8.0 / n_clients), seed=0,
+                          compute_dtype="bfloat16", donate_state=True,
+                          eval_cache=True)
+            state = algo.init_state(jax.random.PRNGKey(0))
+            # warmup block (compile), then timed whole blocks
+            state, ys = algo.run_rounds_fused(state, 0, block,
+                                              eval_every=1)
+            ys.materialize()
+            _sync_state(state)
+            with obs_metrics.get_registry().timer(
+                    f"bench_cohort_c{n_clients}") as tm:
+                r0 = block
+                while r0 < block + rounds:
+                    state, ys = algo.run_rounds_fused(
+                        state, r0, block, eval_every=1)
+                    r0 += block
+                ys.materialize()
+                _sync_state(state)
+            rps = rounds / tm.elapsed
+            devs = obs_memory.device_memory()
+            # the GATED per-cell number is bytes_in_use sampled while
+            # THIS cohort is live (earlier cohorts were deleted, so it
+            # attributes to this C). peak_bytes_in_use is a PROCESS-
+            # LIFETIME high-watermark on memory_stats backends — it
+            # never resets between cells, so a big early cell would
+            # bleed into every later cell's gate; it stays
+            # informational in the extras only.
+            in_use = max((d["bytes_in_use"] for d in devs), default=0)
+            peak = max((d.get("peak_bytes_in_use", d["bytes_in_use"])
+                        for d in devs), default=0)
+            cells[f"c{n_clients}"] = {
+                "rounds_per_sec": round(rps, 4),
+                "mem_bytes": int(in_use),
+                "mem_peak_process_bytes": int(peak),
+                "mem_source": devs[0]["source"] if devs
+                else "unavailable",
+            }
+            for metric, value, unit in (
+                    (f"cohort_rounds_per_sec_c{n_clients}", rps,
+                     "rounds/sec"),
+                    (f"cohort_mem_bytes_c{n_clients}", float(in_use),
+                     "bytes")):
+                try:
+                    regress.append_history(
+                        history, {"metric": metric, "value": value,
+                                  "unit": unit},
+                        source="bench_cohort", repo_root=root)
+                except Exception as e:  # read-only checkout
+                    import sys
+
+                    print(f"# cohort history append skipped: {e}",
+                          file=sys.stderr, flush=True)
+            del data, algo, state, ys  # free this cohort before the next
+        biggest = f"c{max(sizes)}"
+        result = {
+            "metric": ("fedavg_cohort_rounds_per_sec_small3dcnn_"
+                       f"{biggest}_fused_evcache"),
+            "value": cells[biggest]["rounds_per_sec"],
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,  # scaling cell, not a rate target
+            "extra": {"cells": cells, "block": block,
+                      "trained_per_round": 8, "volume": list(vol),
+                      "n_devices": len(jax.devices())},
+        }
+        return _emit_result(result)
     if name == "uneven":
         # primary workload with uneven shards ([20,40] samples/client): the
         # masked epoch path — per-example weights, no-op step selects —

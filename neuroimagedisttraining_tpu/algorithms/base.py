@@ -10,6 +10,7 @@ round_idx)`` before sampling, ``fedavg_api.py:92-100``) and (b) logs metrics.
 from __future__ import annotations
 
 import abc
+import contextlib
 import logging
 import time
 from typing import Any, Dict, List, Optional
@@ -25,6 +26,36 @@ from ..models import make_apply_fn
 from ..obs import trace as obs_trace
 
 logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def _no_persistent_cache_write():
+    """Donated executables must not round-trip the persistent
+    compilation cache: on this jaxlib (0.4.37, XLA:CPU) a DESERIALIZED
+    donated executable carries corrupt input-output-aliasing metadata —
+    executing one reloaded from a warm cache corrupts the heap (a
+    resumed run whose twin populated the cache dies in the cache read
+    or at a later allocation). ``jax_enable_compilation_cache`` cannot
+    gate this per call (``compilation_cache.is_cache_used`` memoizes
+    its first read), but the WRITE threshold
+    ``jax_persistent_cache_min_compile_time_secs`` is consulted on
+    every ``_cache_write`` — raising it to +inf around a donated
+    compile keeps the donated executable out of the cache, and since a
+    donated program's HLO (which carries the aliasing) hashes to its
+    own cache key, its lookups then always miss and compile fresh.
+    No retrace, no effect on in-memory executables or on borrowing
+    entry points. Remove when upstream serialization handles
+    aliasing."""
+    name = "jax_persistent_cache_min_compile_time_secs"
+    prev = getattr(jax.config, name, None)
+    if prev is None:
+        yield
+        return
+    jax.config.update(name, float("inf"))
+    try:
+        yield
+    finally:
+        jax.config.update(name, prev)
 
 
 def _personal_metrics(correct, loss_sum, total):
@@ -133,6 +164,7 @@ class FedAlgorithm(abc.ABC):
         fault_spec: str = "",
         guard: Optional[bool] = None,
         obs_numerics: bool = False,
+        donate_state: bool = False,
     ):
         from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
 
@@ -321,6 +353,38 @@ class FedAlgorithm(abc.ABC):
             model, compute_dtype=self.compute_dtype,
             channel_inject=channel_inject)
         self.eval_client = make_eval_fn(self.apply_fn, loss_type, eval_batch)
+        # donate_state: the state-ownership protocol (README "State
+        # ownership & donation"). When on (and the algorithm declares
+        # donate_supported), the round/finetune/fused/mask entry points
+        # take OWNERSHIP of their input state via donate_argnums — the
+        # [C, model] personal stack (and topk residual / eval cache)
+        # aliases in place instead of being rewritten into a fresh
+        # (1+C)-model allocation every call. The caller's input state is
+        # INVALID after the call; any caller that deliberately re-runs
+        # from a saved state must borrow a copy via clone_state first.
+        # Bit-identical to the borrow path (aliasing only) — inert for
+        # run identity; pinned by tests/test_donation.py.
+        self._donate = bool(donate_state) and self.donate_supported
+        # eval_cache: the in-state incremental personal-eval cache
+        # (subclasses that support it set self.eval_cache before
+        # super().__init__; everyone else is False). Validated here so
+        # an unsupported combination dies at construction.
+        self.eval_cache = bool(getattr(self, "eval_cache", False))
+        if self.eval_cache:
+            if not getattr(self, "track_personal", True):
+                raise ValueError(
+                    f"{self.name}: eval_cache caches the per-client "
+                    "personal-eval terms — it needs the personal stack "
+                    "(track_personal=True)")
+            if self._eval_idx is not None:
+                raise ValueError(
+                    f"{self.name}: eval_cache indexes the full [C] "
+                    "cohort; the sampled-eval subset (eval_clients) "
+                    "composes poorly with it — use one or the other")
+            # the O(S) in-graph row eval of the round body; an attr so
+            # the forward-count test can wrap it and pin the width
+            self._eval_cache_rows = self._vmap_clients(
+                self.eval_client, in_axes=(0, 0, 0, 0))
         self._fused_cache: Dict[Any, Any] = {}  # (block, eval_every) -> jit
         self._personal_cache_reset()
         self._build()
@@ -348,8 +412,10 @@ class FedAlgorithm(abc.ABC):
         de-abstracting ``evaluate`` removed."""
         impl = getattr(self, "_eval_impl", None)
         if impl is not None:
-            # traceable: full personal eval in-graph
-            return impl(state, x_test, y_test, n_test, self._eval_personal)
+            # traceable: the in-state eval cache when it is live (the
+            # O(C)-forwards-free re-reduce), else the full personal eval
+            pf = self._cache_personal_fn(state) or self._eval_personal
+            return impl(state, x_test, y_test, n_test, pf)
         raise NotImplementedError(
             f"{type(self).__name__} must implement eval_metrics (traceable"
             " eval over explicit test arrays), _eval_impl, or override"
@@ -367,8 +433,11 @@ class FedAlgorithm(abc.ABC):
         d = self.data
         impl = getattr(self, "_eval_impl", None)
         if impl is not None:
-            return impl(state, d.x_test, d.y_test, d.n_test,
-                        self._personal_eval_cached)
+            # in-state eval cache first (jitted [C] re-reduce, zero
+            # forwards), then the host-side incremental cache
+            pf = self._cache_personal_fn(state, jit=True) \
+                or self._personal_eval_cached
+            return impl(state, d.x_test, d.y_test, d.n_test, pf)
         return self.eval_metrics(state, d.x_test, d.y_test, d.n_test)
 
     def finalize(self, state: Any):
@@ -405,6 +474,58 @@ class FedAlgorithm(abc.ABC):
     #: checkpointed, and a topk lineage is NOT interchangeable with
     #: other impls' checkpoints (run_identity splits it).
     topk_supported: bool = False
+
+    #: whether this algorithm's jit entry points honor ``donate_state``
+    #: (FedAvg/SalientGrads/Ditto — the central-aggregate rounds whose
+    #: round bodies return every state field, so donation aliases the
+    #: whole state in place). Requires the base ``_fused_data_args``
+    #: layout: the donating fused program returns the threaded data
+    #: arrays and ``run_rounds_fused`` rebinds ``self.data`` from them.
+    donate_supported: bool = False
+
+    def clone_state(self, state: Any) -> Any:
+        """Borrow API of the state-ownership protocol: a deep on-device
+        copy of ``state``. Under ``donate_state`` every round/fused/
+        finetune call CONSUMES its input state, so a caller that still
+        needs the original afterwards — the watchdog's last-good, a
+        bench harness re-running from a saved state, an equivalence
+        gate replaying both spellings from one s0 — clones first and
+        donates the clone (or donates the original and keeps the
+        clone). A same-size copy when donation is off too, so caller
+        code stays mode-independent."""
+        return jax.tree_util.tree_map(jnp.copy, state)
+
+    def _jit_entry(self, fn, donate=0):
+        """jit an entry point under the ownership protocol:
+        ``donate_argnums=donate`` when this instance donates, plain jit
+        otherwise. Entry points donated here must return (or pass
+        through) every input-state leaf so XLA can alias each donated
+        buffer to an output — an unmatched donated leaf degrades to a
+        copy-with-warning, never to corruption. Donated entries call
+        through :func:`_no_persistent_cache_write` (a corrupt
+        deserialized donated executable crashes the process — see its
+        docstring); ``.lower`` is forwarded for the jaxpr donation
+        audit's ``args_info`` introspection."""
+        if not self._donate:
+            return jax.jit(fn)
+        jitted = jax.jit(fn, donate_argnums=donate)
+
+        def entry(*args):
+            # every donated entry here is fixed-shape (one compilation
+            # per fn: the round's cohort/sel shapes are static, each
+            # fused (block, eval_every) is its own fn), so after the
+            # first successful call the guard — which briefly mutates
+            # process-global jax.config — is skipped
+            if entry._compiled:
+                return jitted(*args)
+            with _no_persistent_cache_write():
+                out = jitted(*args)
+            entry._compiled = True
+            return out
+
+        entry._compiled = False
+        entry.lower = jitted.lower
+        return entry
 
     def cost_trained_clients_per_round(self) -> int:
         """Client training passes one round actually runs (cost accounting).
@@ -1064,6 +1185,78 @@ class FedAlgorithm(abc.ABC):
 
         return eval_personal
 
+    # -- in-state incremental personal eval (--eval_cache) --------------------
+    # The host-side cache above cannot ride the fused scan (its validity
+    # is object identity) and dies with the process. eval_cache moves
+    # the per-client (correct, loss_sum, total) terms INTO algorithm
+    # state: the round body evaluates ONLY the trained clients' post-
+    # guard personal rows and scatters them into the cache — O(S)
+    # forwards per round instead of O(C) per eval — and the eval (host
+    # or in the fused cond branch) is a [C] re-reduce with ZERO
+    # forwards. Because the cache is state, it checkpoints, resumes,
+    # rides the fused carry, and rolls back with the watchdog (a
+    # rolled-back round's cache rows are discarded with the state —
+    # a poisoned attempt can never leave a row behind). Quarantined
+    # clients keep their previous personal rows (merge_updates), so
+    # their re-evaluated cache rows reproduce the previous values:
+    # poison-free by construction. State-schema change: eval_cache
+    # lineages split both identities ('evcache' — the r5 track_personal
+    # / PR-7 agg_residual migration pattern).
+
+    def _seed_eval_cache(self, personal):
+        """Initial cache: one full personal eval of the fresh stack
+        (a one-time O(C) pass at init; every later round pays O(S))."""
+        if not self.eval_cache or personal is None:
+            return None
+        d = self.data
+        ev = self._eval_personal(personal, d.x_test, d.y_test, d.n_test)
+        return {"correct": ev["correct"], "loss_sum": ev["loss_sum"],
+                "total": ev["total"]}
+
+    def _update_eval_cache(self, cache, new_personal, sel_idx,
+                           x_test, y_test, n_test):
+        """In-graph cache refresh (round body): evaluate the selected
+        clients' (post-guard) personal rows, scatter into the cache.
+        Full participation updates every row in place (the sel gathers
+        would materialize a second stack copy — same hazard as the
+        training-data gathers)."""
+        if cache is None:
+            return None
+        from ..core.state import tree_index
+
+        with jax.named_scope("eval_cache"):
+            if self.clients_per_round == self.num_clients:
+                c, ls, t = self._eval_cache_rows(
+                    new_personal, x_test, y_test, n_test)
+                return {"correct": c, "loss_sum": ls, "total": t}
+            sub = tree_index(new_personal, sel_idx)
+            c, ls, t = self._eval_cache_rows(
+                sub, jnp.take(x_test, sel_idx, axis=0),
+                jnp.take(y_test, sel_idx, axis=0),
+                jnp.take(n_test, sel_idx))
+            return {"correct": cache["correct"].at[sel_idx].set(c),
+                    "loss_sum": cache["loss_sum"].at[sel_idx].set(ls),
+                    "total": cache["total"].at[sel_idx].set(t)}
+
+    def _cache_personal_fn(self, state, jit: bool = False):
+        """The personal-eval fn backed by ``state.eval_cache`` (the
+        zero-forwards [C] re-reduce), or None when the cache is off or
+        not live on this state (e.g. post-finetune, where the stack was
+        retrained wholesale and finalize dropped the stale cache) — the
+        caller then falls back to the full/host-cached eval."""
+        cache = getattr(state, "eval_cache", None)
+        if not self.eval_cache or cache is None:
+            return None
+        if jit and not hasattr(self, "_pers_metrics_fn"):
+            self._pers_metrics_fn = jax.jit(_personal_metrics)
+        fn = self._pers_metrics_fn if jit else _personal_metrics
+
+        def from_cache(_pers, _x, _y, _n):
+            return fn(cache["correct"], cache["loss_sum"],
+                      cache["total"])
+
+        return from_cache
+
     # -- fused multi-round execution ------------------------------------------
     #: True for algorithms whose only host-side per-round work is the
     #: seeded client draw; their whole round block can run as ONE jitted
@@ -1091,34 +1284,47 @@ class FedAlgorithm(abc.ABC):
 
     def _get_fused_fn(self, block: int, eval_every: int):
         """Build (and cache per (block, eval_every)) the jitted K-round
-        program: ``lax.scan`` over ``_round_jit`` with the eval cadence
+        program: ``lax.scan`` over the round body with the eval cadence
         folded in-graph via ``lax.cond`` (zero host round-trips inside a
         block; the reference's ``frequency_of_the_test`` cadence,
-        main_sailentgrads.py:90)."""
+        main_sailentgrads.py:90).
+
+        Memory structure (the C=32 OOM fix): the cohort data (and, when
+        the eval cadence or the eval cache consumes them, the test
+        arrays) ride the scan CARRY as explicit pass-through loop state
+        instead of closed-over body constants. A closure constant of a
+        scan body lowers to a while-loop invariant that XLA must COPY
+        into the loop's buffer space when the jit parameter cannot be
+        aliased — the "second cohort copy" that OOMed the C=32 cell
+        (bench.py ``_try_fused``). As loop state returned unchanged, the
+        buffers alias in-place through the loop; with ``donate_state``
+        the whole chain aliases — jit parameter -> loop state -> output
+        (the program returns the threaded arrays, and
+        ``run_rounds_fused`` rebinds ``self.data`` to the aliased
+        outputs so the caller's view stays valid)."""
         cache = self._fused_cache
         key = (block, eval_every)
         if key in cache:
             return cache[key]
         n_host = len(self._fused_host_inputs(0))
         n_data = len(self._fused_data_args())
+        # test arrays enter the loop only when consumed (eval cadence
+        # in-graph, or the per-round eval-cache update); an eval-free
+        # block without the cache drops them entirely so they are not
+        # made loop-resident for nothing
+        use_test = bool(eval_every) or self.eval_cache
+        # calling the RAW round fn (not its jitted wrapper) inside the
+        # scan body: same primitives inlined, and it keeps a donated
+        # _round_jit's donate_argnums from being re-interpreted inside
+        # an outer trace
+        round_call = getattr(self, "_round_fn", None) or self._round_jit
 
         def fused(state, host_stack, round_ids, *args):
-            data_args = args[:n_data]
-            test_args = args[n_data:]
-
-            def eval_branch(s):
-                return {k: v for k, v in
-                        self.eval_metrics(s, *test_args).items()
-                        if not k.startswith("acc_per")}
-
-            def zero_branch(s):
-                shapes = jax.eval_shape(eval_branch, s)
-                return jax.tree_util.tree_map(
-                    lambda t: jnp.zeros(t.shape, t.dtype), shapes)
-
-            def body(s, xs):
+            def body(carry, xs):
+                s, data_args, test_args = carry
                 hins, r = xs[:n_host], xs[n_host]
-                out = self._round_jit(s, *hins, r, *data_args)
+                extra = test_args if self.eval_cache else ()
+                out = round_call(s, *hins, r, *data_args, *extra)
                 s, metrics = out[0], out[1:]
                 # fail fast if a subclass's _round_jit outputs drifted from
                 # its _round_metric_names — dict(zip(...)) would silently
@@ -1132,13 +1338,28 @@ class FedAlgorithm(abc.ABC):
                         f"has {len(self._round_metric_names)}")
                 ys = dict(zip(self._round_metric_names, metrics))
                 if eval_every:
+                    # branches defined HERE so the test arrays they read
+                    # are the carry's loop-state views, not hoisted
+                    # closure constants (the second-copy hazard again)
+                    def eval_branch(sb):
+                        return {k: v for k, v in
+                                self.eval_metrics(sb, *test_args).items()
+                                if not k.startswith("acc_per")}
+
+                    def zero_branch(sb):
+                        shapes = jax.eval_shape(eval_branch, sb)
+                        return jax.tree_util.tree_map(
+                            lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+
                     do = (r.astype(jnp.int32) + 1) % eval_every == 0
                     ys["eval"] = jax.lax.cond(
                         do, eval_branch, zero_branch, s)
-                return s, ys
+                return (s, data_args, test_args), ys
 
-            state, ys = jax.lax.scan(
-                body, state, host_stack + (round_ids,))
+            carry0 = (state, args[:n_data],
+                      args[n_data:] if use_test else ())
+            (state, data_out, test_out), ys = jax.lax.scan(
+                body, carry0, host_stack + (round_ids,))
             # pack every per-round scalar series into ONE f32 array: the
             # host materializes a block's metrics in a single transfer
             # (on a tunneled TPU each leaf fetch costs ~110 ms — measured
@@ -1157,9 +1378,21 @@ class FedAlgorithm(abc.ABC):
             packed = jnp.stack([
                 x.astype(jnp.float32)
                 for x in jax.tree_util.tree_leaves(ys)])
+            if self._donate:
+                # return the threaded arrays so every donated input has
+                # an aliasable output (run_rounds_fused rebinds
+                # self.data to these — the caller's data stays valid)
+                return state, ys, packed, data_out + test_out
             return state, ys, packed
 
-        fn = cache[key] = jax.jit(fused)
+        if self._donate:
+            donated = (0,) + tuple(range(
+                3, 3 + n_data + (3 if use_test else 0)))
+            # _jit_entry: donation + the persistent-cache guard +
+            # forwarded .lower for the donation audit
+            fn = cache[key] = self._jit_entry(fused, donate=donated)
+        else:
+            fn = cache[key] = jax.jit(fused)
         return fn
 
     def run_rounds_fused(self, state: Any, start_round: int,
@@ -1176,6 +1409,13 @@ class FedAlgorithm(abc.ABC):
         (tests/test_fused_rounds.py pins it); the win is dispatch/fetch
         amortization: one program launch and one metric materialization
         per block instead of per round.
+
+        Ownership: under ``donate_state`` this call CONSUMES ``state``
+        (and the current ``self.data`` arrays — they are donated into
+        the scan carry and ``self.data`` is rebound to the aliased
+        outputs). Callers re-running from a saved state must
+        ``clone_state`` first; callers holding the pre-call data arrays
+        must re-read them from ``self.data``.
         """
         if not self.supports_fused:
             raise ValueError(
@@ -1194,11 +1434,29 @@ class FedAlgorithm(abc.ABC):
         round_ids = jnp.arange(
             start_round, start_round + n_rounds, dtype=jnp.float32)
         fn = self._get_fused_fn(n_rounds, eval_every)
-        state, ys, packed = fn(
+        out = fn(
             state, host_stack, round_ids,
             *self._fused_data_args(), self.data.x_test,
             self.data.y_test, self.data.n_test)
+        if self._donate:
+            state, ys, packed, rets = out
+            self._adopt_fused_args(rets)
+        else:
+            state, ys, packed = out
         return state, FusedMetrics(ys, packed)
+
+    def _adopt_fused_args(self, rets) -> None:
+        """Rebind ``self.data`` to the donated fused program's aliased
+        pass-through outputs (same buffers, fresh valid handles). The
+        base ``_fused_data_args`` layout (x/y/n train) is the
+        donate_supported contract; the test triplet is present exactly
+        when the program consumed it."""
+        n_data = len(self._fused_data_args())
+        d, t = rets[:n_data], rets[n_data:]
+        kw = dict(x_train=d[0], y_train=d[1], n_train=d[2])
+        if t:
+            kw.update(x_test=t[0], y_test=t[1], n_test=t[2])
+        self.data = self.data.replace(**kw)
 
     def _fused_block_loop(self, state, start_round: int, total: int,
                           block: int, eval_every: int, on_record,
@@ -1255,6 +1513,16 @@ class FedAlgorithm(abc.ABC):
         try:
             for r0 in range(start_round, total, block):
                 k = min(block, total - r0)
+                if pending is not None and self._donate:
+                    # ownership: the next dispatch CONSUMES the pending
+                    # block's output state, which flush still reads
+                    # (cost snapshot, block-boundary checkpoint) — so a
+                    # donating loop flushes BEFORE dispatching. The
+                    # dispatch-ahead pipelining below is the borrow
+                    # path's; what donation loses is only the overlap of
+                    # host record emission with the next block's compute
+                    p, pending = pending, None
+                    flush(p)
                 with obs_trace.span("fused_block_dispatch") as sp:
                     sp.add("start_round", r0)
                     state, ys = self.run_rounds_fused(

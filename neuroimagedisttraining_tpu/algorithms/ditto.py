@@ -38,6 +38,7 @@ class DittoState:
 class Ditto(FedAlgorithm):
     name = "ditto"
     supports_fused = True
+    donate_supported = True
     _round_metric_names = ("train_loss", "personal_train_loss")
 
     def cost_trained_clients_per_round(self) -> int:
@@ -99,7 +100,8 @@ class Ditto(FedAlgorithm):
                 jnp.mean(p_losses),
             )
 
-        self._round_jit = jax.jit(round_fn)
+        self._round_fn = round_fn
+        self._round_jit = self._jit_entry(round_fn)
         self._eval_global = self._make_global_eval()
         self._eval_personal = self._make_personal_eval()
 
@@ -114,6 +116,9 @@ class Ditto(FedAlgorithm):
 
     def run_round(self, state: DittoState, round_idx: int):
         sel = self._selected_client_indexes(round_idx)
+        # read BEFORE dispatch: under donate_state the call consumes
+        # `state` (the ownership lint holds driver paths to this order)
+        old_pers = state.personal_params
         new_state, g_loss, p_loss = self._round_jit(
             state, jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
             self.data.x_train, self.data.y_train, self.data.n_train,
@@ -121,7 +126,7 @@ class Ditto(FedAlgorithm):
         # only the selected clients' personal legs trained — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
         self._note_personal_update(
-            state.personal_params, new_state.personal_params, sel)
+            old_pers, new_state.personal_params, sel)
         return new_state, {"train_loss": g_loss,
                            "personal_train_loss": p_loss}
 

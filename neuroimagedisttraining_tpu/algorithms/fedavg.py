@@ -50,6 +50,13 @@ class FedAvgState:
     # topk lineage is identity-split from the other impls, whose states
     # have no residual — the r5 track_personal migration pattern).
     agg_residual: Any = None
+    # per-client personal-eval cache {correct[C], loss_sum[C], total[C]}
+    # (--eval_cache), or None when off. Real state: the round body
+    # refreshes only the trained clients' rows (O(S) forwards), evals
+    # re-reduce it with zero forwards, it rides the fused scan carry,
+    # and it checkpoints — an evcache lineage splits identity (the same
+    # r5/topk state-structure rule).
+    eval_cache: Any = None
 
 
 class FedAvg(FedAlgorithm):
@@ -58,14 +65,18 @@ class FedAvg(FedAlgorithm):
     guard_metrics_supported = True
     numerics_supported = True
     topk_supported = True
+    donate_supported = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
-                 **kwargs):
+                 eval_cache: bool = False, **kwargs):
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
         self.defense = defense
         # track_personal=False drops the on-device w_per_mdls stack (and the
         # final fine-tune that exists to produce it) — O(C x model) HBM
         self.track_personal = track_personal
+        # eval_cache: the in-state incremental personal-eval cache
+        # (base.py "--eval_cache" section); validated in the base ctor
+        self.eval_cache = bool(eval_cache)
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -77,7 +88,7 @@ class FedAvg(FedAlgorithm):
         )
 
         def round_fn(state: FedAvgState, sel_idx, round_idx,
-                     x_train, y_train, n_train):
+                     x_train, y_train, n_train, *test_args):
             rng, round_key = jax.random.split(state.rng)
             new_global, locals_, mean_loss, fstats, new_residual = \
                 self._train_selected_weighted(
@@ -89,6 +100,13 @@ class FedAvg(FedAlgorithm):
                 )
             new_personal = self._guarded_personal_update(
                 state.personal_params, locals_, sel_idx, fstats)
+            # --eval_cache: refresh ONLY the trained clients' cache rows
+            # from their post-guard personal rows (quarantined rows
+            # re-evaluate their kept previous models — poison-free)
+            new_cache = state.eval_cache
+            if self.eval_cache:
+                new_cache = self._update_eval_cache(
+                    state.eval_cache, new_personal, sel_idx, *test_args)
             # in-jit numerics telemetry (--obs_numerics): pure readout
             # on the round's live arrays, () when off
             nums = self._numerics_outputs(
@@ -96,10 +114,12 @@ class FedAvg(FedAlgorithm):
             return self._round_outputs(
                 FedAvgState(global_params=new_global,
                             personal_params=new_personal, rng=rng,
-                            agg_residual=new_residual),
+                            agg_residual=new_residual,
+                            eval_cache=new_cache),
                 mean_loss, fstats, nums)
 
-        self._round_jit = jax.jit(round_fn)
+        self._round_fn = round_fn
+        self._round_jit = self._jit_entry(round_fn)
 
         def finetune_fn(state: FedAvgState, x_train, y_train, n_train):
             """Final fine-tune: every client trains once from the final
@@ -113,44 +133,59 @@ class FedAvg(FedAlgorithm):
                 self.client_update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0)
             )(params0, mom0, params0, keys, x_train, y_train, n_train,
               jnp.asarray(-1.0, jnp.float32), params0)
+            # eval_cache passes through for donation aliasing; finalize
+            # drops it on the host (the fine-tune retrained EVERY row,
+            # so the cache is stale wholesale)
             return FedAvgState(global_params=state.global_params,
                                personal_params=params_out, rng=rng,
-                               agg_residual=state.agg_residual)
+                               agg_residual=state.agg_residual,
+                               eval_cache=state.eval_cache)
 
-        self._finetune_jit = jax.jit(finetune_fn)
+        self._finetune_jit = self._jit_entry(finetune_fn)
         self._eval_global = self._make_global_eval()
         self._eval_personal = self._make_personal_eval()
 
     def init_state(self, rng: jax.Array) -> FedAvgState:
         p_rng, s_rng = jax.random.split(rng)
         params = init_params(self.model, p_rng, self.init_sample_shape)
+        personal = (broadcast_tree(params, self.num_clients)
+                    if self.track_personal else None)
         return FedAvgState(
             global_params=params,
-            personal_params=(broadcast_tree(params, self.num_clients)
-                             if self.track_personal else None),
+            personal_params=personal,
             rng=s_rng,
             # topk: zero residual per client (same [C, model] HBM
             # footprint caveat as personal_params)
             agg_residual=(zeros_like_tree(
                 broadcast_tree(params, self.num_clients))
                 if self.agg_impl == "topk" else None),
+            # --eval_cache: seed with one full personal eval (one-time
+            # O(C); every later round refreshes O(S) rows in-graph)
+            eval_cache=self._seed_eval_cache(personal),
         )
 
     def run_round(self, state: FedAvgState, round_idx: int):
         sel = self._selected_client_indexes(round_idx)
+        d = self.data
+        # read BEFORE dispatch: under donate_state the call consumes
+        # `state` (the host cache only compares object identity, but
+        # the ownership lint holds driver paths to read-before-donate)
+        old_pers = state.personal_params
+        extra = ((d.x_test, d.y_test, d.n_test)
+                 if self.eval_cache else ())
         # dispatch-time span (async): the round's device phases are
         # labeled by named_scope inside the jitted body instead
         with obs_trace.span("dispatch_round"):
             out = self._round_jit(
                 state, jnp.asarray(sel),
                 jnp.asarray(round_idx, jnp.float32),
-                self.data.x_train, self.data.y_train, self.data.n_train,
+                d.x_train, d.y_train, d.n_train, *extra,
             )
         new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
         self._note_personal_update(
-            state.personal_params, new_state.personal_params, sel)
+            old_pers, new_state.personal_params, sel)
         return new_state, dict(zip(self._round_metric_names, out[1:]))
 
     def finalize(self, state: FedAvgState):
@@ -162,6 +197,11 @@ class FedAvg(FedAlgorithm):
             state = self._finetune_jit(
                 state, self.data.x_train, self.data.y_train,
                 self.data.n_train)
+        if self.eval_cache:
+            # the fine-tune retrained EVERY personal row: the cache is
+            # stale wholesale — drop it so evaluate falls back to the
+            # full personal eval (None marks "not live on this state")
+            state = state.replace(eval_cache=None)
         ev = self.evaluate(state)
         record = {"round": -1, "finetune": True,
                   **{k: v for k, v in ev.items()

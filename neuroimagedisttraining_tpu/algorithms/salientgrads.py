@@ -57,6 +57,9 @@ class SalientGradsState:
     # exact zeros on dead coordinates: the top-k selection (compressed
     # to the plan's live set) can never ship a dead coordinate.
     agg_residual: Any = None
+    # per-client personal-eval cache (--eval_cache), or None — see
+    # FedAvgState.eval_cache (same semantics, same lineage split)
+    eval_cache: Any = None
 
 
 class SalientGrads(FedAlgorithm):
@@ -66,13 +69,15 @@ class SalientGrads(FedAlgorithm):
     numerics_supported = True
     numerics_with_mask = True
     topk_supported = True
+    donate_supported = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
                  fused_kernels: bool = False, snip_mask: bool = True,
                  stratified_sampling: bool = False,
                  stratified_mode: str = "exact",
-                 track_personal: bool = True, **kwargs):
+                 track_personal: bool = True,
+                 eval_cache: bool = False, **kwargs):
         self.dense_ratio = dense_ratio
         self.itersnip_iterations = itersnip_iterations
         # optional robust.RobustAggregator (fedml_core/robustness wiring)
@@ -97,6 +102,9 @@ class SalientGrads(FedAlgorithm):
         # track_personal=False drops the on-device w_per_mdls stack and the
         # personal half of the per-round eval — O(C x model) HBM
         self.track_personal = track_personal
+        # eval_cache: the in-state incremental personal-eval cache
+        # (base.py "--eval_cache" section); validated in the base ctor
+        self.eval_cache = bool(eval_cache)
         super().__init__(*args, **kwargs)
 
     def _build(self) -> None:
@@ -161,12 +169,16 @@ class SalientGrads(FedAlgorithm):
             mean_scores = jax.tree_util.tree_map(
                 lambda s: jnp.mean(s, axis=0), scores
             )
-            return mask_from_scores(mean_scores, self.dense_ratio)
+            # params returned unchanged: under donate_state the donated
+            # params buffers alias to this pass-through output, so the
+            # caller (init_state) keeps a valid handle while XLA reuses
+            # the buffers for the scoring pass's scratch
+            return mask_from_scores(mean_scores, self.dense_ratio), params
 
-        self._global_mask_jit = jax.jit(global_mask_fn)
+        self._global_mask_jit = self._jit_entry(global_mask_fn)
 
         def round_fn(state: SalientGradsState, sel_idx, round_idx,
-                     x_train, y_train, n_train):
+                     x_train, y_train, n_train, *test_args):
             rng, round_key = jax.random.split(state.rng)
             new_global, locals_, mean_loss, fstats, new_residual = \
                 self._train_selected_weighted(
@@ -187,6 +199,12 @@ class SalientGrads(FedAlgorithm):
             # trained weights (sailentgrads_api.py:133), guard-aware
             new_personal = self._guarded_personal_update(
                 state.personal_params, locals_, sel_idx, fstats)
+            # --eval_cache: refresh ONLY the trained clients' cache rows
+            # (see FedAvg.round_fn — identical semantics)
+            new_cache = state.eval_cache
+            if self.eval_cache:
+                new_cache = self._update_eval_cache(
+                    state.eval_cache, new_personal, sel_idx, *test_args)
             # in-jit numerics telemetry (--obs_numerics) incl. mask
             # churn / cross-client agreement; AFTER the defense re-mask
             # so the update norms see the adopted global. () when off
@@ -197,10 +215,12 @@ class SalientGrads(FedAlgorithm):
                 SalientGradsState(global_params=new_global,
                                   mask=state.mask,
                                   personal_params=new_personal, rng=rng,
-                                  agg_residual=new_residual),
+                                  agg_residual=new_residual,
+                                  eval_cache=new_cache),
                 mean_loss, fstats, nums)
 
-        self._round_jit = jax.jit(round_fn)
+        self._round_fn = round_fn
+        self._round_jit = self._jit_entry(round_fn)
         self._eval_global = self._make_global_eval()
         self._eval_personal = self._make_personal_eval()
 
@@ -213,25 +233,32 @@ class SalientGrads(FedAlgorithm):
             mask = jax.tree_util.tree_map(jnp.ones_like, params)
         else:
             with obs_trace.span("snip_mask"):
-                mask = self._global_mask_jit(
+                # params rebound to the pass-through output: under
+                # donate_state the input buffers were donated and THIS
+                # is the valid (aliased) handle
+                mask, params = self._global_mask_jit(
                     params, self.data.x_train, self.data.y_train,
                     self.data.n_train, m_rng,
                 )
         from ..core.state import zeros_like_tree
 
+        personal = (broadcast_tree(params, self.num_clients)
+                    if self.track_personal else None)
         return SalientGradsState(
             global_params=params, mask=mask,
             # w_per_mdls init: dense copies of the initial global model —
             # the reference's init-time mask multiply is commented out
             # (sailentgrads_api.py:107-110)
-            personal_params=(broadcast_tree(params, self.num_clients)
-                             if self.track_personal else None),
+            personal_params=personal,
             rng=s_rng,
             # topk: zero residual per client (masked by construction —
             # deltas of mask-honoring locals are zero on dead coords)
             agg_residual=(zeros_like_tree(
                 broadcast_tree(params, self.num_clients))
-                if self.agg_impl == "topk" else None))
+                if self.agg_impl == "topk" else None),
+            # --eval_cache: seeded by one full personal eval (one-time
+            # O(C); later rounds refresh O(S) rows in-graph)
+            eval_cache=self._seed_eval_cache(personal))
 
     def _ensure_agg_plan(self, state: SalientGradsState) -> None:
         """Host-side, before the round program traces: build the
@@ -254,19 +281,25 @@ class SalientGrads(FedAlgorithm):
     def run_round(self, state: SalientGradsState, round_idx: int):
         self._ensure_agg_plan(state)
         sel = self._selected_client_indexes(round_idx)
+        d = self.data
+        # read BEFORE dispatch: under donate_state the call consumes
+        # `state` (the ownership lint holds driver paths to this order)
+        old_pers = state.personal_params
+        extra = ((d.x_test, d.y_test, d.n_test)
+                 if self.eval_cache else ())
         # dispatch-time span (async): the round's device phases are
         # labeled by named_scope inside the jitted body instead
         with obs_trace.span("dispatch_round"):
             out = self._round_jit(
                 state, jnp.asarray(sel),
                 jnp.asarray(round_idx, jnp.float32),
-                self.data.x_train, self.data.y_train, self.data.n_train,
+                d.x_train, d.y_train, d.n_train, *extra,
             )
         new_state = out[0]
         # only the trained clients' personal models changed — feed the
         # incremental personal-eval cache (base._personal_eval_cached)
         self._note_personal_update(
-            state.personal_params, new_state.personal_params, sel)
+            old_pers, new_state.personal_params, sel)
         return new_state, dict(zip(self._round_metric_names, out[1:]))
 
     def run_rounds_fused(self, state, start_round, n_rounds, eval_every=0):

@@ -23,6 +23,18 @@ What a runtime test can only pin one instance of, this lints as a class:
   hand-maintained 31-entry list of the old ``tests/test_no_bare_assert``
   had already drifted (``algorithms/ditto.py``, the ``comm/`` backends,
   and the newer ``robust/`` modules were unlisted).
+* **donation-use-after** — reading a state variable after passing it to
+  a DONATING entry point (``_round_jit`` / ``_finetune_jit`` /
+  ``_global_mask_jit`` / ``run_round`` / ``run_rounds_fused``) on a
+  driver path. Under the state-ownership protocol (``donate_state``)
+  those calls consume their first argument — a later read hits a
+  deleted buffer at runtime (or silently works only while donation is
+  off). Drivers either rebind the variable in the same statement
+  (``state, m = algo.run_round(state, r)``), read what they need
+  BEFORE the call, or borrow via ``clone_state``. Conservative
+  name-tracking: only ``x.<entry>(var, ...)`` call sites with >= 2
+  positional args mark ``var``; the window closes at the next
+  rebinding of ``var``.
 * **deprecated-timer** — imports of the ``utils.profiling.Timer`` shim.
 * **xfail hygiene** — every ``pytest.mark.xfail`` in ``tests/`` carries
   a non-empty ``reason=`` and an entry in the committed xfail ledger,
@@ -125,6 +137,15 @@ _NP_MATH = {
 #: call roots that are nondeterministic / host-effectful under trace
 _NONDET_ROOTS = ("time.", "np.random.", "numpy.random.", "random.",
                  "os.urandom")
+
+#: method names that DONATE their first argument under the state-
+#: ownership protocol (FedAlgorithm donate_state — algorithms/base.py).
+#: Matched as attribute calls with >= 2 positional args so unrelated
+#: same-named methods (comm.cross_silo.run_round(round_idx)) stay out.
+_DONATING_ENTRIES = frozenset({
+    "_round_jit", "_finetune_jit", "_global_mask_jit",
+    "run_round", "run_rounds_fused",
+})
 
 
 def _dotted(node: ast.AST) -> str:
@@ -409,6 +430,17 @@ class PackageLint:
                 HOST_SYNC_ALLOWLIST_PREFIXES):
             out.extend(self._host_sync_rules(mod, mod.tree))
 
+        # use-after-donation: every module (driver paths call the
+        # donating entry points from algorithms/, experiments/, utils/).
+        # functions dict lists nested defs separately AND walks reach
+        # them through their parents — dedupe by (rule, line)
+        dseen: Set[Tuple[str, int]] = set()
+        for fn in mod.functions.values():
+            for f in self._donation_rules(mod, fn):
+                if (f.rule, f.line) not in dseen:
+                    dseen.add((f.rule, f.line))
+                    out.append(f)
+
         # traced-context rules: EVERY module — the traced set is proven
         # by discovery (decorated/wrapped/HOF/fixpoint), so a traced
         # model forward in models/ or a data transform reached from
@@ -457,6 +489,71 @@ class PackageLint:
                     f"{d} on a JAX expression computes on host via "
                     "__array__ (hidden transfer + f64 promotion); "
                     "use the jnp equivalent"))
+        return out
+
+    def _donation_rules(self, mod: _Module, fn: ast.AST) -> List[Finding]:
+        """Use-after-donation within one function body: a Name passed
+        as the first of >= 2 positional args to a donating entry point
+        is invalid from the end of that call until its next rebinding;
+        any Name load in that window is a finding. Same-statement tuple
+        rebinds (``state, m = self.run_round(state, r)``) close the
+        window immediately; reads hoisted ABOVE the call, clones, and
+        conditional-expression args are all clean by construction."""
+        # every line at which each name is (re)bound
+        binds: Dict[str, List[int]] = {}
+
+        def bind(target: ast.AST, line: int) -> None:
+            if isinstance(target, ast.Name):
+                binds.setdefault(target.id, []).append(line)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    bind(elt, line)
+            elif isinstance(target, ast.Starred):
+                bind(target.value, line)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    bind(t, node.lineno)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                bind(node.target, node.lineno)
+            elif isinstance(node, ast.NamedExpr):
+                bind(node.target, node.lineno)
+            elif isinstance(node, ast.For):
+                bind(node.target, node.lineno)
+            elif isinstance(node, ast.withitem) and \
+                    node.optional_vars is not None:
+                bind(node.optional_vars, getattr(
+                    node.optional_vars, "lineno", 0))
+
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DONATING_ENTRIES
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Name)):
+                continue
+            var = node.args[0].id
+            call_end = getattr(node, "end_lineno", node.lineno)
+            rebinds = [ln for ln in binds.get(var, [])
+                       if ln >= node.lineno]
+            if rebinds and min(rebinds) <= call_end:
+                continue  # rebound by the call's own statement
+            window_end = min(rebinds) if rebinds else float("inf")
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Name) and sub.id == var and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        call_end < sub.lineno < window_end:
+                    out.append(self._finding(
+                        mod, "donation-use-after", sub,
+                        f"{var!r} is read after being passed to "
+                        f"donating entry point .{node.func.attr} "
+                        f"(line {node.lineno}) — under donate_state "
+                        "the call consumed it; read before the call, "
+                        "rebind in the same statement, or borrow via "
+                        "clone_state"))
+                    break  # one finding per donated window
         return out
 
     def _traced_rules(self, mod: _Module, fn: ast.AST) -> List[Finding]:

@@ -46,11 +46,13 @@ class Finding:
         return f"[{self.rule}] {loc}: {self.message}"
 
 
-def load_baseline(path: str) -> Dict[str, str]:
-    """``key -> justification``. Missing file = empty baseline (a fresh
-    checkout with no pinned findings). Malformed JSON or a schema drift
-    raises ``ValueError`` — the gate maps that to exit code 2 (config
-    error), never to a silent all-clear."""
+def load_baseline_doc(path: str) -> Dict[str, object]:
+    """The parsed, schema-validated baseline document (one parse for
+    every consumer: the suppression ``entries`` and the donation
+    gate's ``donated_entry_points``). Missing file = empty doc.
+    Malformed JSON or a schema drift raises ``ValueError`` — the gate
+    maps that to exit code 2 (config error), never a silent
+    all-clear."""
     if not os.path.exists(path):
         return {}
     try:
@@ -62,15 +64,27 @@ def load_baseline(path: str) -> Dict[str, str]:
         raise ValueError(
             f"lint baseline {path}: expected version {BASELINE_VERSION}, "
             f"got {doc.get('version') if isinstance(doc, dict) else doc!r}")
-    out: Dict[str, str] = {}
     for e in doc.get("entries", ()):
         if not isinstance(e, dict) or "key" not in e \
                 or not str(e.get("justification", "")).strip():
             raise ValueError(
                 f"lint baseline {path}: every entry needs a key and a "
                 f"non-empty one-line justification, got {e!r}")
-        out[str(e["key"])] = str(e["justification"])
-    return out
+    pins = doc.get("donated_entry_points", [])
+    if not isinstance(pins, list) or \
+            not all(isinstance(p, str) for p in pins):
+        raise ValueError(
+            f"lint baseline {path}: donated_entry_points must be a "
+            "list of entry-point strings")
+    return doc
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """``key -> justification`` (the suppression entries of
+    :func:`load_baseline_doc`)."""
+    doc = load_baseline_doc(path)
+    return {str(e["key"]): str(e["justification"])
+            for e in doc.get("entries", ())}
 
 
 def apply_baseline(

@@ -14,7 +14,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
 from .findings import (
     Finding,
     apply_baseline,
-    load_baseline,
+    load_baseline_doc,
     render_report,
 )
 
@@ -90,6 +90,7 @@ def run_gate(
     jaxpr_fixture: Optional[str] = None,
     x64: bool = False,
     jaxpr_algos: Sequence[str] = ("fedavg", "salientgrads"),
+    jaxpr_donate: bool = True,
 ) -> Dict[str, Any]:
     """Run the selected analyzers; returns a verdict dict with
     ``exit_code``, ``findings`` (live), ``suppressed``, ``stale``,
@@ -120,9 +121,16 @@ def run_gate(
         return config_error(f"unknown analyzer(s) {unknown}; "
                             f"choose from {list(ANALYZERS)}")
     try:
-        baseline = load_baseline(baseline_path)
+        baseline_doc = load_baseline_doc(baseline_path)
     except ValueError as e:
         return config_error(str(e))
+    baseline = {str(e["key"]): str(e["justification"])
+                for e in baseline_doc.get("entries", ())}
+    # the donation GATE's pins ride the same reviewed baseline file:
+    # entry points listed under "donated_entry_points" must audit as
+    # donated (one parse validates both sections)
+    donation_pins: List[str] = list(
+        baseline_doc.get("donated_entry_points", ()))
 
     changed = set(changed_files) if changed_files is not None else None
     if changed is not None and any(
@@ -239,7 +247,9 @@ def run_gate(
                         "collective multisets are empty; run under "
                         "the 8-virtual-device test env for the full "
                         "check")
-                f, rep = jaxpr_audit.audit_algorithms(jaxpr_algos)
+                f, rep = jaxpr_audit.audit_algorithms(
+                    jaxpr_algos, donate=jaxpr_donate,
+                    donation_pins=donation_pins)
                 findings.extend(f)
                 reports["jaxpr"] = rep
             else:

@@ -94,6 +94,8 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "data_dtype": ("identity", "dt<dtype> numerics split"),
     "final_finetune": ("identity", "'noft' protocol split"),
     "track_personal": ("identity", "'nopers' state-structure split"),
+    "eval_cache": ("identity", "'evcache' state-structure + eval-"
+                               "protocol split (r5/topk pattern)"),
     "global_test": ("identity", "'-g' reference-parity tag"),
     "tag": ("identity", "explicit identity suffix"),
     # -- inert (telemetry / logging / placement / scheduling-only) ---------
@@ -137,6 +139,9 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "mesh_space": ("inert", "spatial sharding placement"),
     "remat": ("inert", "rematerialization trades FLOPs for HBM, "
                        "bit-identical results"),
+    "donate_state": ("inert", "buffer aliasing only — bit-identical "
+                              "outputs (tests/test_donation.py pins "
+                              "donated==undonated)"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

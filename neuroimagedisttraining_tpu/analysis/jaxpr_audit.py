@@ -24,12 +24,17 @@ checks the contracts the runtime tests can only sample:
   "per-process retry would break SPMD collective matching". On the
   CPU sim every process traces both branches identically, so only a
   static check can see the divergence before pod hardware does.
-* **donation audit** (report, not findings) — every jit entry point
-  without ``donate_argnums`` and the state bytes it re-allocates per
-  call: the measurement ROADMAP Open item 2's donation refactor
-  starts from. Reported, not gated: today *no* entry point donates
-  (the bench/test harnesses re-run from saved states, so donation
-  needs the explicit ownership protocol first).
+* **donation audit + gate** — every jit entry point with its
+  ``donate_argnums`` status and per-call realloc bytes. Since the
+  Round-14 ownership refactor the central entry points DONATE their
+  input state (``donate_state``, on by default in the CLI): the audit
+  instance is built donating, a donated entry's realloc drops from
+  the full ``(1+C)``-model state to the trained slice (global +
+  ``clients_per_round`` rows of each stacked field), and the entries
+  pinned in ``results/lint_baseline.json``'s ``donated_entry_points``
+  are GATED — a regression to un-donated is a ``jaxpr-donation``
+  finding (exit 1). ``--jaxpr-no-donate`` (seeded-violation plumbing)
+  audits a borrowing instance to prove the gate fires.
 """
 from __future__ import annotations
 
@@ -194,11 +199,20 @@ def audit_summary(s: JaxprSummary, label: str) -> List[Finding]:
 # -- central-algorithm audit ------------------------------------------------
 
 def build_central_algo(name: str, agg_impl: str = "bucketed",
-                       n_clients: int = 8, use_mesh: bool = True):
+                       n_clients: int = 16, use_mesh: bool = True,
+                       frac: float = 0.5, donate: bool = True):
     """A tiny audit instance of fedavg/salientgrads with the guard on
     (so the quarantine ``lax.cond`` is in the program) and a collective-
     emitting ``agg_impl``, its training data sharded over the test mesh
-    so ``_aggregate`` takes the ``shard_map`` path."""
+    so ``_aggregate`` takes the ``shard_map`` path.
+
+    ``frac < 1`` (C=16, S=8 — S stays divisible by the 8-device mesh
+    axis) makes the donation ledger's trained-slice number meaningful:
+    at full participation the trained slice IS the whole stack, so a
+    donated round would look no smaller than an un-donated one.
+    ``donate`` mirrors the CLI's ``--donate_state`` default; the
+    ``--jaxpr-no-donate`` seeded violation audits a borrowing
+    instance."""
     import jax
 
     from ..algorithms import FedAvg, SalientGrads
@@ -223,19 +237,20 @@ def build_central_algo(name: str, agg_impl: str = "bucketed",
                      local_epochs=1, steps_per_epoch=1, batch_size=8)
     cls = {"fedavg": FedAvg, "salientgrads": SalientGrads}[name]
     algo = cls(create_model("small3dcnn", num_classes=1), data, hp,
-               loss_type="bce", frac=1.0, seed=0, agg_impl=agg_impl,
-               guard=True)
+               loss_type="bce", frac=frac, seed=0, agg_impl=agg_impl,
+               guard=True, donate_state=donate)
     return algo, mesh
 
 
 def round_args(algo, state=None):
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     if state is None:
         state = algo.init_state(jax.random.PRNGKey(0))
-    sel = jnp.asarray(np.arange(algo.num_clients, dtype=np.int32))
+    # the seeded (contract-checked) draw — arange at full
+    # participation, the np.random.seed(0) subset at frac<1
+    sel = jnp.asarray(algo._selected_client_indexes(0))
     d = algo.data
     return (state, sel, jnp.asarray(0.0, jnp.float32),
             d.x_train, d.y_train, d.n_train)
@@ -260,13 +275,18 @@ def fused_args(algo, state, block: int = 2):
 
 def audit_central_algorithm(
     name: str, agg_impl: str = "bucketed", block: int = 2,
+    donate: bool = True,
+    donation_pins: Optional[Sequence[str]] = None,
 ) -> Tuple[List[Finding], Dict[str, Any]]:
     """Full audit of one algorithm: unfused round + fused block traced,
     per-program contracts checked, fused-vs-unfused collective multiset
-    equality proven, donation report assembled."""
+    equality proven, donation report assembled — and, for the entry
+    points named in ``donation_pins``, GATED: a pinned entry point
+    found un-donated is a ``jaxpr-donation`` finding."""
     import jax
 
-    algo, mesh = build_central_algo(name, agg_impl=agg_impl)
+    algo, mesh = build_central_algo(name, agg_impl=agg_impl,
+                                    donate=donate)
     if name == "salientgrads":
         state = algo.init_state(jax.random.PRNGKey(0))
         algo._ensure_agg_plan(state)
@@ -293,15 +313,33 @@ def audit_central_algorithm(
                     "a fused block on a pod would issue a different "
                     "collective sequence than the per-round path it is "
                     "bit-pinned against"))
+    donation = donation_audit(algo, state, rargs)
+    rows = {r["entry_point"]: r for r in donation}
+    for pin in donation_pins or ():
+        if not pin.startswith(name + "."):
+            continue
+        row = rows.get(pin)
+        if row is None or not row["donated"]:
+            findings.append(Finding(
+                rule="jaxpr-donation", file=f"jaxpr:{name}", line=0,
+                detail=pin,
+                message=f"{pin}: pinned donated in the baseline's "
+                        "donated_entry_points but the traced entry "
+                        "point does not donate its state — a "
+                        "regression to borrow semantics re-allocates "
+                        f"{row['state_bytes'] if row else '?'} state "
+                        "bytes per call (the Round-13 (1+C)-model "
+                        "rewrite the ownership protocol removed)"))
     report = {
         "algorithm": name,
         "agg_impl": agg_impl,
         "on_mesh": mesh is not None,
+        "donate_state": bool(algo._donate),
         "collectives_round": mu,
         "collectives_fused": mf,
         "dtypes_round": sorted(unfused.dtypes),
         "dtypes_fused": sorted(fused.dtypes),
-        "donation": donation_audit(algo, state, rargs),
+        "donation": donation,
     }
     return findings, report
 
@@ -331,40 +369,67 @@ def _donated_args(fn, args) -> Optional[List[bool]]:
         return None
 
 
+def trained_slice_bytes(algo, state, s_frac: Optional[float] = None
+                        ) -> int:
+    """The state bytes a DONATED round still writes fresh per call:
+    the new global model plus the trained clients' rows of every
+    stacked field (personal stack, topk residual, eval cache) — the
+    rest of the state aliases in place. ``s_frac`` defaults to the
+    instance's participation fraction; 1.0 for entry points that
+    rewrite every row (the finetune pass)."""
+    if s_frac is None:
+        s_frac = algo.clients_per_round / max(1, algo.num_clients)
+    g = _tree_bytes(getattr(state, "global_params", None))
+    stacked = 0
+    for field in ("personal_params", "agg_residual", "eval_cache"):
+        stacked += _tree_bytes(getattr(state, field, None))
+    return int(g + s_frac * stacked)
+
+
 def donation_audit(algo, state, rargs) -> List[Dict[str, Any]]:
     """Rows: every jit entry point, whether any argument is donated,
-    and the state bytes a non-donated call re-allocates (the [C, model]
-    personal stack dominates — RESULTS.md item 6's ~7%-of-round full
-    rewrite)."""
+    and its per-call realloc bytes — the full state for a borrowing
+    (un-donated) entry (the [C, model] personal stack dominates —
+    RESULTS.md item 6's ~7%-of-round full rewrite), the trained-slice
+    bytes (``trained_slice_bytes``) for a donating one (aliasing
+    leaves only the freshly-written global + S stacked rows)."""
     import jax
 
     d = algo.data
     state_bytes = _tree_bytes(state)
-    entries: List[Tuple[str, Any, Tuple, int]] = [
-        ("_round_jit", algo._round_jit, rargs, state_bytes),
+    model_bytes = _tree_bytes(state.global_params)
+    slice_bytes = trained_slice_bytes(algo, state)
+    full_rewrite = trained_slice_bytes(algo, state, s_frac=1.0)
+    # (name, fn, args, undonated realloc, donated realloc)
+    entries: List[Tuple[str, Any, Tuple, int, int]] = [
+        ("_round_jit", algo._round_jit, rargs, state_bytes,
+         slice_bytes),
     ]
     if hasattr(algo, "_finetune_jit"):
         entries.append(("_finetune_jit", algo._finetune_jit,
                         (state, d.x_train, d.y_train, d.n_train),
-                        state_bytes))
+                        state_bytes, full_rewrite))
     if hasattr(algo, "_global_mask_jit"):
         entries.append((
             "_global_mask_jit", algo._global_mask_jit,
             (state.global_params, d.x_train, d.y_train, d.n_train,
              jax.random.PRNGKey(0)),
-            _tree_bytes(state.global_params)))
+            # borrow: params re-broadcast + fresh mask; donate: only
+            # the mask output is fresh (params alias through)
+            _tree_bytes(state.global_params), model_bytes))
     entries.append(("_eval_global", algo._eval_global,
                     (state.global_params, d.x_test, d.y_test, d.n_test),
-                    0))  # eval outputs are scalars; nothing to donate
+                    0, 0))  # eval outputs are scalars; nothing to donate
     if state.personal_params is not None:
         entries.append(("_eval_personal", algo._eval_personal,
                         (state.personal_params, d.x_test, d.y_test,
-                         d.n_test), 0))
+                         d.n_test), 0, 0))
     fused_fn = algo._get_fused_fn(2, 1)
     entries.append(("fused[2,1]", fused_fn,
-                    fused_args(algo, state, 2), state_bytes))
+                    fused_args(algo, state, 2), state_bytes,
+                    slice_bytes))
     rows = []
-    for name, fn, args, realloc in entries:
+    for name, fn, args, realloc, donated_realloc in entries:
         flags = _donated_args(fn, args)
         donated = any(flags) if flags else False
         rows.append({
@@ -372,7 +437,8 @@ def donation_audit(algo, state, rargs) -> List[Dict[str, Any]]:
             "donated": donated,
             "donation_introspection": flags is not None,
             "state_bytes": realloc,
-            "realloc_bytes_per_call": 0 if donated else realloc,
+            "realloc_bytes_per_call": (donated_realloc if donated
+                                       else realloc),
         })
     return rows
 
@@ -380,11 +446,28 @@ def donation_audit(algo, state, rargs) -> List[Dict[str, Any]]:
 def audit_algorithms(
     names: Sequence[str] = ("fedavg", "salientgrads"),
     agg_impl: str = "bucketed",
+    donate: bool = True,
+    donation_pins: Optional[Sequence[str]] = None,
 ) -> Tuple[List[Finding], Dict[str, Any]]:
     findings: List[Finding] = []
     reports: Dict[str, Any] = {}
     for name in names:
-        f, rep = audit_central_algorithm(name, agg_impl=agg_impl)
+        f, rep = audit_central_algorithm(
+            name, agg_impl=agg_impl, donate=donate,
+            donation_pins=donation_pins)
         findings.extend(f)
         reports[name] = rep
+    # a pin no audited algorithm consumed (typo'd prefix, or an algo
+    # dropped from the audit set) would otherwise read as enforced
+    # while checking nothing — the same dead-excuse drift the
+    # stale-baseline machinery exists to catch for entries[]
+    for pin in donation_pins or ():
+        if not any(pin.startswith(n + ".") for n in names):
+            findings.append(Finding(
+                rule="jaxpr-donation", file="jaxpr", line=0,
+                detail=pin,
+                message=f"donated_entry_points pin {pin!r} matches no "
+                        f"audited algorithm ({list(names)}) — it "
+                        "enforces nothing; fix the prefix or delete "
+                        "the pin"))
     return findings, reports

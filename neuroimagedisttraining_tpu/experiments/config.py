@@ -267,6 +267,47 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "never enters run identity; 0 restores the "
                         "contract-everything-then-reduce order for A/B "
                         "timing")
+    import os as _os
+
+    p.add_argument("--donate_state", type=int,
+                   # product default: ON. The env override exists for
+                   # compile-budget-bound CI (tests/conftest.py): a
+                   # donated executable cannot use the persistent
+                   # compilation cache (base._no_persistent_cache_write
+                   # — jaxlib 0.4.37 corrupts donated executables on
+                   # reload), so the suite runs the borrow default and
+                   # the donation suites opt in explicitly
+                   default=int(_os.environ.get(
+                       "NIDT_DONATE_STATE_DEFAULT", "1")),
+                   help="state-ownership protocol: round/fused/finetune "
+                        "entry points take ownership of their input "
+                        "state (jit donate_argnums), so the [C, model] "
+                        "personal stack (and topk residual / eval "
+                        "cache) aliases in place instead of being "
+                        "re-allocated every call — the RESULTS.md "
+                        "Round-13 donation ledger's ~(1+C)-model/round "
+                        "rewrite drops to the trained slice. "
+                        "Bit-identical to 0 (aliasing only — never "
+                        "enters run identity); drivers that re-run "
+                        "from a saved state borrow via "
+                        "algo.clone_state (README 'State ownership & "
+                        "donation'). Supported: fedavg/salientgrads/"
+                        "ditto; a no-op elsewhere")
+    p.add_argument("--eval_cache", type=int, default=0,
+                   help="in-state incremental personal eval (fedavg/"
+                        "salientgrads with the personal stack): the "
+                        "round body evaluates only the trained "
+                        "clients' personal rows into a per-client "
+                        "(correct, loss_sum, total) cache carried in "
+                        "algorithm state — O(clients_per_round) "
+                        "forwards per round instead of O(C) per eval, "
+                        "riding the fused scan carry and checkpoints. "
+                        "Accuracies bit-equal the full eval; losses "
+                        "agree to f32 round-off (subset-width "
+                        "reassociation — the fused-eval tolerance). "
+                        "State-structure change: 'evcache' splits both "
+                        "run and checkpoint lineage (the r5 "
+                        "track_personal / topk-residual pattern)")
     p.add_argument("--eval_clients", type=int, default=0,
                    help="sampled-eval mode: evaluate only this many "
                         "(seeded) clients per eval instead of the whole "
@@ -635,6 +676,16 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
             # the sampled threshold changes WHICH coordinates ship —
             # trajectory, so it splits both lineages like the density
             parts.append(f"tks{args.agg_topk_sample}")
+    if algo in ("fedavg", "salientgrads") and \
+            getattr(args, "eval_cache", 0) and \
+            getattr(args, "track_personal", 1):
+        # eval_cache changes the state STRUCTURE (the in-state per-
+        # client eval cache rides checkpoints — the r5 personal-stack /
+        # topk-residual precedent) and the personal-loss reduction
+        # width (f32 ulps), so BOTH lineages split. Only the consuming
+        # algorithms split (the 'nopers' rule); --track_personal 0 has
+        # no stack to cache, so the runner refuses it before here.
+        parts.append("evcache")
     if not getattr(args, "final_finetune", 1):
         parts.append("noft")
     if algo in ("fedavg", "salientgrads") and \

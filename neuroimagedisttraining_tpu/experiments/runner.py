@@ -302,6 +302,9 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         guard=(bool(args.guard)
                if getattr(args, "guard", None) is not None else None),
         obs_numerics=bool(getattr(args, "obs_numerics", 0)),
+        # state-ownership protocol (on by default — bit-identical
+        # aliasing; only donate_supported algorithms consume it)
+        donate_state=bool(getattr(args, "donate_state", 1)),
     )
     if (getattr(args, "fault_spec", "") or getattr(args, "guard", 0)) \
             and algo_name not in ("fedavg", "salientgrads", "ditto"):
@@ -309,6 +312,22 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             "--fault_spec/--guard protect the CENTRAL aggregation round "
             f"(fedavg/salientgrads/ditto); {algo_name} has no central "
             "aggregate to guard")
+    if getattr(args, "eval_cache", 0):
+        if algo_name not in ("fedavg", "salientgrads"):
+            raise SystemExit(
+                "--eval_cache caches the per-client personal-eval "
+                "terms in algorithm state; only fedavg/salientgrads "
+                f"carry the personal stack it indexes ({algo_name} "
+                "does not)")
+        if not getattr(args, "track_personal", 1):
+            raise SystemExit(
+                "--eval_cache needs the personal stack; it cannot "
+                "combine with --track_personal 0")
+        if getattr(args, "eval_clients", 0):
+            raise SystemExit(
+                "--eval_cache indexes the full cohort; the sampled-"
+                "eval subset (--eval_clients) composes poorly with it "
+                "— use one or the other")
     if getattr(args, "obs_numerics", 0) and \
             algo_name not in ("fedavg", "salientgrads"):
         raise SystemExit(
@@ -373,11 +392,13 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
                                              "exact"),
                      fused_kernels=bool(getattr(args, "fused_kernels", 0)),
                      track_personal=bool(
-                         getattr(args, "track_personal", 1)))
+                         getattr(args, "track_personal", 1)),
+                     eval_cache=bool(getattr(args, "eval_cache", 0)))
     elif algo_name == "fedavg":
         extra = dict(defense=defense,
                      track_personal=bool(
-                         getattr(args, "track_personal", 1)))
+                         getattr(args, "track_personal", 1)),
+                     eval_cache=bool(getattr(args, "eval_cache", 0)))
     elif algo_name == "dispfl":
         extra = dict(dense_ratio=args.dense_ratio,
                      anneal_factor=args.anneal_factor,
@@ -568,7 +589,9 @@ def _ckpt_metadata(args, algo, cost):
             "track_personal": bool(getattr(args, "track_personal", 1)),
             # diagnostic only (topk lineages already split identity):
             # records which impl wrote this lineage's states
-            "agg_impl": algo.agg_impl}
+            "agg_impl": algo.agg_impl,
+            # diagnostic only (evcache lineages already split identity)
+            "eval_cache": bool(getattr(algo, "eval_cache", False))}
 
 
 def _cost_round_record(algo, cost, samples_per_client, state):
@@ -763,15 +786,22 @@ def run_experiment(args: argparse.Namespace,
         state = None
         start_round = 0
         if ckpt_mgr is not None and args.resume:
-            restored = ckpt_mgr.restore_latest(
-                algo.init_state(jax.random.PRNGKey(args.seed)),
-                schema_hint=(
+            hints = []
+            if getattr(args, "agg_impl", "dense") == "topk":
+                hints.append(
                     "(agg_impl='topk' states carry the error-feedback "
                     "residual stack; topk lineages live under their own "
                     "'aggtopk' checkpoint identity and are not "
-                    "interchangeable with other impls')"
-                    if getattr(args, "agg_impl", "dense") == "topk"
-                    else ""))
+                    "interchangeable with other impls')")
+            if getattr(args, "eval_cache", 0):
+                hints.append(
+                    "(--eval_cache states carry the per-client eval "
+                    "cache; evcache lineages live under their own "
+                    "checkpoint identity and are not interchangeable "
+                    "with cache-less ones)")
+            restored = ckpt_mgr.restore_latest(
+                algo.init_state(jax.random.PRNGKey(args.seed)),
+                schema_hint=" ".join(hints))
             if restored is not None:
                 state, start_round = restored
                 logger.info("resumed from round %d", start_round)
@@ -1018,11 +1048,17 @@ def run_experiment(args: argparse.Namespace,
                     # the watchdog RETRY attempt into its bundle —
                     # best-effort, once per run
                     flight.start_profile(prof_dir)
+                # under the ownership protocol the attempt CONSUMES its
+                # input; with a watchdog in play the pre-round state IS
+                # last-good and must survive the attempt — hand the
+                # attempt a borrowed clone (robust/recovery.py)
+                attempt = (watchdog.attempt_input(algo, state)
+                           if watchdog is not None else state)
                 with obs_trace.step_span("round", r):
                     # NOTE: dispatch-time span (the round program is
                     # async); wall attribution lives in round_time_s at
                     # the deferred flush — see obs/trace.py caveat
-                    new_state, rec = algo.run_round(state, r)
+                    new_state, rec = algo.run_round(attempt, r)
                 record = {"round": r, **dict(rec)}
                 if watchdog is not None:
                     verdict = watchdog.judge(r, record, new_state, state)

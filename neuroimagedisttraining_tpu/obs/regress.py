@@ -212,7 +212,12 @@ def metric_gate_defaults(metric: str) -> Dict[str, Any]:
     (incl. the topk/hier impls); ``agg_bytes_`` the modeled wire bytes
     recorded beside them — bytes are ANALYTIC (zero run-to-run noise),
     so any upward drift is a real model/impl change and the band is
-    tight."""
+    tight. ``cohort_mem_bytes_`` covers the BENCH_CONFIG=cohort sweep's
+    peak-device-memory ledger (bench.py, obs/memory.py): lower is
+    better, default band (the live-arrays fallback on backends without
+    memory_stats carries some run-to-run spread); the sweep's
+    ``cohort_rounds_per_sec_`` rates use the generic higher-is-better
+    defaults."""
     if metric in METRIC_GATE_DEFAULTS:
         return dict(METRIC_GATE_DEFAULTS[metric])
     if metric.startswith("agg_ms_"):
@@ -220,6 +225,8 @@ def metric_gate_defaults(metric: str) -> Dict[str, Any]:
     if metric.startswith("agg_bytes_"):
         return {"higher_is_better": False, "rel_threshold": 0.01,
                 "mad_k": 0.0}
+    if metric.startswith("cohort_mem_bytes_"):
+        return {"higher_is_better": False}
     return {}
 
 

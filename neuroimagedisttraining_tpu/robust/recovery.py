@@ -93,6 +93,21 @@ class RoundWatchdog:
         self._round: Optional[int] = None
         self._retries = 0
 
+    def attempt_input(self, algo, state: Any) -> Any:
+        """The state to hand a round attempt. Under the state-ownership
+        protocol (``donate_state``) the attempt CONSUMES its input —
+        but the watchdog's whole design rests on the pre-round state
+        surviving as last-good (``judge`` reads it for the update norm,
+        ``rollback`` returns it). So a donating algorithm's attempt
+        gets a borrowed clone (``algo.clone_state``) and the original
+        stays valid; a borrowing algorithm's attempt gets the state
+        itself, exactly as before. One full state copy per round — the
+        price of per-round rollback, only paid when the watchdog is
+        armed (it is opt-in)."""
+        if getattr(algo, "_donate", False):
+            return algo.clone_state(state)
+        return state
+
     def retries_at(self, round_idx: int) -> int:
         """Retry nonce for this attempt of ``round_idx`` (0 on the first
         attempt); resets when the driver moves to a new round."""

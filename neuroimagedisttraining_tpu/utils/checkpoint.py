@@ -131,8 +131,14 @@ class CheckpointManager:
         error propagate (with the schema-mismatch diagnosis, its most
         common cause). ``schema_hint`` lets the caller name the
         state-schema feature most likely to explain an all-steps
-        failure (e.g. the agg_impl='topk' error-feedback residual the
-        runner's template carries only under that impl)."""
+        failure (e.g. the agg_impl='topk' error-feedback residual or
+        the --eval_cache per-client eval cache — both carried by the
+        runner's template only under their flag).
+
+        Ownership: the restored state is freshly allocated — the
+        caller owns it outright and may hand it to a donating entry
+        point without cloning (the state-ownership protocol, README
+        "State ownership & donation")."""
         steps = sorted(self.mgr.all_steps(), reverse=True)
         if not steps:
             return None

@@ -30,7 +30,14 @@ def trace(log_dir: str):
 
 def trace_one_round(algo, state, log_dir: str, round_idx: int = 0) -> None:
     """Profile a single federated round (compile excluded: one warm-up
-    round runs first so the trace shows steady-state device time)."""
+    round runs first so the trace shows steady-state device time).
+
+    Borrows: the caller keeps using ``state`` afterwards (the runner
+    profiles before its round loop), so under the state-ownership
+    protocol the warm-up runs on a clone — ``run_round`` would
+    otherwise consume (donate) the caller's state."""
+    if getattr(algo, "_donate", False):
+        state = algo.clone_state(state)
     state2, _ = algo.run_round(state, round_idx)
     jax.block_until_ready(jax.tree_util.tree_leaves(state2)[0])
     with trace(log_dir):

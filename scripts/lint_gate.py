@@ -33,7 +33,13 @@ Usage:
     python scripts/lint_gate.py --only jaxpr \
         --jaxpr-fixture tests/fixtures/jaxpr_fixtures.py::f64_round --x64
 
-Donation-audit report (ROADMAP Open item 2's measurement):
+    # donation-gate seeded violation: audit a borrowing (un-donated)
+    # instance — the baseline's donated_entry_points pins must fire
+    python scripts/lint_gate.py --only jaxpr --jaxpr-no-donate
+
+Donation-ledger report (ROADMAP Open item 2's measurement, now a gate:
+``results/lint_baseline.json``'s ``donated_entry_points`` pins the
+central entry points donated — a regression to un-donated exits 1):
     python scripts/lint_gate.py --only jaxpr --json - | \
         python -c "import json,sys; \
             print(json.load(sys.stdin)['reports']['jaxpr'])"
@@ -123,6 +129,11 @@ def main(argv=None) -> int:
     p.add_argument("--x64", action="store_true",
                    help="trace the jaxpr fixture under enable_x64 so "
                         "latent f64 promotions surface")
+    p.add_argument("--jaxpr-no-donate", action="store_true",
+                   help="audit a borrowing (donate_state=0) instance — "
+                        "seeded-violation plumbing proving the "
+                        "donated_entry_points gate exits 1 on an "
+                        "un-donation regression")
     p.add_argument("--changed-only", action="store_true",
                    help="lint only files changed vs the merge base "
                         "(+ uncommitted/untracked); analyzers whose "
@@ -150,6 +161,7 @@ def main(argv=None) -> int:
         changed_files=changed,
         jaxpr_fixture=args.jaxpr_fixture,
         x64=args.x64,
+        jaxpr_donate=not args.jaxpr_no_donate,
     )
     if changed is not None:
         verdict["changed_files"] = changed

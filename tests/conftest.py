@@ -7,6 +7,16 @@ pyramid, with multi-device integration tests simulated via
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Suite-wide borrow default for the state-ownership protocol: a DONATED
+# executable cannot use the persistent compilation cache this suite's
+# budget is sized around (jaxlib 0.4.37 corrupts donated executables on
+# reload — algorithms/base.py:_no_persistent_cache_write), so every
+# runner-built algorithm here runs borrow semantics (the pre-round-14
+# compile economics) and the donation/eval-cache suites opt in with
+# explicit --donate_state 1 / donate_state=True. Donation is pure
+# aliasing (bit-identical, pinned by tests/test_donation.py), so this
+# changes no test semantics.
+os.environ.setdefault("NIDT_DONATE_STATE_DEFAULT", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

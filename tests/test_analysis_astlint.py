@@ -496,3 +496,61 @@ def test_finding_keys_are_line_number_free(tmp_path):
     ka = a[0].key.split(":", 2)[2]
     kb = b[0].key.split(":", 2)[2]
     assert ka == kb == "assert x"
+
+
+# -- donation-use-after -----------------------------------------------------
+
+def test_use_after_donation_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """
+        def drive(algo, state, r):
+            new_state, rec = algo.run_round(state, r)
+            norm = state.global_params
+            return new_state, norm
+        """, rel="experiments/driver.py")
+    assert _rules(fs) == ["donation-use-after"]
+    assert fs[0].line == 4
+
+
+def test_same_statement_rebind_is_clean(tmp_path):
+    fs = _lint_src(tmp_path, """
+        def drive(algo, state, rounds):
+            for r in range(rounds):
+                state, rec = algo.run_round(state, r)
+            return state
+        """, rel="experiments/driver.py")
+    assert fs == []
+
+
+def test_read_before_donation_and_clone_are_clean(tmp_path):
+    fs = _lint_src(tmp_path, """
+        def drive(algo, state, r):
+            old_pers = state.personal_params
+            new_state, rec = algo.run_round(algo.clone_state(state), r)
+            return new_state, old_pers, state
+        """, rel="experiments/driver.py")
+    # arg0 is a clone_state(...) Call, not the state Name — the
+    # original deliberately survives (borrow semantics)
+    assert fs == []
+
+
+def test_single_arg_same_named_method_is_not_donating(tmp_path):
+    # comm.cross_silo.run_round(round_idx) shares the name but takes no
+    # state: the >= 2 positional-args guard keeps it out of the rule
+    fs = _lint_src(tmp_path, """
+        def loop(self, rounds):
+            for r in range(rounds):
+                rec = self.run_round(r)
+                history = [r, rec]
+            return history
+        """, rel="comm/driver.py")
+    assert fs == []
+
+
+def test_rebind_after_window_closes_it(tmp_path):
+    fs = _lint_src(tmp_path, """
+        def drive(algo, state, r):
+            out = algo._round_jit(state, r)
+            state = out[0]
+            return state.global_params
+        """, rel="experiments/driver.py")
+    assert fs == []

@@ -63,15 +63,40 @@ def test_donation_audit_names_every_entry_point(audited):
     expected.add(f"{name}._finetune_jit" if name == "fedavg"
                  else f"{name}._global_mask_jit")
     assert expected == set(rows)
-    # ROADMAP Open item 2's starting measurement: nothing donates today,
-    # and the stateful entries re-allocate their full state every call
-    assert all(not r["donated"] for r in rows.values())
-    assert rows[f"{name}._round_jit"]["realloc_bytes_per_call"] > 0
-    assert rows[f"{name}.fused[2,1]"]["realloc_bytes_per_call"] > 0
+    # the Round-14 ownership contract: every stateful entry point
+    # DONATES, and a donated round's per-call realloc drops from the
+    # full (1+C)-model state to the trained slice (global + S rows of
+    # each stacked field — the audit instance runs frac=0.5, S=C/2,
+    # exactly so this reduction is visible)
+    assert report["donate_state"]
+    for ep in (f"{name}._round_jit", f"{name}.fused[2,1]"):
+        assert rows[ep]["donated"], ep
+        assert 0 < rows[ep]["realloc_bytes_per_call"] \
+            < rows[ep]["state_bytes"], ep
+    # evals donate nothing (scalar outputs; inputs shared with callers)
+    assert not rows[f"{name}._eval_global"]["donated"]
     assert rows[f"{name}._eval_global"]["realloc_bytes_per_call"] == 0
     # introspection really worked (args_info) rather than silently
     # defaulting everything to un-donated
     assert all(r["donation_introspection"] for r in rows.values())
+
+
+def test_un_donated_instance_trips_the_pins(eight_devices):
+    """The donation GATE: auditing a borrowing (donate_state=0)
+    instance against the baseline's donated_entry_points pins produces
+    jaxpr-donation findings for every pinned entry point — the seeded
+    un-donation regression the acceptance criteria name."""
+    pins = ("fedavg._round_jit", "fedavg.fused[2,1]")
+    findings, report = jaxpr_audit.audit_central_algorithm(
+        "fedavg", donate=False, donation_pins=pins)
+    assert not report["donate_state"]
+    rows = {r["entry_point"]: r for r in report["donation"]}
+    assert not rows["fedavg._round_jit"]["donated"]
+    # borrowing: the full state re-allocates every call again
+    assert rows["fedavg._round_jit"]["realloc_bytes_per_call"] == \
+        rows["fedavg._round_jit"]["state_bytes"]
+    got = {f.detail for f in findings if f.rule == "jaxpr-donation"}
+    assert got == set(pins), [f.render() for f in findings]
 
 
 # -- seeded violation fixtures ----------------------------------------------

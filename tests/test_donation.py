@@ -1,0 +1,252 @@
+"""The state-ownership protocol (``donate_state`` — ISSUE 9 /
+ROADMAP Open item 2).
+
+Donation is pure aliasing: a donated round must be BIT-IDENTICAL to
+the borrowing one across every agg wire and with the guard in play;
+the fused spelling must stay bit-pinned against the unfused one; the
+watchdog's last-good state must survive a donated (consumed) attempt;
+and the cohort-scale configuration the refactor exists for — C=256
+clients on one chip through the donated fused path — must complete.
+Per the BASELINE notes, the 1-vCPU sandbox cannot measure wall-clock
+or HBM deltas: these gates are deterministic (bit-identity, buffer
+liveness, ledger presence), and the realloc accounting itself is
+proven statically by the jaxpr donation gate
+(tests/test_analysis_jaxpr.py)."""
+import jax
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import (
+    Ditto,
+    FedAvg,
+    SalientGrads,
+)
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+
+
+def _data(n_clients=6):
+    return make_synthetic_federated(
+        n_clients=n_clients, samples_per_client=8, test_per_client=4,
+        sample_shape=(8, 8, 8, 1),
+    )
+
+
+def _hp():
+    return HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9,
+                       local_epochs=1, steps_per_epoch=1, batch_size=4)
+
+
+def _mk(cls, donate, frac=0.5, seed=3, **kw):
+    return cls(create_model("small3dcnn", num_classes=1), _data(),
+               _hp(), loss_type="bce", frac=frac, seed=seed,
+               donate_state=donate, **kw)
+
+
+def _max_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x).astype(np.float64)
+                            - np.asarray(y).astype(np.float64))))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+@pytest.mark.parametrize("agg_impl,guarded", [
+    ("dense", False), ("bucketed", True), ("bf16", False),
+    ("topk", True),
+])
+def test_donated_bitwise_equals_undonated(agg_impl, guarded):
+    """Donation changes WHERE buffers live, never what they hold:
+    3 rounds donated vs borrowed, bit-equal states and metrics, across
+    the agg wires (incl. topk's in-state residual) and with the guard
+    quarantining a real NaN fault. (Every impl covered, guard on and
+    off each covered twice — the full 4x2 cross costs ~40 s of
+    tier-1 compile for combinations the aliasing argument already
+    makes equivalent.)"""
+    kw = dict(agg_impl=agg_impl)
+    if guarded:
+        kw.update(fault_spec="nan=0.3", guard=True)
+    a_u = _mk(FedAvg, False, **kw)
+    a_d = _mk(FedAvg, True, **kw)
+    s_u = a_u.init_state(jax.random.PRNGKey(3))
+    s_d = a_d.init_state(jax.random.PRNGKey(3))
+    for r in range(3):
+        s_u, m_u = a_u.run_round(s_u, r)
+        s_d, m_d = a_d.run_round(s_d, r)
+        for k in m_u:
+            assert float(m_u[k]) == float(m_d[k]), (agg_impl, r, k)
+    assert _max_diff(s_u.global_params, s_d.global_params) == 0.0
+    assert _max_diff(s_u.personal_params, s_d.personal_params) == 0.0
+    if agg_impl == "topk":
+        assert _max_diff(s_u.agg_residual, s_d.agg_residual) == 0.0
+
+
+def test_donated_salientgrads_sparse_and_mask_jit():
+    """SalientGrads' donated ``_global_mask_jit`` returns the params
+    pass-through (the aliased handle init_state keeps), and the sparse
+    wire matches its borrowing twin bitwise."""
+    a_u = _mk(SalientGrads, False, agg_impl="sparse", dense_ratio=0.5,
+              itersnip_iterations=1)
+    a_d = _mk(SalientGrads, True, agg_impl="sparse", dense_ratio=0.5,
+              itersnip_iterations=1)
+    s_u = a_u.init_state(jax.random.PRNGKey(3))
+    s_d = a_d.init_state(jax.random.PRNGKey(3))
+    assert _max_diff(s_u.mask, s_d.mask) == 0.0
+    # the donated mask pass kept a VALID params handle
+    assert np.isfinite(float(
+        jax.tree_util.tree_leaves(s_d.global_params)[0].sum()))
+    for r in range(2):
+        s_u, _ = a_u.run_round(s_u, r)
+        s_d, _ = a_d.run_round(s_d, r)
+    assert _max_diff(s_u.global_params, s_d.global_params) == 0.0
+
+
+def test_donation_consumes_the_input_state():
+    """The ownership contract is real on this backend: after a donated
+    round, the input state's buffers are deleted — reading them raises
+    — while clone_state keeps a borrowed copy fully usable."""
+    algo = _mk(FedAvg, True)
+    s0 = algo.init_state(jax.random.PRNGKey(0))
+    kept = algo.clone_state(s0)
+    s1, _ = algo.run_round(s0, 0)
+    leaf = jax.tree_util.tree_leaves(s0.global_params)[0]
+    with pytest.raises(Exception, match="deleted|delete"):
+        np.asarray(leaf)
+    # the borrowed clone is intact and bit-equal to a fresh init
+    fresh = algo.clone_state(s1)  # output states are owned and usable
+    assert np.isfinite(float(
+        jax.tree_util.tree_leaves(kept.global_params)[0].sum()))
+    assert np.isfinite(float(
+        jax.tree_util.tree_leaves(fresh.global_params)[0].sum()))
+
+
+def test_fused_donated_bitwise_equals_unfused_and_rebinds_data():
+    """The donated fused block (state + data threaded through the scan
+    carry, returned aliased) is bit-pinned against the borrowing
+    unfused loop, and ``algo.data`` is rebound to valid arrays so
+    post-block eval/continuation works."""
+    a_u = _mk(SalientGrads, False, dense_ratio=0.5,
+              itersnip_iterations=1)
+    s_u = a_u.init_state(jax.random.PRNGKey(3))
+    accs = []
+    for r in range(4):
+        s_u, _ = a_u.run_round(s_u, r)
+        accs.append(float(a_u.evaluate(s_u)["global_acc"]))
+    a_d = _mk(SalientGrads, True, dense_ratio=0.5,
+              itersnip_iterations=1)
+    s_d = a_d.init_state(jax.random.PRNGKey(3))
+    s_f, ys = a_d.run_rounds_fused(s_d, 0, 4, eval_every=1)
+    assert _max_diff(s_u.global_params, s_f.global_params) == 0.0
+    assert _max_diff(s_u.personal_params, s_f.personal_params) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(ys["eval"]["global_acc"]), accs)
+    # data rebound to the aliased outputs: a post-block eval works and
+    # a SECOND donated block continues from the rebound arrays
+    ev = a_d.evaluate(s_f)
+    assert float(ev["global_acc"]) == accs[-1]
+    s_f2, _ = a_d.run_rounds_fused(s_f, 4, 2, eval_every=0)
+    assert np.isfinite(float(
+        jax.tree_util.tree_leaves(s_f2.global_params)[0].sum()))
+
+
+def test_watchdog_last_good_survives_donated_retry():
+    """Rollback-retry under donation: the attempt consumes a borrowed
+    clone (``RoundWatchdog.attempt_input``), so the pre-round state
+    stays readable for the judge's norm check and IS the rollback
+    target; a skipped round carries it forward bit-intact."""
+    from neuroimagedisttraining_tpu.robust import recovery
+
+    algo = _mk(FedAvg, True, frac=0.5)
+    wd = recovery.RoundWatchdog(max_retries=1, loss_threshold=1e-9,
+                                norm_threshold=1e-9)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    snapshot = algo.clone_state(state)
+    r = 0
+    verdicts = []
+    for _attempt in range(3):
+        algo.set_retry_nonce(wd.retries_at(r))
+        attempt = wd.attempt_input(algo, state)
+        new_state, rec = algo.run_round(attempt, r)
+        record = {"round": r, **{k: float(v) for k, v in rec.items()}}
+        verdict = wd.judge(r, record, new_state, state)
+        verdicts.append(verdict)
+        if verdict == recovery.RETRY:
+            state = wd.rollback(state)  # last-good: still valid
+            continue
+        if verdict == recovery.SKIP:
+            break
+        raise AssertionError("thresholds force RETRY then SKIP")
+    algo.set_retry_nonce(0)
+    assert verdicts == [recovery.RETRY, recovery.SKIP]
+    assert wd.rounds_retried == 1 and wd.rounds_skipped == 1
+    # last-good survived BOTH donated attempts, bit-intact
+    assert _max_diff(state.global_params, snapshot.global_params) == 0.0
+    assert _max_diff(state.personal_params,
+                     snapshot.personal_params) == 0.0
+
+
+def test_runner_donate_on_off_bit_identical(tmp_path):
+    """The CLI default (--donate_state 1) against an explicit
+    --donate_state 0 run: identical histories — donation never enters
+    run identity because there is nothing to key."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+    from neuroimagedisttraining_tpu.experiments.config import (
+        run_identity,
+    )
+
+    def argv(tag, donate):
+        return ["--model", "small3dcnn", "--dataset", "synthetic",
+                "--client_num_in_total", "4", "--batch_size", "8",
+                "--epochs", "1", "--comm_round", "3", "--lr", "0.05",
+                "--frac", "0.5", "--frequency_of_the_test", "1",
+                "--donate_state", donate, "--results_dir", "",
+                "--log_dir", str(tmp_path / f"LOG{tag}")]
+
+    out_on = run_experiment(parse_args(argv("on", "1"), algo="fedavg"),
+                            "fedavg")
+    out_off = run_experiment(parse_args(argv("off", "0"),
+                                        algo="fedavg"), "fedavg")
+    assert out_on["identity"] == out_off["identity"]
+    assert "donate" not in run_identity(
+        parse_args(argv("i", "1"), algo="fedavg"), "fedavg")
+    h_on = [h for h in out_on["history"] if h["round"] >= 0]
+    h_off = [h for h in out_off["history"] if h["round"] >= 0]
+    assert len(h_on) == len(h_off) == 3
+    for a, b in zip(h_on, h_off):
+        for k in a:
+            if k != "round_time_s":
+                assert float(a[k]) == float(b[k]), (a["round"], k)
+
+
+def test_c256_cohort_fused_smoke():
+    """The ROADMAP success metric's deterministic half: C=256 clients
+    on one chip through the donated fused path with the in-state eval
+    cache — the configuration whose second cohort copy OOMed C=32 at
+    full volume. On the CPU sandbox the gate is completion +
+    finiteness + the memory ledger being recordable (wall-clock and
+    HBM deltas are driver-side measurements, BASELINE notes)."""
+    from neuroimagedisttraining_tpu.obs import memory as obs_memory
+
+    data = make_synthetic_federated(
+        n_clients=256, samples_per_client=4, test_per_client=2,
+        sample_shape=(8, 8, 8, 1))
+    algo = FedAvg(create_model("small3dcnn", num_classes=1), data,
+                  _hp(), loss_type="bce", frac=8.0 / 256, seed=0,
+                  donate_state=True, eval_cache=True)
+    state = algo.init_state(jax.random.PRNGKey(0))
+    assert jax.tree_util.tree_leaves(
+        state.personal_params)[0].shape[0] == 256
+    state, ys = algo.run_rounds_fused(state, 0, 2, eval_every=1)
+    h = ys.materialize()
+    assert np.all(np.isfinite(h["train_loss"]))
+    assert np.all(np.asarray(h["eval"]["personal_acc"]) >= 0.0)
+    # per-round personal eval paid O(8) forwards, not O(256): the round
+    # program's cache update is the only personal-eval compute, and the
+    # in-graph eval branch re-reduces the [256] cache
+    assert algo.clients_per_round == 8
+    devs = obs_memory.device_memory()
+    assert devs and devs[0]["bytes_in_use"] > 0

@@ -104,6 +104,23 @@ def test_clean_fixture_exits_0(tmp_path):
     assert rc == 0
 
 
+def test_seeded_un_donation_exits_1(tmp_path, eight_devices):
+    """The donation gate through the CLI: auditing a borrowing
+    (--jaxpr-no-donate) instance against the baseline's
+    donated_entry_points pins exits 1 with one jaxpr-donation finding
+    per pinned entry point."""
+    rc = _gate_main(["--only", "jaxpr", "--jaxpr-no-donate",
+                     "--json", str(tmp_path / "v.json")])
+    assert rc == 1
+    verdict = json.loads((tmp_path / "v.json").read_text())
+    rules = {f["rule"] for f in verdict["findings"]}
+    assert rules == {"jaxpr-donation"}
+    pinned = json.load(open(os.path.join(
+        REPO, "results", "lint_baseline.json")))["donated_entry_points"]
+    flagged = {f["key"].split(":")[-1] for f in verdict["findings"]}
+    assert flagged == set(pinned)
+
+
 def test_bad_baseline_is_config_error_not_clean(tmp_path):
     from neuroimagedisttraining_tpu.analysis import gate
 
