@@ -5,9 +5,10 @@ filename, and (via ``for_checkpoint``) the checkpoint-lineage key
 (``experiments/config.py:run_identity``). Two standing contracts hang
 off it:
 
-* telemetry never forks lineage — no ``--obs_*`` / ``--flight_*`` flag
-  may enter the identity string (obs is bit-inert by construction, so
-  an obs ablation must resume / compare against the same lineage);
+* telemetry never forks lineage — no ``--obs_*`` / ``--flight_*`` /
+  ``--slo_*`` flag may enter the identity string (obs is bit-inert by
+  construction, so an obs ablation must resume / compare against the
+  same lineage);
 * every behavior-splitting flag that *should* key the lineage does —
   the r5 ``track_personal`` and the topk-residual migrations were both
   "a flag changed state structure, the identity must split" events
@@ -44,7 +45,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .findings import Finding
 
 #: flag-name prefixes that are telemetry by contract: never identity
-INERT_PREFIXES = ("obs", "flight")
+INERT_PREFIXES = ("obs", "flight", "slo")
 
 #: flag -> (class, one-line reason). Classes: identity | inert | unkeyed.
 FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
@@ -105,6 +106,11 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "obs_tb_dir": ("inert", "telemetry output path"),
     "obs_numerics": ("inert", "in-jit telemetry, pure readout"),
     "obs_comm": ("inert", "comm telemetry, pure readout"),
+    "slo_spec": ("inert", "online SLO evaluation, pure readout over "
+                          "flushed records (bit-inert off, trajectory-"
+                          "identical on)"),
+    "slo_enforce": ("inert", "exit-code verdict only — never touches "
+                             "state or records"),
     "flight_recorder": ("inert", "post-mortem capture, pure readout"),
     "flight_window": ("inert", "flight-recorder window size"),
     "flight_profile": ("inert", "flight-recorder profiler capture"),

@@ -404,13 +404,40 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "salientgrads/ditto) only. Off (the default) "
                         "is bit-inert; like every obs knob it never "
                         "enters run/checkpoint identity")
+    p.add_argument("--slo_spec", type=str, default="",
+                   help="online SLO engine (obs/slo.py): declarative "
+                        "objectives evaluated incrementally at the "
+                        "per-round record hook with O(1)-memory "
+                        "streaming estimators — inline ';'-separated "
+                        "DSL or a file path (one objective per line), "
+                        "e.g. 'p99:round_time_s<2.5@w=20;"
+                        "rate:clients_quarantined<0.1@w=50;"
+                        "ewma:global_acc>0.55'. Breaches, error-budget "
+                        "burn alerts, and OK/DEGRADED/FAILING health "
+                        "transitions land on the typed event bus "
+                        "(obs/events.py: <identity>.events.jsonl + "
+                        "obs tail + flight-recorder 'slo' trigger), "
+                        "and the health state is stamped on every "
+                        "JSONL round line. Requires --obs; pure "
+                        "readout — bit-inert off, trajectory-identical "
+                        "on; like every obs knob it never enters "
+                        "run/checkpoint identity")
+    p.add_argument("--slo_enforce", type=int, default=0,
+                   help="with --slo_spec: a run whose health ends "
+                        "FAILING exits nonzero AFTER writing every "
+                        "artifact (stat_info, metrics.json, events "
+                        "stream) — the CI-gateable mode "
+                        "scripts/slo_smoke.py drives. 0 (default) "
+                        "only observes")
     p.add_argument("--flight_recorder", type=str, default="",
                    help="anomaly flight recorder (obs/recorder.py): "
                         "comma-separated triggers — 'guard' (in-jit "
                         "quarantine fired), 'watchdog' (rollback/skip "
                         "verdict), 'drift>K' (max client drift exceeds "
                         "the trailing median by K robust sigmas; "
-                        "non-finite drift always trips), or 'auto' "
+                        "non-finite drift always trips), 'slo' (SLO "
+                        "breach / budget burn / FAILING transition "
+                        "from the --slo_spec event bus), or 'auto' "
                         "(= watchdog,guard). On trigger a bounded "
                         "post-mortem bundle (trigger detail + last-"
                         "K-round numerics window) lands under "
@@ -544,6 +571,14 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         from ..obs.recorder import parse_triggers
 
         parse_triggers(args.flight_recorder)
+    # same rule for the SLO spec: a typo'd objective must die at parse
+    # time, not silently watch nothing. File specs must exist by now —
+    # a missing file gets load_slo_spec's missing-file error here
+    # rather than a confusing malformed-DSL one mid-run.
+    if getattr(args, "slo_spec", ""):
+        from ..obs.slo import load_slo_spec
+
+        load_slo_spec(args.slo_spec)  # raises ValueError on bad specs
     if getattr(args, "guard", None) is None:
         args.guard = 1 if fault_spec else 0
     if getattr(args, "watchdog", None) is None:

@@ -636,6 +636,10 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
         if counters is not None:
             counters.update(rec)
         history.append(rec)
+        if flight is not None:
+            # before record_round: SLO event-bus triggers fire there,
+            # and their bundles must see this round in the window
+            flight.observe_record(rec)
         if obs_session is not None:
             # fused records arrive at the block flush point, already
             # materialized — the JSONL write forces no device sync
@@ -643,8 +647,6 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
                 rec, extra=(obs_fault_counts(r)
                             if obs_fault_counts is not None and r >= 0
                             else None))
-        if flight is not None:
-            flight.observe_record(rec)
         logger.info("%s round %d: %s", algo_name, r, rec)
 
     def on_block(end_round, state_out):
@@ -719,6 +721,28 @@ def run_experiment(args: argparse.Namespace,
                     "pod runtime; elsewhere pass --coordinator_address/"
                     "--num_processes/--process_id explicitly.")
 
+        if getattr(args, "slo_spec", "") and not getattr(args, "obs", 0):
+            raise SystemExit(
+                "--slo_spec rides the obs session (per-round record "
+                "hook, events stream, registry); pass --obs 1")
+        if getattr(args, "slo_enforce", 0) and \
+                not getattr(args, "slo_spec", ""):
+            raise SystemExit(
+                "--slo_enforce needs objectives to enforce; pass "
+                "--slo_spec (inline DSL or a spec file)")
+        if getattr(args, "flight_recorder", ""):
+            from ..obs.recorder import parse_triggers
+
+            if parse_triggers(args.flight_recorder)["slo"] and \
+                    not getattr(args, "slo_spec", ""):
+                # the 'slo' trigger rides the event bus, which only
+                # exists with an engine — arming it spec-less would be
+                # a silent never-fires no-op, the exact failure mode
+                # the parse-time trigger validation exists to prevent
+                raise SystemExit(
+                    "--flight_recorder slo captures SLO breach/burn/"
+                    "FAILING events; pass --slo_spec to arm the "
+                    "engine that emits them")
         if getattr(args, "obs", 0):
             # telemetry session: registry + tracer + sinks (obs/). Built
             # AFTER identity is fixed (obs knobs never enter the
@@ -733,14 +757,39 @@ def run_experiment(args: argparse.Namespace,
             jsonl = getattr(args, "obs_jsonl", "") or os.path.join(
                 args.results_dir or ".", args.dataset,
                 identity + ".obs.jsonl")
+            # online SLO engine (--slo_spec, obs/slo.py): incremental
+            # objective evaluation + typed event bus at the record
+            # hook. Pure readout — like every obs knob it never enters
+            # identity; off, the session produces byte-identical
+            # artifacts to pre-SLO behavior.
+            slo_engine = None
+            if getattr(args, "slo_spec", ""):
+                from ..obs.slo import SloEngine, load_slo_spec
+
+                slo_engine = SloEngine(load_slo_spec(args.slo_spec))
             obs_session = ObsSession(
                 jsonl_path=jsonl,
                 trace_dir=getattr(args, "trace_dir", ""),
                 identity=identity,
                 sample_every=getattr(args, "obs_sample_every", 1),
                 tb_dir=getattr(args, "obs_tb_dir", ""),
-                comm=bool(getattr(args, "obs_comm", 0)))
+                comm=bool(getattr(args, "obs_comm", 0)),
+                slo=slo_engine,
+                # events stream rides BESIDE the round stream, derived
+                # from the jsonl path (not the identity) so an
+                # explicit --obs_jsonl override — e.g. a resume with a
+                # larger --comm_round, whose identity differs — keeps
+                # the two streams continuous together
+                events_path=((jsonl[:-len(".obs.jsonl")]
+                              if jsonl.endswith(".obs.jsonl")
+                              else jsonl) + ".events.jsonl"
+                             if slo_engine is not None else ""))
             logger.info("obs: per-round JSONL -> %s", jsonl)
+            if slo_engine is not None:
+                logger.info(
+                    "obs slo: %d objective(s) armed, events -> %s",
+                    len(slo_engine.objectives),
+                    obs_session.events_path)
 
         with obs_trace.span("build"):
             if mh_mesh is not None:
@@ -782,6 +831,12 @@ def run_experiment(args: argparse.Namespace,
                 num_clients=algo.num_clients,
                 clients_per_round=algo.clients_per_round)
             logger.info("flight recorder armed -> %s", flight.dir)
+            if obs_session is not None and \
+                    obs_session.event_bus is not None:
+                # the 'slo' trigger adapter: the recorder rides the
+                # typed event bus, freezing a bundle on SLO breach /
+                # budget burn / FAILING transition events
+                obs_session.event_bus.subscribe(flight.observe_event)
 
         state = None
         start_round = 0
@@ -805,6 +860,19 @@ def run_experiment(args: argparse.Namespace,
             if restored is not None:
                 state, start_round = restored
                 logger.info("resumed from round %d", start_round)
+                if obs_session is not None and start_round > 0:
+                    # rebuild the SLO engine's estimator/budget/health
+                    # state from the run's own JSONL (deterministic —
+                    # the engine is a pure function of the record
+                    # stream); emission is suppressed, the events
+                    # stream already holds those rounds
+                    replayed = obs_session.slo_replay_from_stream(
+                        start_round)
+                    if replayed:
+                        logger.info(
+                            "obs slo: rebuilt engine state from %d "
+                            "recorded round(s) (health=%s)", replayed,
+                            obs_session.slo.health)
 
         if state is None:
             with obs_trace.span("init_state"):
@@ -954,12 +1022,15 @@ def run_experiment(args: argparse.Namespace,
             # round and defeat the one-round-deferred pipelining. The obs
             # JSONL write shares the same flush point for the same reason.
             counters.update(rec)
-            if obs_session is not None:
-                obs_session.record_round(rec, extra=_obs_extra_for(rec))
             if flight is not None:
                 # records are materialized at this point: trigger
-                # evaluation (guard counters, drift) is sync-free
+                # evaluation (guard counters, drift) is sync-free.
+                # BEFORE record_round: the SLO engine's event-bus
+                # triggers fire inside record_round, and their bundles
+                # must find THIS round's record already in the window
                 flight.observe_record(rec)
+            if obs_session is not None:
+                obs_session.record_round(rec, extra=_obs_extra_for(rec))
             logger.info("%s round %s: %s", algo_name, rec["round"], rec)
 
         # with obs on, records also get round_time_s stamped at flush
@@ -1224,6 +1295,24 @@ def run_experiment(args: argparse.Namespace,
                              if algo._eval_idx is not None else None),
             avg_inference_flops=avg_inf,
             fault_counters=fault_totals, obs_metrics=obs_snapshot)
+        if obs_session is not None and obs_session.slo is not None:
+            from ..obs import slo as slo_mod
+
+            health = obs_session.slo.health
+            if health != slo_mod.OK:
+                logger.warning("obs slo: run ended %s (breached: %s)",
+                               health.upper(),
+                               ", ".join(obs_session.slo.breached)
+                               or "none currently")
+            if getattr(args, "slo_enforce", 0) and \
+                    health == slo_mod.FAILING:
+                # every artifact above is already on disk — the
+                # nonzero exit is the verdict, not a crash
+                raise SystemExit(
+                    f"--slo_enforce: run {identity} ended FAILING "
+                    "(error budget exhausted; see "
+                    f"{obs_session.events_path or 'the events stream'}"
+                    " and metrics.json slo_* gauges)")
         return {
             "identity": identity,
             "history": history,

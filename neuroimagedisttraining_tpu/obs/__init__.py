@@ -56,15 +56,32 @@ The COMMUNICATION half — where the aggregation's bytes and time go:
   achieved wire GB/s vs the model), with a ``jit_cost_analysis``
   FLOPs/bytes fallback when no trace was captured.
 
+The ONLINE half — in-run SLO evaluation while the run is live:
+
+* :mod:`~.slo` — the online SLO engine (``--slo_spec``): a declarative
+  objective DSL evaluated incrementally at the record hook with
+  O(1)-memory streaming estimators (windowed/P² quantiles, windowed
+  rates, EWMA, least-squares slope), SRE-style error budgets with
+  fast/slow burn-rate alerts, and the ``OK -> DEGRADED -> FAILING``
+  run-health state machine stamped on every JSONL line
+  (``--slo_enforce`` turns a FAILING end state into a nonzero exit).
+* :mod:`~.events` — the typed, severity-ranked event bus
+  (``SLO_BREACH`` / ``BUDGET_BURN`` / ``GUARD`` / ``WATCHDOG`` /
+  ``DRIFT`` / ``HEALTH_TRANSITION``) with pluggable sinks: the per-run
+  ``<identity>.events.jsonl`` stream, the flight-recorder ``slo``
+  trigger adapter, ``obs tail --events`` live rendering.
+
 Nothing here enters run/checkpoint identity: telemetry never forks a
 lineage, and with ``--obs`` off every hook is a no-op (bit-identical to
-the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it).
+the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it;
+``scripts/slo_smoke.py`` adds the SLO-layer contract).
 """
 from . import (
     analyze,
     comm,
     compile,
     devtrace,
+    events,
     export,
     health,
     memory,
@@ -72,9 +89,10 @@ from . import (
     numerics,
     recorder,
     regress,
+    slo,
     trace,
 )
 
-__all__ = ["analyze", "comm", "compile", "devtrace", "export",
-           "health", "memory", "metrics", "numerics", "recorder",
-           "regress", "trace"]
+__all__ = ["analyze", "comm", "compile", "devtrace", "events",
+           "export", "health", "memory", "metrics", "numerics",
+           "recorder", "regress", "slo", "trace"]

@@ -9,9 +9,17 @@
 
     # live-tail a running (or finished) run's per-round JSONL: one
     # formatted line per round as it lands — round time, agg share,
-    # guard/watchdog/drift events (first step toward live SLO watching)
+    # run-health state + last event (--slo_spec runs), and the
+    # guard/watchdog/drift events; --events follows the typed
+    # <identity>.events.jsonl stream instead
     python -m neuroimagedisttraining_tpu.obs tail results/synthetic \
-        [--identity <run-identity>] [--poll 0.5] [--once]
+        [--identity <run-identity>] [--poll 0.5] [--once] [--events]
+
+    # offline SLO replay: re-evaluate a recorded run's round stream
+    # through the engine (bit-identical to the in-run verdicts), or
+    # judge a pre-SLO run against a spec after the fact
+    python -m neuroimagedisttraining_tpu.obs slo results/synthetic \
+        [--slo_spec 'p99:round_time_s<2.5@w=20'] [--enforce] [--json]
 
     # regression-gate a value against the bench history
     # (scripts/perf_gate.py is the fuller CI surface)
@@ -21,8 +29,9 @@
 
 Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
 tail — 0 (interrupt to stop; --once prints what's there and exits, 2
-when no stream resolves); regress — the perf-gate codes (0 pass, 1
-regression, 2 no history).
+when no stream resolves); slo — 0, 1 with --enforce when a replayed
+run ends FAILING, 2 when nothing replays; regress — the perf-gate
+codes (0 pass, 1 regression, 2 no history).
 """
 from __future__ import annotations
 
@@ -34,32 +43,48 @@ import time
 from typing import Callable, Optional, Sequence
 
 
-def resolve_stream(target: str, identity: str = "") -> Optional[str]:
+def resolve_stream(target: str, identity: str = "",
+                   suffix: str = ".obs.jsonl") -> Optional[str]:
     """``tail``'s stream resolution: an explicit JSONL path passes
-    through; a run dir picks ``<identity>.obs.jsonl`` when given, else
+    through; a run dir picks ``<identity><suffix>`` when given, else
     the most recently modified stream (the live run).
 
-    A NAMED stream (explicit ``.obs.jsonl`` path or dir+identity) need
+    A NAMED stream (explicit ``<suffix>`` path or dir+identity) need
     not exist yet — a just-launched run opens its stream lazily at the
     first flush, and ``tail_stream``'s follow mode waits for exactly
-    that; only the pick-the-newest mode needs something on disk."""
+    that; only the pick-the-newest mode needs something on disk. A run
+    dir holding ONLY an events stream (an early-killed run whose first
+    round never flushed, or a copied-out events file) resolves to that
+    events stream instead of nothing — ``format_tail_line`` renders
+    event records natively."""
     if os.path.isfile(target):
         return target
-    if target.endswith(".obs.jsonl") and \
+    if target.endswith((suffix, ".events.jsonl")) and \
             os.path.isdir(os.path.dirname(target) or "."):
         return target
     if not os.path.isdir(target):
         return None
     if identity:
-        return os.path.join(target, identity + ".obs.jsonl")
+        return os.path.join(target, identity + suffix)
     streams = [os.path.join(target, f) for f in os.listdir(target)
-               if f.endswith(".obs.jsonl")]
+               if f.endswith(suffix)]
+    if not streams and suffix == ".obs.jsonl":
+        # hardening: a dir with only events streams still tails
+        streams = [os.path.join(target, f) for f in os.listdir(target)
+                   if f.endswith(".events.jsonl")]
     return max(streams, key=os.path.getmtime) if streams else None
 
 
 def format_tail_line(rec: dict) -> str:
     """One round record -> one human line: round index, wall time,
-    loss, agg share, and any guard / watchdog / drift events."""
+    loss, agg share, the run-health state and last event (--slo_spec
+    runs), and any guard / watchdog / drift events. An EVENT record
+    (a line from the events stream — the only-events-dir hardening)
+    renders in the event format instead."""
+    if "event_type" in rec:
+        from .events import format_event_line
+
+        return format_event_line(rec)
     r = rec.get("round")
     parts = ["final " if r == -1 else f"round {r:<4}"
              if isinstance(r, (int, float)) else "?     "]
@@ -95,6 +120,14 @@ def format_tail_line(rec: dict) -> str:
                       ",".join(str(j) for j in bad))
     if events:
         parts.append("[" + "; ".join(events) + "]")
+    # run-health state + the round's top event (--slo_spec runs stamp
+    # both on every line; pre-SLO streams carry neither)
+    health = rec.get("slo_health")
+    if isinstance(health, str):
+        parts.append(health.upper())
+    ev = rec.get("slo_event")
+    if isinstance(ev, str) and ev:
+        parts.append(f"!{ev}")
     return "  ".join(parts)
 
 
@@ -135,6 +168,79 @@ def tail_stream(path: str, poll: float = 0.5, follow: bool = True,
             time.sleep(poll)
 
 
+def slo_replay_cli(run_dir: str, identity: str = "",
+                   slo_spec: str = "", enforce: bool = False,
+                   as_json: bool = False,
+                   out: Callable[[str], None] = print) -> int:
+    """``obs slo <run_dir>``: deterministically replay recorded round
+    streams through the SLO engine (the engine is a pure function of
+    the record stream, so the offline replay reproduces the in-run
+    verdicts bit-for-bit — including for runs recorded WITHOUT
+    ``--slo_spec``, evaluated after the fact against a spec given
+    here). Exit 0, 1 with ``enforce`` when any run ends FAILING, 2
+    when nothing replays (no streams, or no spec anywhere)."""
+    import json as _json
+
+    from . import export as obs_export, slo as obs_slo
+    from .events import format_event_line
+
+    if not os.path.isdir(run_dir):
+        print(f"not a directory: {run_dir}", file=sys.stderr)
+        return 2
+    names = sorted(f for f in os.listdir(run_dir)
+                   if f.endswith(".obs.jsonl"))
+    if identity:
+        names = [n for n in names
+                 if n == identity + ".obs.jsonl"]
+    if not names:
+        print(f"no *.obs.jsonl streams under {run_dir} "
+              "(was the run launched with --obs 1?)", file=sys.stderr)
+        return 2
+    any_failing = False
+    replayed = 0
+    for name in names:
+        ident = name[:-len(".obs.jsonl")]
+        records = obs_export.read_jsonl(
+            os.path.join(run_dir, name), allow_partial_tail=True)
+        spec = slo_spec
+        if not spec:
+            stat = os.path.join(run_dir, ident + ".json")
+            if os.path.exists(stat):
+                with open(stat) as f:
+                    spec = str((_json.load(f).get("config") or {})
+                               .get("slo_spec") or "")
+        if not spec:
+            print(f"{ident}: no --slo_spec given and the run recorded "
+                  "none; skipping", file=sys.stderr)
+            continue
+        engine = obs_slo.SloEngine(obs_slo.load_slo_spec(spec))
+        events = engine.replay(records)
+        replayed += 1
+        summary = engine.summary()
+        any_failing = any_failing or summary["health"] == \
+            obs_slo.FAILING
+        if as_json:
+            out(_json.dumps({"identity": ident, **summary}, indent=1))
+            continue
+        out(f"== slo replay: {ident} ==")
+        out(f"health: {summary['health'].upper()} over "
+            f"{summary['rounds_observed']} round(s), "
+            f"{summary['events_total']} event(s)")
+        for o in summary["objectives"].values():
+            comp = o["compliance"]
+            out(f"  {o['name']:<40} "
+                + (f"compliance {comp:.3f}, " if comp is not None
+                   else "not evaluated, ")
+                + f"budget spend {o['budget_spend']:.2f}"
+                + ("  EXHAUSTED" if o["budget_exhausted"] else "")
+                + ("  (violating)" if o["violating"] else ""))
+        for ev in events:
+            out("  " + format_event_line(ev.to_record()))
+    if not replayed:
+        return 2
+    return 1 if (enforce and any_failing) else 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m neuroimagedisttraining_tpu.obs",
@@ -163,6 +269,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     pt.add_argument("--once", action="store_true",
                     help="print the records already there and exit "
                          "(the scriptable mode; default follows live)")
+    pt.add_argument("--events", action="store_true",
+                    help="follow the run's <identity>.events.jsonl "
+                         "stream (the typed SLO/guard/watchdog event "
+                         "bus) instead of the per-round records")
+
+    ps = sub.add_parser(
+        "slo", help="offline SLO replay over a recorded run")
+    ps.add_argument("run_dir", help="directory holding *.obs.jsonl "
+                                    "streams (+ stat_info sidecars)")
+    ps.add_argument("--identity", default="",
+                    help="replay one stream (default: every stream "
+                         "in the dir)")
+    ps.add_argument("--slo_spec", default="",
+                    help="objectives to evaluate (inline DSL or spec "
+                         "file); default: the run's recorded "
+                         "--slo_spec from its stat_info config")
+    ps.add_argument("--enforce", action="store_true",
+                    help="exit 1 when any replayed run ends FAILING")
+    ps.add_argument("--json", action="store_true",
+                    help="print the summary JSON instead of the "
+                         "report")
 
     pr = sub.add_parser("regress", help="bench-history regression gate")
     pr.add_argument("--history", default="results/bench_history.jsonl")
@@ -194,10 +321,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     if args.cmd == "tail":
-        path = resolve_stream(args.target, args.identity)
+        suffix = ".events.jsonl" if args.events else ".obs.jsonl"
+        path = resolve_stream(args.target, args.identity,
+                              suffix=suffix)
         if path is None:
-            print(f"no *.obs.jsonl stream under {args.target} "
-                  "(was the run launched with --obs 1?)",
+            print(f"no *{suffix} stream under {args.target} "
+                  "(was the run launched with --obs 1"
+                  + ("" if args.events else "?")
+                  + (" and --slo_spec?)" if args.events else ")"),
                   file=sys.stderr)
             return 2
         print(f"tailing {path}", file=sys.stderr)
@@ -206,6 +337,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except KeyboardInterrupt:
             pass
         return 0
+
+    if args.cmd == "slo":
+        return slo_replay_cli(args.run_dir, identity=args.identity,
+                              slo_spec=args.slo_spec,
+                              enforce=args.enforce,
+                              as_json=args.json)
 
     from . import regress as obs_regress
 

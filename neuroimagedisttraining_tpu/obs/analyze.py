@@ -53,10 +53,16 @@ __all__ = [
 #: modeled bytes per agg_impl and per leaf group, the what-if table at
 #: the live mask density, probed agg time/share, measured serialized
 #: bytes, and the obs/devtrace.py device-trace attribution when a
-#: profile was captured). Older documents (and older ``obs_schema``
-#: round streams) are still accepted — each version's keys are
-#: required only of documents at that version or newer.
-ANALYSIS_SCHEMA_VERSION = 3
+#: profile was captured). v4 adds the ``slo`` section (obs/slo.py
+#: online-SLO telemetry: the run-health trajectory, per-objective
+#: compliance and error-budget spend from a deterministic engine
+#: replay, and the breach timeline from the ``<identity>.events.jsonl``
+#: stream joined against the fault-trace replay so each breach names
+#: the injected rounds and clients behind it). Older documents (and
+#: older ``obs_schema`` round streams) are still accepted — each
+#: version's keys are required only of documents at that version or
+#: newer.
+ANALYSIS_SCHEMA_VERSION = 4
 
 #: host span name -> phase bucket. Container / nested spans are mapped
 #: to None and skipped so phase totals never double-count (``round``
@@ -705,6 +711,138 @@ def _analyze_comm(records: List[Dict[str, Any]],
     return out
 
 
+def _injected_fault_fn(config: Optional[Dict[str, Any]]):
+    """``fn(round, retry) -> {"poisoned": [...], "dropped": [...],
+    "straggled": [...], "byzantine": [...]}`` of global client ids via
+    the deterministic fault-trace replay, or None when the run config
+    lacks a fault spec / cohort shape — the breach-attribution join's
+    evidence source."""
+    cfg = config or {}
+    fault_spec = str(cfg.get("fault_spec") or "")
+    num = int(cfg.get("client_num_in_total") or 0)
+    if not fault_spec or not num:
+        return None
+    from ..robust.faults import fault_trace_round, parse_fault_spec
+
+    spec = parse_fault_spec(fault_spec)
+    if spec is None or not spec.any_active:
+        return None
+    per = int(cfg.get("client_num_per_round") or num)
+    seed = int(cfg.get("seed") or 0)
+    from .health import replay_client_indexes
+
+    def injected(round_idx: int, retry: int = 0) -> Dict[str, Any]:
+        sel = replay_client_indexes(round_idx, num, per, retry=retry)
+        tr = fault_trace_round(spec, seed, round_idx, sel)
+        # EFFECTIVE faults, mirroring the health ledger's convention
+        # (obs/health.py): a straggle/byzantine draw overridden by NaN
+        # poison or a drop never reached the round program, and the
+        # breach timeline must name the same clients the ledger does
+        from .health import _effective_straggled
+
+        eff = {
+            "poisoned": tr["poisoned"],
+            "dropped": tr["dropped"],
+            "straggled": _effective_straggled(tr),
+            "byzantine": (tr["byzantine"] & ~tr["poisoned"]
+                          & ~tr["dropped"]),
+        }
+        return {field: [int(c) for c, hit in zip(sel, flags) if hit]
+                for field, flags in eff.items()}
+
+    return injected
+
+
+def _analyze_slo(records: List[Dict[str, Any]],
+                 events: Optional[List[Dict[str, Any]]],
+                 config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """The schema-v4 slo section: the recorded health trajectory, the
+    engine's per-objective compliance/budget verdicts (rebuilt by a
+    deterministic replay of the round stream against the run's
+    recorded spec), and the breach timeline — every breach-family
+    event joined against the fault-trace replay so the analyzer names
+    the injected rounds and clients behind it. ``present`` only when
+    the stream carries slo stamps or an events stream exists —
+    pre-SLO streams analyze with an empty section."""
+    out: Dict[str, Any] = {
+        "present": False, "health_final": None, "transitions": [],
+        "objectives": {}, "budget": {}, "breaches": [],
+        "events": {"total": 0, "by_type": {}},
+    }
+    ev = list(events or [])
+    stamped = [r for r in records
+               if isinstance(r.get("slo_health"), str)]
+    if not stamped and not ev:
+        return out
+    out["present"] = True
+    # -- recorded health trajectory -------------------------------------
+    prev = None
+    for r in stamped:
+        h = r["slo_health"]
+        if h != prev:
+            out["transitions"].append(
+                {"round": int(r["round"]), "to": h, "from": prev})
+            prev = h
+    if stamped:
+        out["health_final"] = stamped[-1]["slo_health"]
+    # -- engine replay: per-objective compliance + budget spend ---------
+    spec = str((config or {}).get("slo_spec") or "")
+    if spec:
+        from . import slo as obs_slo
+
+        try:
+            engine = obs_slo.SloEngine(obs_slo.load_slo_spec(spec))
+            engine.replay(records)
+            summary = engine.summary()
+            out["objectives"] = summary["objectives"]
+            out["budget"] = {
+                name: {"budget": o["budget"],
+                       "spend": o["budget_spend"],
+                       "exhausted": o["budget_exhausted"]}
+                for name, o in summary["objectives"].items()}
+            if out["health_final"] is None:
+                out["health_final"] = summary["health"]
+        except ValueError:
+            out["spec_error"] = spec  # unparseable recorded spec
+    # -- breach timeline joined against the fault trace -----------------
+    injected_fn = _injected_fault_fn(config)
+    retry_of = {int(r["round"]): int(r.get("rounds_retried") or 0)
+                for r in records
+                if isinstance(r.get("round"), (int, float))
+                and int(r.get("round", -1)) >= 0}
+    rec_of = {int(r["round"]): r for r in records
+              if isinstance(r.get("round"), (int, float))
+              and int(r.get("round", -1)) >= 0}
+    for e in ev:
+        etype = e.get("event_type")
+        out["events"]["total"] += 1
+        out["events"]["by_type"][etype] = \
+            out["events"]["by_type"].get(etype, 0) + 1
+        if etype not in ("SLO_BREACH", "BUDGET_BURN",
+                         "HEALTH_TRANSITION"):
+            continue
+        r = int(e.get("round", -1))
+        entry: Dict[str, Any] = {
+            "round": r, "event_type": etype,
+            "objectives": [b.get("objective") for b in
+                           (e.get("detail") or {}).get(
+                               "objectives", [])],
+        }
+        if etype == "HEALTH_TRANSITION":
+            entry["to"] = (e.get("detail") or {}).get("to")
+        rec = rec_of.get(r) or {}
+        q = rec.get("clients_quarantined")
+        if isinstance(q, (int, float)) and q > 0:
+            entry["clients_quarantined"] = float(q)
+        if injected_fn is not None and r >= 0:
+            inj = injected_fn(r, retry=retry_of.get(r, 0))
+            entry["injected"] = {k: v for k, v in inj.items() if v}
+        out["breaches"].append(entry)
+    out["breaches"].sort(
+        key=lambda b: (b["round"], str(b["event_type"])))
+    return out
+
+
 def _analyze_compile(metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     m = metrics or {}
     out: Dict[str, Any] = {"present": False, "total_s": 0.0,
@@ -739,7 +877,8 @@ def analyze_records(records: List[Dict[str, Any]],
                     metrics: Optional[Dict[str, Any]] = None,
                     config: Optional[Dict[str, Any]] = None,
                     identity: str = "run",
-                    devtrace: Optional[Dict[str, Any]] = None
+                    devtrace: Optional[Dict[str, Any]] = None,
+                    events: Optional[List[Dict[str, Any]]] = None
                     ) -> Dict[str, Any]:
     """Pure-function analyzer core over an already-loaded round stream
     (plus optional trace / metrics.json / run-config dicts)."""
@@ -765,6 +904,7 @@ def analyze_records(records: List[Dict[str, Any]],
     numerics = _analyze_numerics(rounds, config)
     comm = _analyze_comm(rounds, metrics, devtrace=devtrace,
                          config=config)
+    slo = _analyze_slo(rounds, events, config)
     analysis = {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
         "identity": identity,
@@ -780,6 +920,7 @@ def analyze_records(records: List[Dict[str, Any]],
         "numerics": numerics,
         "outlier_table": _outlier_table(stragglers, numerics),
         "comm": comm,
+        "slo": slo,
     }
     flags = []
     flags += [f"straggler_round_{s['round']}" for s in stragglers]
@@ -799,6 +940,13 @@ def analyze_records(records: List[Dict[str, Any]],
     if isinstance(agg_share, (int, float)) and \
             agg_share > COMM_AGG_SHARE_FLAG:
         flags.append(f"agg_share_{int(round(100 * agg_share))}pct")
+    # run-health flags: the final SLO verdict plus the breach count
+    if slo["present"] and slo.get("health_final") not in (None, "ok"):
+        flags.append(f"slo_{slo['health_final']}")
+    breach_rounds = sorted({b["round"] for b in slo["breaches"]
+                            if b["event_type"] == "SLO_BREACH"})
+    if breach_rounds:
+        flags.append(f"slo_breach_rounds_{len(breach_rounds)}")
     analysis["flags"] = flags
     return analysis
 
@@ -819,6 +967,9 @@ _SCHEMA_KEYS_V2 = {"numerics": dict, "outlier_table": list}
 #: keys ADDED by schema v3 — required only of v3+ documents
 _SCHEMA_KEYS_V3 = {"comm": dict}
 
+#: keys ADDED by schema v4 — required only of v4+ documents
+_SCHEMA_KEYS_V4 = {"slo": dict}
+
 
 def validate_analysis(analysis: Dict[str, Any]) -> None:
     """Raise ValueError describing every schema violation (an explicit
@@ -833,6 +984,8 @@ def validate_analysis(analysis: Dict[str, Any]) -> None:
             required.update(_SCHEMA_KEYS_V2)
         if analysis["schema_version"] >= 3:
             required.update(_SCHEMA_KEYS_V3)
+        if analysis["schema_version"] >= 4:
+            required.update(_SCHEMA_KEYS_V4)
     for key, typ in required.items():
         if key not in analysis:
             problems.append(f"missing key {key!r}")
@@ -899,10 +1052,18 @@ def analyze_run_dir(run_dir: str, trace_dir: str = "",
         # --obs_comm + --profile_dir were both set)
         devtrace = _maybe_json(
             os.path.join(run_dir, identity + ".devtrace.json"))
+        # typed event stream (--slo_spec runs; obs/events.py) — torn
+        # final line tolerated, keep-last dedupe by (round, type)
+        events = None
+        events_path = os.path.join(run_dir,
+                                   identity + ".events.jsonl")
+        if os.path.exists(events_path):
+            events = obs_export.dedupe_events(obs_export.read_jsonl(
+                events_path, allow_partial_tail=True))
         analysis = analyze_records(
             records, trace_doc=trace_doc, metrics=metrics,
             config=(stat or {}).get("config"), identity=identity,
-            devtrace=devtrace)
+            devtrace=devtrace, events=events)
         if write:
             analysis["analysis_path"] = write_analysis(
                 analysis, os.path.join(run_dir,
@@ -1067,6 +1228,42 @@ def render_report(analysis: Dict[str, Any]) -> str:
             lines.append("  measured messages: " + ", ".join(
                 f"{k}={v:g}" for k, v in sorted(meas.items())
                 if isinstance(v, (int, float))))
+    sl = a.get("slo") or {}
+    if sl.get("present"):
+        hf = sl.get("health_final")
+        lines.append("slo (online run-health):"
+                     + (f" final {str(hf).upper()}" if hf else ""))
+        for t in sl.get("transitions") or ():
+            lines.append(
+                f"  round {t['round']}: "
+                f"{(t.get('from') or 'start').upper()} -> "
+                f"{t['to'].upper()}")
+        for o in (sl.get("objectives") or {}).values():
+            comp = o.get("compliance")
+            lines.append(
+                f"  {o['name']:<40}"
+                + (f" compliance {comp:.3f}," if comp is not None
+                   else " not evaluated,")
+                + f" budget spend {o['budget_spend']:.2f}"
+                + ("  EXHAUSTED" if o.get("budget_exhausted") else ""))
+        for b in sl.get("breaches") or ():
+            who = ""
+            inj = b.get("injected") or {}
+            if inj:
+                who = "; injected " + ", ".join(
+                    f"{k} {v}" for k, v in sorted(inj.items()))
+            lines.append(
+                f"  BREACH round {b['round']} ({b['event_type']}"
+                + (f" -> {b['to'].upper()}" if b.get("to") else "")
+                + "): "
+                + (", ".join(str(x) for x in b["objectives"])
+                   or "run-level")
+                + who)
+        ev = sl.get("events") or {}
+        if ev.get("total"):
+            lines.append("  events: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(
+                    (ev.get("by_type") or {}).items())))
     c = a["compile"]
     if c["present"]:
         lines.append(f"compile: {c['total_s']:.2f} s total"

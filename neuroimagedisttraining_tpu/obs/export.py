@@ -28,9 +28,9 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "OBS_SCHEMA_VERSION", "ObsSession", "RoundLogWriter",
-    "SUPPORTED_OBS_SCHEMAS", "dedupe_rounds",
-    "maybe_tensorboard_writer", "merge_host_jsonl", "record_schema",
-    "write_metrics_json",
+    "SUPPORTED_OBS_SCHEMAS", "dedupe_events", "dedupe_rounds",
+    "maybe_tensorboard_writer", "merge_host_events",
+    "merge_host_jsonl", "record_schema", "write_metrics_json",
 ]
 
 #: version of the per-round JSONL record schema (stamped on every
@@ -41,20 +41,26 @@ __all__ = [
 #: per-slot client drift/cosine, mask churn/agreement). v3 adds the
 #: communication-telemetry keys (``comm_*`` — obs/comm.py: modeled
 #: wire bytes per agg_impl and per leaf group, live mask density, the
-#: probed agg time/share). Older streams carry none of them and still
-#: read/analyze cleanly — every reader treats the keys as optional.
-OBS_SCHEMA_VERSION = 3
+#: probed agg time/share). v4 adds the online-SLO keys (``slo_*`` —
+#: obs/slo.py: the run-health state stamped on every line, the
+#: currently-breached objective count, the round's top event) plus the
+#: sibling ``<identity>.events.jsonl`` stream (obs/events.py). Older
+#: streams carry none of them and still read/analyze cleanly — every
+#: reader treats the keys as optional.
+OBS_SCHEMA_VERSION = 4
 
 #: every schema this module's readers (and obs/analyze.py) accept
-SUPPORTED_OBS_SCHEMAS = (1, 2, 3)
+SUPPORTED_OBS_SCHEMAS = (1, 2, 3, 4)
 
 
 def record_schema(record: Dict[str, Any]) -> int:
-    """The LOWEST schema a record actually requires: v3 only when it
-    carries comm keys, v2 when (only) numerics keys. A plain line is
-    stamped 1 so older analyzers (which refuse schemas newer than they
-    understand) keep reading the streams they can read perfectly —
-    the v2/v3 keys are purely additive."""
+    """The LOWEST schema a record actually requires: v4 only when it
+    carries slo keys, v3 when comm keys, v2 when (only) numerics keys.
+    A plain line is stamped 1 so older analyzers (which refuse schemas
+    newer than they understand) keep reading the streams they can read
+    perfectly — the v2/v3/v4 keys are purely additive."""
+    if any(k.startswith("slo_") for k in record):
+        return 4
     if any(k.startswith("comm_") for k in record):
         return 3
     return 2 if any(k.startswith("num_") for k in record) else 1
@@ -140,20 +146,34 @@ class RoundLogWriter:
             self._fh = None
 
 
-def read_jsonl(path: str) -> List[Dict[str, Any]]:
+def read_jsonl(path: str,
+               allow_partial_tail: bool = False) -> List[Dict[str, Any]]:
     """Parse one JSONL stream; a malformed line raises with its number
-    (a telemetry file that silently drops rounds is worse than none)."""
+    (a telemetry file that silently drops rounds is worse than none).
+
+    ``allow_partial_tail`` tolerates exactly ONE malformed line — the
+    file's LAST non-empty one — by dropping it: a run killed mid-write
+    leaves a torn final line on its events stream, and the fold over a
+    crashed run's streams must read every completed event rather than
+    refuse the file. A malformed line anywhere earlier still raises."""
     out = []
+    bad: Optional[ValueError] = None
     with open(path) as f:
         for i, line in enumerate(f):
             line = line.strip()
             if not line:
                 continue
+            if bad is not None:
+                raise bad  # the malformed line was NOT the tail
             try:
                 out.append(json.loads(line))
             except json.JSONDecodeError as e:
-                raise ValueError(
-                    f"{path}:{i + 1}: malformed JSONL line: {e}") from e
+                err = ValueError(
+                    f"{path}:{i + 1}: malformed JSONL line: {e}")
+                err.__cause__ = e
+                if not allow_partial_tail:
+                    raise err
+                bad = err  # torn tail: drop iff nothing follows
     return out
 
 
@@ -196,6 +216,50 @@ def merge_host_jsonl(paths: List[str],
             rec.setdefault("host", host)
             merged.append(rec)
     merged.sort(key=lambda r: (r.get("round", -1), r.get("host", 0)))
+    return merged
+
+
+def dedupe_events(records: List[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """Deterministic timeline repair for one EVENTS stream: keep the
+    LAST record per ``(round, event_type)`` (the emission contract is
+    at most one event per type per round, so a kill+resume rerun's
+    re-emitted duplicates supersede the originals — which are
+    bit-identical anyway, the determinism contract), sorted by
+    ``(round, event_type)``. Records missing either key are dropped —
+    they are not events."""
+    from .events import event_key
+
+    last: Dict[Any, Dict[str, Any]] = {}
+    for rec in records:
+        k = event_key(rec)
+        if k[0] is None or k[1] is None:
+            continue
+        last[k] = rec
+    return [last[k] for k in sorted(
+        last, key=lambda k: (k[0], str(k[1])))]
+
+
+def merge_host_events(paths: List[str],
+                      dedupe: bool = True) -> List[Dict[str, Any]]:
+    """The per-host fold for ``<identity>.events.jsonl`` streams: the
+    ``merge_host_jsonl`` semantics with the EVENTS dedupe key
+    (keep-last by ``(round, event_type)`` within one host) and a torn
+    final line tolerated per stream (a killed run's last write). An
+    empty (or all-blank) stream contributes nothing; the same
+    ``(round, type)`` on DIFFERENT hosts is not a duplicate — it is
+    the multihost fold."""
+    merged: List[Dict[str, Any]] = []
+    for host, p in enumerate(paths):
+        recs = read_jsonl(p, allow_partial_tail=True)
+        if dedupe:
+            recs = dedupe_events(recs)
+        for rec in recs:
+            rec = dict(rec)
+            rec.setdefault("host", host)
+            merged.append(rec)
+    merged.sort(key=lambda r: (r.get("round", -1), r.get("host", 0),
+                               str(r.get("event_type", ""))))
     return merged
 
 
@@ -248,7 +312,8 @@ class ObsSession:
 
     def __init__(self, jsonl_path: str = "", trace_dir: str = "",
                  identity: str = "run", sample_every: int = 1,
-                 tb_dir: str = "", comm: bool = False):
+                 tb_dir: str = "", comm: bool = False, slo=None,
+                 events_path: str = ""):
         self.identity = identity
         self.registry = obs_metrics.MetricsRegistry()
         self.registry.gauge("obs_schema_version").set(OBS_SCHEMA_VERSION)
@@ -290,6 +355,32 @@ class ObsSession:
         self._tb = maybe_tensorboard_writer(tb_dir) if tb_dir else None
         self.metrics_json_path: Optional[str] = None
         self.trace_path: Optional[str] = None
+        # online SLO engine (obs/slo.py) + typed event bus
+        # (obs/events.py): constructed only when --slo_spec is set, so
+        # slo-off sessions produce byte-identical artifacts to HEAD (no
+        # slo_* keys, no events stream)
+        self.slo = slo
+        self.events_path = events_path or (
+            jsonl_path[:-len(".obs.jsonl")] + ".events.jsonl"
+            if slo is not None and jsonl_path.endswith(".obs.jsonl")
+            else "")
+        self.event_bus = None
+        self.event_writer: Optional[RoundLogWriter] = None
+        if slo is not None:
+            from .events import EventBus
+
+            self.event_bus = EventBus()
+            if self.events_path:
+                self.event_writer = RoundLogWriter(self.events_path)
+                self.event_bus.subscribe(
+                    lambda ev: self.event_writer.write(ev.to_record()))
+
+            def _count_event(ev, _reg=self.registry) -> None:
+                c = _reg.counter("slo_events_total")
+                c.inc()
+                c.labels(type=ev.type).inc()
+
+            self.event_bus.subscribe(_count_event)
         self._closed = False
 
     # -- comm telemetry --------------------------------------------------
@@ -351,7 +442,24 @@ class ObsSession:
                     share = agg_ms / 1e3 / rt
                     out["comm_agg_share"] = share
                     reg.distribution("comm_agg_share").observe(share)
-            # stamp from the ENRICHED line: comm keys promote it to v3
+            if self.slo is not None and isinstance(r, int) and r >= 0:
+                # online SLO evaluation over the ENRICHED line (mem_*/
+                # comm_* keys are objectives too), then the health
+                # stamp — evaluated state, written on the same line
+                events = self.slo.observe(out)
+                out["slo_health"] = self.slo.health
+                out["slo_breached"] = float(len(self.slo.breached))
+                if events:
+                    top = max(events, key=lambda e: e.severity)
+                    out["slo_event"] = top.type + (
+                        f"({top.objective})" if top.objective else "")
+                reg.gauge("slo_health_rank").set(
+                    float(self.slo.health_rank))
+                if self.event_bus is not None:
+                    for ev in events:
+                        self.event_bus.emit(ev)
+            # stamp from the ENRICHED line: comm keys promote it to
+            # v3, slo keys to v4
             out["obs_schema"] = record_schema(out)
             self.writer.write(out)
         if self._tb is not None and isinstance(r, int):
@@ -363,12 +471,49 @@ class ObsSession:
                         logger.debug("TB scalar export failed",
                                      exc_info=True)
 
+    # -- resume ----------------------------------------------------------
+    def slo_replay_from_stream(self, start_round: int) -> int:
+        """Deterministically rebuild the SLO engine's estimator/budget/
+        health state from this session's OWN existing JSONL stream on
+        ``--resume``: feed the deduped records of rounds BEFORE
+        ``start_round`` through the engine with event emission
+        suppressed (the events stream already holds those rounds'
+        events; the live rounds >= start_round re-emit, and the
+        events-fold's keep-last dedupe absorbs the overlap). Returns
+        the number of rounds replayed."""
+        if self.slo is None or not self.jsonl_path or \
+                not os.path.exists(self.jsonl_path):
+            return 0
+        prior = [r for r in dedupe_rounds(read_jsonl(
+                     self.jsonl_path, allow_partial_tail=True))
+                 if isinstance(r.get("round"), (int, float))
+                 and 0 <= int(r["round"]) < int(start_round)]
+        self.slo.replay(prior)  # events discarded: already on disk
+        return len(prior)
+
     # -- end-of-run ------------------------------------------------------
     def finish(self) -> Dict[str, Any]:
         """Final memory sample, write sinks, return the registry
         snapshot (the runner merges it into stat_info)."""
         self.memory.sample()
         self.compile_watch.summarize()
+        if self.slo is not None:
+            # run-health summary into the registry so metrics.json
+            # (and stat_info's obs_metrics merge) carry the verdict
+            s = self.slo.summary()
+            self.registry.gauge("slo_health_rank").set(
+                float(s["health_rank"]))
+            self.registry.gauge("slo_rounds_observed").set(
+                float(s["rounds_observed"]))
+            self.registry.gauge("slo_transitions").set(
+                float(len(s["transitions"])))
+            for name, o in s["objectives"].items():
+                g = self.registry.gauge("slo_budget_spend")
+                g.labels(objective=name).set(float(o["budget_spend"]))
+                if o["compliance"] is not None:
+                    c = self.registry.gauge("slo_compliance")
+                    c.labels(objective=name).set(
+                        float(o["compliance"]))
         if self.exports:
             if self.jsonl_path:
                 self.metrics_json_path = write_metrics_json(
@@ -397,6 +542,8 @@ class ObsSession:
             self._msg_hook = None
         if self.writer is not None:
             self.writer.close()
+        if self.event_writer is not None:
+            self.event_writer.close()
         if self._tb is not None:
             try:
                 self._tb.close()

@@ -57,19 +57,23 @@ _DRIFT_MIN_HISTORY = 5
 
 
 def parse_triggers(spec: str) -> Dict[str, Any]:
-    """``"watchdog,guard,drift>3.5"`` → ``{"watchdog": bool, "guard":
-    bool, "drift_k": float|None}``; ``"auto"``/``"1"``/``"on"`` =
-    watchdog+guard. Raises ValueError on unknown tokens so a typo'd
-    flight config dies at parse time, not silently at the fault."""
+    """``"watchdog,guard,drift>3.5,slo"`` → ``{"watchdog": bool,
+    "guard": bool, "slo": bool, "drift_k": float|None}``;
+    ``"auto"``/``"1"``/``"on"`` = watchdog+guard. ``slo`` captures a
+    bundle on SLO_BREACH / BUDGET_BURN / HEALTH_TRANSITION-to-FAILING
+    events from the typed event bus (obs/events.py — the recorder is a
+    bus sink via :meth:`FlightRecorder.observe_event`). Raises
+    ValueError on unknown tokens so a typo'd flight config dies at
+    parse time, not silently at the fault."""
     out: Dict[str, Any] = {"watchdog": False, "guard": False,
-                           "drift_k": None}
+                           "slo": False, "drift_k": None}
     for tok in str(spec).split(","):
         tok = tok.strip()
         if not tok:
             continue
         if tok in ("auto", "1", "on"):
             out["watchdog"] = out["guard"] = True
-        elif tok in ("watchdog", "guard"):
+        elif tok in ("watchdog", "guard", "slo"):
             out[tok] = True
         elif tok.startswith("drift>"):
             try:
@@ -86,12 +90,12 @@ def parse_triggers(spec: str) -> Dict[str, Any]:
         else:
             raise ValueError(
                 f"flight_recorder: unknown trigger {tok!r} "
-                "(know: auto, watchdog, guard, drift>K)")
-    if not (out["watchdog"] or out["guard"]
+                "(know: auto, watchdog, guard, slo, drift>K)")
+    if not (out["watchdog"] or out["guard"] or out["slo"]
             or out["drift_k"] is not None):
         raise ValueError(
             "flight_recorder: no triggers in spec "
-            "(use e.g. 'auto' or 'guard,drift>3.5')")
+            "(use e.g. 'auto' or 'guard,slo,drift>3.5')")
     return out
 
 
@@ -184,6 +188,37 @@ class FlightRecorder:
             detail = self._offenders(rec)
             detail["drift_sigmas"] = round((cur - med) / sigma, 2)
             self._capture("drift", r, rec, detail)
+
+    # -- event-bus adapter (obs/events.py sink) --------------------------
+    def observe_event(self, event) -> None:
+        """The SLO engine's trigger adapter: subscribed to the typed
+        event bus when the ``slo`` trigger is armed, it freezes a
+        bundle on an SLO breach, an error-budget burn, or the health
+        state machine entering FAILING. The event's record and detail
+        become the trigger payload; the window is the same last-K
+        flushed rounds every other trigger captures."""
+        if not self.triggers.get("slo"):
+            return
+        etype = getattr(event, "type", "")
+        reason = None
+        if etype == "SLO_BREACH":
+            reason = "slo_breach"
+        elif etype == "BUDGET_BURN":
+            reason = "slo_budget_burn"
+        elif etype == "HEALTH_TRANSITION" and \
+                (getattr(event, "detail", {}) or {}).get("to") == \
+                "failing":
+            reason = "slo_failing"
+        if reason is None:
+            return
+        detail = dict(getattr(event, "detail", {}) or {})
+        if getattr(event, "objective", ""):
+            detail.setdefault("objective", event.objective)
+        # event records are JSON-safe by construction (no device
+        # scalars), so they skip the record sanitizer — _json_safe
+        # would stringify the nested detail dict
+        self._capture(reason, int(event.round), event.to_record(),
+                      detail)
 
     # -- watchdog hooks --------------------------------------------------
     def note_watchdog(self, round_idx: int, verdict: str,

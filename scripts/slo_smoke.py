@@ -1,0 +1,298 @@
+"""SLO smoke: the online-SLO subsystem's end-to-end CI gate.
+
+Runs the scale-8 synthetic config against a DETERMINISTIC SLO spec
+(objectives over fault counters and losses — never wall-clock metrics,
+so every verdict is bit-reproducible) and asserts the acceptance
+contract of the online SLO engine (obs/slo.py + obs/events.py):
+
+  1. INERTNESS — the obs+slo run's training trajectory is BIT-IDENTICAL
+     to the plain obs run (the engine is a pure readout), and its round
+     records equal the plain run's modulo the ``slo_*`` stamps and the
+     schema bump they imply.
+  2. CLEAN TWIN — the fault-free run stays OK on every line, emits ZERO
+     breach events, and exits 0 even under ``--slo_enforce``.
+  3. SEEDED BREACH — the chaos twin (deterministic ``--fault_spec`` NaN
+     injection) trips the expected SLO_BREACH / HEALTH_TRANSITION
+     events; two identical runs produce byte-identical events streams;
+     ``--slo_enforce`` makes the FAILING run exit nonzero (after
+     writing every artifact).
+  4. FUSED PARITY — the fused (``--fuse_rounds``) chaos twin emits the
+     identical event sequence and health trajectory.
+  5. RESUME — a kill+``--resume`` pair (first half checkpointed, second
+     half resumed; the engine deterministically rebuilds its state from
+     the JSONL) reproduces the uninterrupted run's events and health
+     stamps after the events-fold dedupe.
+  6. ANALYZER — obs/analyze.py emits a schema-v4 ``slo`` section whose
+     breach timeline names the injected rounds and clients (the
+     fault-trace join).
+
+    python scripts/slo_smoke.py                 # CI gate
+    python scripts/slo_smoke.py --clients 8 --rounds 6
+
+Prints ONE JSON line; exits 0 when the whole contract holds, 1 on any
+violation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+CHAOS_SPEC = "nan=0.4"
+
+
+def _slo_spec(rounds: int) -> str:
+    """Deterministic objectives: the quarantine-rate SLO breaches under
+    seeded NaN chaos and never on the clean twin; the loss EWMA is a
+    wide always-green guard proving multi-objective evaluation."""
+    return (f"rate:clients_quarantined<0.05@w={rounds}"
+            ";ewma:train_loss<100@a=0.5")
+
+
+def _argv(clients, rounds, tmp, sub, extra):
+    return [
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", str(clients), "--batch_size", "8",
+        "--epochs", "1", "--comm_round", str(rounds), "--lr", "0.05",
+        "--frequency_of_the_test", "0", "--final_finetune", "0",
+        "--log_dir", os.path.join(tmp, sub, "LOG"),
+        "--results_dir", os.path.join(tmp, sub, "results"),
+    ] + list(extra)
+
+
+def _run(clients, rounds, tmp, sub, extra):
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    args = parse_args(_argv(clients, rounds, tmp, sub, extra),
+                      algo="fedavg")
+    return run_experiment(args, "fedavg")
+
+
+def _read(path, events=False):
+    from neuroimagedisttraining_tpu.obs.export import (
+        dedupe_events,
+        dedupe_rounds,
+        read_jsonl,
+    )
+
+    if not os.path.exists(path):
+        return []
+    recs = read_jsonl(path, allow_partial_tail=events)
+    return dedupe_events(recs) if events else dedupe_rounds(recs)
+
+
+def _event_sig(events):
+    """The comparable identity of an event stream (host-field-free)."""
+    return [(e["round"], e["event_type"], e.get("objective", ""),
+             e.get("message", ""), json.dumps(e.get("detail", {}),
+                                              sort_keys=True))
+            for e in events]
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--rounds", type=int, default=6,
+                   help="total rounds (the resume pair splits it in "
+                        "half; >= 4)")
+    p.add_argument("--tmp", type=str, default="",
+                   help="scratch dir (default: a fresh tempdir)")
+    args = p.parse_args(argv)
+    if args.rounds < 4:
+        raise SystemExit("--rounds must be >= 4 (the resume pair "
+                         "needs two halves with >= 2 rounds each)")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import logging
+    import tempfile
+
+    import numpy as np
+
+    logging.getLogger().setLevel(logging.WARNING)
+    tmp = args.tmp or tempfile.mkdtemp(prefix="slo_smoke_")
+    spec = _slo_spec(args.rounds)
+    slo_flags = ["--obs", "1", "--slo_spec", spec, "--watchdog", "0"]
+    chaos = ["--fault_spec", CHAOS_SPEC]
+
+    import jax
+
+    def params_equal(a, b):
+        return all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(
+                       jax.tree_util.tree_leaves(a.global_params),
+                       jax.tree_util.tree_leaves(b.global_params)))
+
+    def streams(sub, out, jsonl_override=""):
+        d = os.path.join(tmp, sub, "results", "synthetic")
+        base = jsonl_override or os.path.join(
+            d, out["identity"] + ".obs.jsonl")
+        return (_read(base),
+                _read(base[:-len(".obs.jsonl")] + ".events.jsonl",
+                      events=True))
+
+    # -- 1. inertness: plain obs vs obs+slo under chaos -----------------
+    out_plain = _run(args.clients, args.rounds, tmp, "plain",
+                     ["--obs", "1", "--watchdog", "0"] + chaos)
+    out_slo = _run(args.clients, args.rounds, tmp, "slo",
+                   slo_flags + chaos)
+    if not params_equal(out_plain["state"], out_slo["state"]):
+        raise SystemExit("slo run is not bit-identical to plain obs")
+    recs_plain, _ = streams("plain", out_plain)
+    recs_slo, events_slo = streams("slo", out_slo)
+
+    def deterministic(rec, drop_slo):
+        # two separate processes can only be compared on the
+        # deterministic record content: wall-clock and memory samples
+        # differ run to run by nature, and the slo stamps (plus the
+        # schema bump they imply) are exactly the delta under test
+        return {k: v for k, v in rec.items()
+                if k != "round_time_s" and not k.startswith("mem_")
+                and k != "obs_schema"
+                and not (drop_slo and k.startswith("slo_"))}
+
+    for rp, rs in zip(recs_plain, recs_slo):
+        if deterministic(rs, True) != deterministic(rp, False):
+            raise SystemExit(
+                f"slo stamps changed the record beyond slo_* keys at "
+                f"round {rs.get('round')}")
+    rounds_rec = [r for r in recs_slo
+                  if isinstance(r.get("round"), int) and r["round"] >= 0]
+    if not all("slo_health" in r and r["obs_schema"] == 4
+               for r in rounds_rec):
+        raise SystemExit("slo run lines missing health stamp / v4")
+
+    # -- 3a. seeded breach fired deterministically ----------------------
+    etypes = {e["event_type"] for e in events_slo}
+    if "SLO_BREACH" not in etypes or "HEALTH_TRANSITION" not in etypes:
+        raise SystemExit(
+            f"chaos run missed expected events (got {sorted(etypes)})")
+    final_health = rounds_rec[-1]["slo_health"]
+    if final_health != "failing":
+        raise SystemExit(
+            f"chaos run ended {final_health!r}, expected 'failing'")
+    out_slo2 = _run(args.clients, args.rounds, tmp, "slo2",
+                    slo_flags + chaos)
+    _, events_slo2 = streams("slo2", out_slo2)
+    if _event_sig(events_slo) != _event_sig(events_slo2):
+        raise SystemExit("two identical chaos runs emitted different "
+                         "event streams")
+
+    # -- 4. fused parity ------------------------------------------------
+    out_fused = _run(args.clients, args.rounds, tmp, "fused",
+                     slo_flags + chaos + ["--fuse_rounds", "2"])
+    recs_fused, events_fused = streams("fused", out_fused)
+    if _event_sig(events_fused) != _event_sig(events_slo):
+        raise SystemExit("fused chaos run emitted a different event "
+                         "sequence than unfused")
+    fused_health = [(r["round"], r["slo_health"]) for r in recs_fused
+                    if isinstance(r.get("round"), int)
+                    and r["round"] >= 0]
+    unfused_health = [(r["round"], r["slo_health"])
+                      for r in rounds_rec]
+    if fused_health != unfused_health:
+        raise SystemExit("fused health trajectory differs from unfused")
+
+    # -- 2. clean twin stays OK (zero breach events), enforce exits 0 ---
+    out_clean = _run(args.clients, args.rounds, tmp, "clean",
+                     slo_flags + ["--slo_enforce", "1"])
+    recs_clean, events_clean = streams("clean", out_clean)
+    bad = [e for e in events_clean
+           if e["event_type"] in ("SLO_BREACH", "BUDGET_BURN",
+                                  "HEALTH_TRANSITION")]
+    if bad:
+        raise SystemExit(f"clean twin emitted breach events: {bad}")
+    if not all(r.get("slo_health") == "ok" for r in recs_clean
+               if isinstance(r.get("round"), int) and r["round"] >= 0):
+        raise SystemExit("clean twin left the OK state")
+
+    # -- 3b. --slo_enforce: the FAILING chaos run exits nonzero ---------
+    enforce_code = 0
+    try:
+        _run(args.clients, args.rounds, tmp, "enforce",
+             slo_flags + chaos + ["--slo_enforce", "1"])
+    except SystemExit as e:
+        enforce_code = 1 if isinstance(e.code, str) else int(
+            e.code or 0)
+    if enforce_code == 0:
+        raise SystemExit(
+            "--slo_enforce did not exit nonzero on the FAILING run")
+    # artifacts were still written BEFORE the verdict exit
+    enforce_dir = os.path.join(tmp, "enforce", "results", "synthetic")
+    if not any(f.endswith(".events.jsonl")
+               for f in os.listdir(enforce_dir)):
+        raise SystemExit("enforced run wrote no events stream")
+
+    # -- 5. kill + resume reproduces the uninterrupted run --------------
+    half = args.rounds // 2
+    ckpt = os.path.join(tmp, "resume", "ckpt")
+    jsonl_b = os.path.join(tmp, "resume", "stream.obs.jsonl")
+    resume_extra = slo_flags + chaos + [
+        "--checkpoint_dir", ckpt, "--obs_jsonl", jsonl_b]
+    _run(args.clients, half, tmp, "resume", resume_extra)
+    out_b = _run(args.clients, args.rounds, tmp, "resume",
+                 resume_extra + ["--resume"])
+    if not params_equal(out_slo["state"], out_b["state"]):
+        raise SystemExit("resumed run's final state differs from the "
+                         "uninterrupted run")
+    recs_b = _read(jsonl_b)
+    events_b = _read(jsonl_b[:-len(".obs.jsonl")] + ".events.jsonl",
+                     events=True)
+    health_b = [(r["round"], r["slo_health"]) for r in recs_b
+                if isinstance(r.get("round"), int) and r["round"] >= 0]
+    if health_b != unfused_health:
+        raise SystemExit(
+            f"resumed health trajectory {health_b} != uninterrupted "
+            f"{unfused_health}")
+    if _event_sig(events_b) != _event_sig(events_slo):
+        raise SystemExit("resumed event stream (deduped) differs from "
+                         "the uninterrupted run's")
+
+    # -- 6. analyzer v4: breach attribution names injected clients ------
+    from neuroimagedisttraining_tpu.obs import analyze as obs_analyze
+
+    analyses = obs_analyze.analyze_run_dir(
+        os.path.join(tmp, "slo", "results", "synthetic"))
+    if len(analyses) != 1:
+        raise SystemExit("expected one analyzable slo run")
+    a = analyses[0]
+    obs_analyze.validate_analysis(a)
+    if a["schema_version"] < 4 or not a["slo"]["present"]:
+        raise SystemExit("analysis is not schema v4 with a slo section")
+    if a["slo"]["health_final"] != "failing":
+        raise SystemExit(
+            f"analyzer health {a['slo']['health_final']} != failing")
+    breaches = [b for b in a["slo"]["breaches"]
+                if b["event_type"] == "SLO_BREACH"]
+    if not breaches:
+        raise SystemExit("analyzer found no SLO_BREACH in the timeline")
+    attributed = [b for b in breaches
+                  if (b.get("injected") or {}).get("poisoned")]
+    if not attributed:
+        raise SystemExit("analyzer attributed no breach to the "
+                         "injected NaN clients")
+
+    result = {
+        "slo_ok": True, "clients": args.clients, "rounds": args.rounds,
+        "slo_spec": spec, "fault_spec": CHAOS_SPEC,
+        "chaos_final_health": final_health,
+        "chaos_events": len(events_slo),
+        "clean_events": len(events_clean),
+        "enforce_exit": enforce_code,
+        "resume_events_match": True, "fused_events_match": True,
+        "breach_rounds": sorted({b["round"] for b in breaches}),
+        "attributed_clients": sorted({
+            c for b in attributed for c in b["injected"]["poisoned"]}),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

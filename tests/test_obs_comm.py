@@ -191,14 +191,17 @@ def test_probe_agg_ms_runs_and_is_bit_inert():
 # ---------------------------------------------------------------------------
 
 def test_record_schema_v3():
-    assert export.OBS_SCHEMA_VERSION == 3
-    assert export.SUPPORTED_OBS_SCHEMAS == (1, 2, 3)
+    assert export.OBS_SCHEMA_VERSION == 4
+    assert export.SUPPORTED_OBS_SCHEMAS == (1, 2, 3, 4)
     assert export.record_schema({"round": 0}) == 1
     assert export.record_schema({"round": 0, "num_update_norm": 1.0}) == 2
     assert export.record_schema({"round": 0, "comm_bytes_wire": 4.0}) == 3
     assert export.record_schema(
         {"round": 0, "num_update_norm": 1.0,
          "comm_bytes_wire": 4.0}) == 3
+    # v4: the online-SLO stamps promote the line past the comm keys
+    assert export.record_schema(
+        {"round": 0, "comm_bytes_wire": 4.0, "slo_health": "ok"}) == 4
 
 
 def test_obs_session_comm_merge(tmp_path):
@@ -300,7 +303,7 @@ def test_analyzer_comm_section():
     a = analyze.analyze_records(_comm_records(),
                                 config={"agg_impl": "bf16"})
     analyze.validate_analysis(a)
-    assert a["schema_version"] == 3
+    assert a["schema_version"] == analyze.ANALYSIS_SCHEMA_VERSION
     cm = a["comm"]
     assert cm["present"] and cm["impl"] == "bf16"
     assert cm["wire_bytes"] == 500.0
@@ -358,6 +361,12 @@ def test_v3_document_requires_comm_key():
         analyze.validate_analysis(v3)
     v3["comm"] = {}
     analyze.validate_analysis(v3)
+    # v4 documents additionally require the slo section
+    v4 = dict(v3, schema_version=4)
+    with pytest.raises(ValueError, match="slo"):
+        analyze.validate_analysis(v4)
+    v4["slo"] = {}
+    analyze.validate_analysis(v4)
 
 
 def test_obs_comm_e2e_fused_and_unfused(tmp_path):

@@ -304,8 +304,8 @@ def test_flight_recorder_drift_trigger_robust_threshold(tmp_path):
 # ---------------------------------------------------------------------------
 
 def test_schema_versions_and_v1_fixture_still_analyzes():
-    assert export.OBS_SCHEMA_VERSION == 3
-    assert export.SUPPORTED_OBS_SCHEMAS == (1, 2, 3)
+    assert export.OBS_SCHEMA_VERSION == 4
+    assert export.SUPPORTED_OBS_SCHEMAS == (1, 2, 3, 4)
     # a PR-4-era (v1) stream: no num_* keys anywhere — analyzes cleanly
     v1 = [{"round": r, "train_loss": 0.5, "round_time_s": 0.1,
            "obs_schema": 1} for r in range(6)]

@@ -1,0 +1,648 @@
+"""Online SLO engine tier-1 coverage: spec DSL, streaming estimators
+(concrete determinism — the hypothesis property half lives in
+test_slo_estimators.py), the breach/budget/health state machine, the
+typed event bus and its sinks (events stream, flight-recorder adapter,
+tail rendering), the events-stream fold, the analyzer's schema-v4 slo
+section, the ``obs slo`` offline replay CLI, and the end-to-end
+scripts/slo_smoke.py contract at CI scale."""
+import importlib.util
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.obs import (
+    analyze,
+    events as ev_mod,
+    export,
+    slo as slo_mod,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# spec DSL
+# ---------------------------------------------------------------------------
+
+def test_parse_slo_spec_full_grammar():
+    objs = slo_mod.parse_slo_spec(
+        "p99:round_time_s<2.5@w=20;"
+        "rate:clients_quarantined<0.1@w=50,budget=0.2;"
+        "ewma:global_acc>0.55@a=0.3;"
+        "slope:mem_device_bytes_in_use<1e6")
+    assert [o.kind for o in objs] == ["quantile", "rate", "ewma",
+                                      "slope"]
+    q = objs[0]
+    assert q.quantile == 0.99 and q.window == 20 and q.op == "<" \
+        and q.threshold == 2.5 and q.metric == "round_time_s"
+    assert objs[1].budget == 0.2 and objs[1].window == 50
+    assert objs[2].alpha == 0.3 and objs[2].op == ">"
+    assert objs[3].threshold == 1e6
+    # p999 parses as 0.999; w=0 selects the P2 streaming estimator;
+    # res=N the whole-run deterministic reservoir
+    (o,) = slo_mod.parse_slo_spec("p999:round_time_s<9@w=0")
+    assert o.quantile == 0.999
+    assert isinstance(o.make_estimator(), slo_mod.P2Quantile)
+    assert isinstance(objs[0].make_estimator(),
+                      slo_mod.WindowedQuantile)
+    (r,) = slo_mod.parse_slo_spec("p99:round_time_s<9@res=64")
+    assert isinstance(r.make_estimator(), slo_mod.ReservoirQuantile)
+
+
+def test_parse_slo_spec_file_and_comments(tmp_path):
+    p = tmp_path / "objectives.slo"
+    p.write_text("# production SLOs\n"
+                 "p99:round_time_s<2.5@w=20\n"
+                 "\n"
+                 "ewma:train_loss<10  # drift guard\n")
+    objs = slo_mod.load_slo_spec(str(p))
+    assert len(objs) == 2
+    # inline still parses through the same loader
+    assert len(slo_mod.load_slo_spec("rate:x<1")) == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "", "  ;  ", "p99:round_time_s", "bogus:x<1", "p99:x!1",
+    "p99:x<notanumber", "rate:x<1@w", "rate:x<1@zz=3",
+    "rate:x<1@budget=0", "rate:x<1@budget=2", "p0:x<1",
+    "rate:x<1;rate:x<1",  # duplicate objective
+    # estimator-constructor constraints die at PARSE time, not as a
+    # traceback at engine construction mid-run-setup
+    "ewma:x<1@a=0", "ewma:x<1@a=2", "rate:x<1@w=-1",
+    # ambiguous quantile spellings are refused, never misread
+    "p5:x<1", "p100:x<1", "p1000:x<1",
+    # w=0 / res= are quantile-only notions
+    "rate:x<1@w=0", "slope:x<1@w=0", "rate:x<1@res=64",
+])
+def test_parse_slo_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        slo_mod.parse_slo_spec(bad)
+
+
+def test_parse_slo_spec_comment_may_contain_semicolons():
+    objs = slo_mod.parse_slo_spec(
+        "p99:round_time_s<2.5@w=20  # fast; slow windows\n"
+        "rate:x<1  # burn; budget notes")
+    assert [o.metric for o in objs] == ["round_time_s", "x"]
+
+
+def test_cli_validates_slo_spec_at_parse_time():
+    from neuroimagedisttraining_tpu.experiments import parse_args
+
+    with pytest.raises(ValueError, match="slo_spec"):
+        parse_args(["--slo_spec", "bogus:x<1"], algo="fedavg")
+    # a path-looking spec whose file is missing names the real
+    # mistake, not "malformed DSL"
+    with pytest.raises(ValueError, match="existing spec file"):
+        parse_args(["--slo_spec", "specs/missing.slo"], algo="fedavg")
+
+
+def test_flight_slo_trigger_requires_engine(tmp_path):
+    """--flight_recorder slo without --slo_spec would arm a trigger
+    that can never fire (no event bus) — refused, not a silent no-op."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    argv = ["--model", "small3dcnn", "--dataset", "synthetic",
+            "--comm_round", "1", "--obs", "1",
+            "--flight_recorder", "slo",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results")]
+    with pytest.raises(SystemExit, match="flight_recorder slo"):
+        run_experiment(parse_args(argv, algo="fedavg"), "fedavg")
+
+
+# ---------------------------------------------------------------------------
+# streaming estimators — concrete determinism (property half skips
+# on hosts without hypothesis; these always run)
+# ---------------------------------------------------------------------------
+
+def test_windowed_quantile_matches_np_on_sliding_window():
+    rng = np.random.RandomState(7)
+    xs = rng.uniform(-5, 5, size=120)
+    for q, w in ((0.5, 8), (0.9, 16), (0.99, 20)):
+        est = slo_mod.WindowedQuantile(q, window=w)
+        for i, x in enumerate(xs):
+            est.observe(float(x))
+            lo = max(0, i + 1 - w)
+            np.testing.assert_allclose(
+                est.value(), np.quantile(xs[lo:i + 1], q),
+                rtol=1e-12, atol=0)
+
+
+def test_p2_quantile_tracks_exact_within_envelope():
+    rng = np.random.RandomState(3)
+    xs = rng.uniform(0, 100, size=400)
+    for q in (0.5, 0.9, 0.99):
+        est = slo_mod.P2Quantile(q)
+        for x in xs:
+            est.observe(float(x))
+        v = est.value()
+        lo = np.quantile(xs, max(0.0, q - 0.1))
+        hi = np.quantile(xs, min(1.0, q + 0.1))
+        assert lo <= v <= hi, (q, v, lo, hi)
+        assert xs.min() <= v <= xs.max()
+
+
+def test_estimators_are_deterministic():
+    xs = list(np.random.RandomState(11).uniform(0, 9, size=64))
+
+    def run(mk):
+        e = mk()
+        out = []
+        for x in xs:
+            e.observe(x)
+            out.append(e.value())
+        return out
+
+    for mk in (lambda: slo_mod.WindowedQuantile(0.9, 8),
+               lambda: slo_mod.P2Quantile(0.9),
+               lambda: slo_mod.ReservoirQuantile(0.9),
+               lambda: slo_mod.WindowedMean(8),
+               lambda: slo_mod.Ewma(0.2),
+               lambda: slo_mod.WindowedSlope(8)):
+        assert run(mk) == run(mk)
+
+
+def test_reservoir_quantile_exact_until_capacity():
+    xs = list(np.random.RandomState(5).uniform(-3, 3, size=40))
+    est = slo_mod.ReservoirQuantile(0.75, reservoir_size=64)
+    for x in xs:
+        est.observe(x)
+    s = sorted(xs)
+    assert est.value() == s[int(round(0.75 * (len(s) - 1)))]
+
+
+def test_mean_ewma_slope_values():
+    m = slo_mod.WindowedMean(3)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        m.observe(v)
+    assert m.value() == pytest.approx(3.0)  # mean of last 3
+    e = slo_mod.Ewma(0.5)
+    e.observe(1.0)
+    e.observe(3.0)
+    assert e.value() == pytest.approx(2.0)
+    s = slo_mod.WindowedSlope(8)
+    for i in range(5):
+        s.observe(2.0 * i + 1.0)
+    assert s.value() == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# event bus + record-derived events
+# ---------------------------------------------------------------------------
+
+def test_events_from_record_families():
+    rec = {"round": 3, "clients_quarantined": 2.0,
+           "rounds_retried": 1.0, "num_drift_s1": float("nan")}
+    evs = ev_mod.events_from_record(rec)
+    assert [e.type for e in evs] == ["GUARD", "WATCHDOG", "DRIFT"]
+    assert evs[2].detail["slots"] == [1]
+    # the final record is not a round
+    assert ev_mod.events_from_record(
+        {"round": -1, "clients_quarantined": 1.0}) == []
+
+
+def test_event_roundtrip_and_validation():
+    e = ev_mod.make_event("SLO_BREACH", 4, "msg", {"k": 1},
+                          objective="p99:x<1")
+    rec = e.to_record()
+    assert rec["event_schema"] == ev_mod.EVENT_SCHEMA_VERSION
+    assert rec["severity_label"] == "error"
+    back = ev_mod.Event.from_record(rec)
+    assert back.type == e.type and back.detail == e.detail \
+        and back.objective == e.objective
+    with pytest.raises(ValueError, match="unknown event type"):
+        ev_mod.Event(type="NOPE", round=0, severity=10, message="")
+    assert ev_mod.severity_label(35) == "error"
+
+
+def test_event_bus_counts_and_isolates_sink_errors():
+    bus = ev_mod.EventBus()
+    seen = []
+
+    def boom(ev):
+        raise RuntimeError("sink died")
+
+    bus.subscribe(boom)
+    bus.subscribe(seen.append)
+    ev = ev_mod.make_event("GUARD", 0, "x")
+    bus.emit(ev)  # must not raise
+    bus.emit(ev_mod.make_event("GUARD", 1, "y"))
+    assert len(seen) == 2
+    assert bus.counts == {"GUARD": 2} and bus.total == 2
+
+
+# ---------------------------------------------------------------------------
+# the engine: breach edges, budgets, burn, health hysteresis, replay
+# ---------------------------------------------------------------------------
+
+def _recs(vals, key="x"):
+    return [{"round": r, key: v} for r, v in enumerate(vals)]
+
+
+def test_engine_breach_degrade_fail_and_events():
+    eng = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1"))
+    evs0 = eng.observe({"round": 0, "x": 0.5})
+    assert evs0 == [] and eng.health == slo_mod.OK
+    evs1 = eng.observe({"round": 1, "x": 2.0})   # breach EDGE
+    assert [e.type for e in evs1] == ["SLO_BREACH"]
+    assert evs1[0].detail["objectives"][0]["value"] == 2.0
+    assert eng.health == slo_mod.OK              # hysteresis: streak 1
+    evs2 = eng.observe({"round": 2, "x": 2.0})   # streak 2 -> DEGRADED
+    assert [e.type for e in evs2] == ["HEALTH_TRANSITION"]
+    assert evs2[0].detail["to"] == slo_mod.DEGRADED
+    assert eng.health == slo_mod.DEGRADED
+    evs3 = eng.observe({"round": 3, "x": 2.0})
+    # default budget 0.1: 3 violations / 4 evaluated >> budget, and
+    # MIN_BUDGET_ROUNDS reached -> FAILING
+    assert eng.health == slo_mod.FAILING
+    assert any(e.type == "HEALTH_TRANSITION"
+               and e.detail["to"] == slo_mod.FAILING for e in evs3)
+    assert eng.breached == ["ewma:x<1@a=1"]
+    s = eng.summary()
+    o = s["objectives"]["ewma:x<1@a=1"]
+    assert o["violations"] == 3 and o["budget_exhausted"]
+    assert o["breach_rounds"] == [1, 2, 3]
+    assert [t["to"] for t in s["transitions"]] == [
+        slo_mod.DEGRADED, slo_mod.FAILING]
+
+
+def test_engine_recovery_hysteresis():
+    # budget=1 can never exhaust (violations <= evaluated), so the
+    # state machine exercises DEGRADED -> OK recovery
+    eng = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1,budget=1"))
+    for rec in _recs([2.0, 2.0]):
+        eng.observe(rec)
+    assert eng.health == slo_mod.DEGRADED
+    eng.observe({"round": 2, "x": 0.1})
+    eng.observe({"round": 3, "x": 0.1})
+    assert eng.health == slo_mod.DEGRADED  # clean streak 2 < 3
+    evs = eng.observe({"round": 4, "x": 0.1})
+    assert eng.health == slo_mod.OK
+    assert any(e.type == "HEALTH_TRANSITION"
+               and e.detail["to"] == slo_mod.OK for e in evs)
+    # a single breach round never degrades (hysteresis up)
+    eng2 = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1,budget=1"))
+    for rec in _recs([2.0, 0.1, 2.0, 0.1]):
+        eng2.observe(rec)
+    assert eng2.health == slo_mod.OK
+
+
+def test_engine_budget_burn_event():
+    eng = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1,budget=1"))
+    burn = []
+    for rec in _recs([2.0] * (slo_mod.BURN_FAST_WINDOW + 1)):
+        burn += [e for e in eng.observe(rec)
+                 if e.type == "BUDGET_BURN"]
+    assert len(burn) == 1  # edge-triggered, not per-round
+    d = burn[0].detail["objectives"][0]
+    assert d["fast_rate"] == 1.0 and d["slow_rate"] == 1.0
+
+
+def test_engine_missing_metric_rounds_do_not_evaluate():
+    eng = slo_mod.SloEngine(slo_mod.parse_slo_spec("ewma:x<1@a=1"))
+    for r in range(6):
+        assert eng.observe({"round": r, "other": 9.0}) == []
+    assert eng.health == slo_mod.OK
+    assert eng.summary()["objectives"]["ewma:x<1@a=1"][
+        "evaluated"] == 0
+
+
+def test_engine_replay_equals_straight_run():
+    recs = _recs([0.5, 2.0, 2.0, 2.0, 0.1, 0.1, 2.0, 0.1])
+    straight = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1"))
+    s_events = []
+    for rec in recs:
+        s_events += straight.observe(rec)
+    resumed = slo_mod.SloEngine(
+        slo_mod.parse_slo_spec("ewma:x<1@a=1"))
+    resumed.replay(recs[:4])  # the killed run's recorded rounds
+    r_events = []
+    for rec in recs[4:]:      # the resumed live rounds
+        r_events += resumed.observe(rec)
+    assert resumed.summary() == straight.summary()
+    tail = [(e.round, e.type, e.message) for e in s_events
+            if e.round >= 4]
+    assert [(e.round, e.type, e.message) for e in r_events] == tail
+
+
+# ---------------------------------------------------------------------------
+# events-stream export fold
+# ---------------------------------------------------------------------------
+
+def test_read_jsonl_partial_tail_semantics(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text('{"round": 0, "event_type": "GUARD"}\n'
+                 '{"round": 1, "event_ty')  # torn mid-write
+    with pytest.raises(ValueError, match="malformed"):
+        export.read_jsonl(str(p))
+    recs = export.read_jsonl(str(p), allow_partial_tail=True)
+    assert [r["round"] for r in recs] == [0]
+    # a malformed line FOLLOWED by data is corruption, not a torn tail
+    p2 = tmp_path / "bad.jsonl"
+    p2.write_text('{"broken\n{"round": 1, "event_type": "GUARD"}\n')
+    with pytest.raises(ValueError, match="malformed"):
+        export.read_jsonl(str(p2), allow_partial_tail=True)
+
+
+def test_dedupe_events_keeps_last_per_round_and_type():
+    recs = [
+        {"round": 1, "event_type": "GUARD", "n": 1},
+        {"round": 0, "event_type": "SLO_BREACH", "n": 2},
+        {"round": 1, "event_type": "GUARD", "n": 3},   # rerun append
+        {"round": 1, "event_type": "SLO_BREACH", "n": 4},
+        {"no_round": True},
+    ]
+    out = export.dedupe_events(recs)
+    assert [(r["round"], r["event_type"], r["n"]) for r in out] == [
+        (0, "SLO_BREACH", 2), (1, "GUARD", 3), (1, "SLO_BREACH", 4)]
+
+
+def test_merge_host_events_empty_partial_and_multihost(tmp_path):
+    a = tmp_path / "h0.events.jsonl"
+    a.write_text(
+        '{"round": 0, "event_type": "GUARD", "n": 1}\n'
+        '{"round": 0, "event_type": "GUARD", "n": 2}\n'  # dup in-host
+        '{"round": 2, "event_ty')                        # torn tail
+    b = tmp_path / "h1.events.jsonl"
+    b.write_text("\n   \n")                              # blank stream
+    c = tmp_path / "h2.events.jsonl"
+    c.write_text('{"round": 0, "event_type": "GUARD", "n": 9}\n')
+    merged = export.merge_host_events([str(a), str(b), str(c)])
+    # same (round, type) on different hosts is the fold, not a dup
+    assert [(r["round"], r["host"], r["n"]) for r in merged] == [
+        (0, 0, 2), (0, 2, 9)]
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder trigger adapter
+# ---------------------------------------------------------------------------
+
+def test_parse_triggers_slo_token():
+    from neuroimagedisttraining_tpu.obs.recorder import parse_triggers
+
+    t = parse_triggers("slo")
+    assert t["slo"] and not t["guard"] and not t["watchdog"]
+    assert parse_triggers("auto,slo")["slo"]
+    assert not parse_triggers("auto")["slo"]  # auto unchanged
+    with pytest.raises(ValueError, match="unknown trigger"):
+        parse_triggers("slow")
+
+
+def test_flight_recorder_captures_slo_events(tmp_path):
+    from neuroimagedisttraining_tpu.obs.recorder import FlightRecorder
+
+    fr = FlightRecorder(str(tmp_path), "run", spec="slo", window=4)
+    fr.observe_event(ev_mod.make_event(
+        "SLO_BREACH", 3, "breach",
+        {"objectives": [{"objective": "p99:x<1"}]},
+        objective="p99:x<1"))
+    fr.observe_event(ev_mod.make_event(
+        "HEALTH_TRANSITION", 5, "to failing",
+        {"from": "degraded", "to": "failing"}))
+    # OK transitions and non-slo event types are not captures
+    fr.observe_event(ev_mod.make_event(
+        "HEALTH_TRANSITION", 6, "to ok", {"to": "ok"}))
+    fr.observe_event(ev_mod.make_event("GUARD", 7, "guard"))
+    assert sorted(os.path.basename(b) for b in fr.bundles) == [
+        "r00003-slo_breach", "r00005-slo_failing"]
+    trig = json.load(open(os.path.join(
+        fr.bundles[0], "trigger.json")))
+    assert trig["reason"] == "slo_breach"
+    assert trig["record"]["event_type"] == "SLO_BREACH"
+    assert trig["detail"]["objective"] == "p99:x<1"
+    # the slo trigger OFF ignores the bus entirely
+    fr2 = FlightRecorder(str(tmp_path), "run2", spec="guard")
+    fr2.observe_event(ev_mod.make_event("SLO_BREACH", 1, "b"))
+    assert fr2.bundles == []
+
+
+# ---------------------------------------------------------------------------
+# tail rendering + stream resolution + obs slo CLI
+# ---------------------------------------------------------------------------
+
+def test_tail_renders_health_and_last_event():
+    from neuroimagedisttraining_tpu.obs.__main__ import format_tail_line
+
+    line = format_tail_line({
+        "round": 4, "round_time_s": 0.1, "train_loss": 0.5,
+        "slo_health": "degraded",
+        "slo_event": "SLO_BREACH(p99:round_time_s<2.5@w=20)"})
+    assert "DEGRADED" in line
+    assert "!SLO_BREACH(p99:round_time_s<2.5@w=20)" in line
+    # pre-SLO records render unchanged (no health column)
+    plain = format_tail_line({"round": 4, "train_loss": 0.5})
+    assert "OK" not in plain and "!" not in plain
+    # an event record renders in the event format
+    ev_line = format_tail_line(ev_mod.make_event(
+        "BUDGET_BURN", 2, "burning", {}).to_record())
+    assert "BUDGET_BURN" in ev_line and "WARNING" in ev_line
+
+
+def test_resolve_stream_events_suffix_and_only_events_dir(tmp_path):
+    from neuroimagedisttraining_tpu.obs.__main__ import resolve_stream
+
+    d = str(tmp_path)
+    (tmp_path / "runA.events.jsonl").write_text("")
+    # a dir holding ONLY an events stream still resolves (hardening)
+    assert resolve_stream(d) == os.path.join(d, "runA.events.jsonl")
+    # the --events mode resolves by suffix, named or newest
+    assert resolve_stream(d, suffix=".events.jsonl") == \
+        os.path.join(d, "runA.events.jsonl")
+    assert resolve_stream(d, identity="runB",
+                          suffix=".events.jsonl") == \
+        os.path.join(d, "runB.events.jsonl")
+    # an explicit events path passes through even before it exists
+    lazy = os.path.join(d, "later.events.jsonl")
+    assert resolve_stream(lazy, suffix=".events.jsonl") == lazy
+    # .obs.jsonl still wins over events when both exist
+    (tmp_path / "runA.obs.jsonl").write_text("")
+    assert resolve_stream(d) == os.path.join(d, "runA.obs.jsonl")
+
+
+def test_tail_events_mode_cli(tmp_path, capsys):
+    from neuroimagedisttraining_tpu.obs.__main__ import main as obs_main
+
+    d = tmp_path / "run"
+    d.mkdir()
+    ev = ev_mod.make_event("SLO_BREACH", 1, "breach msg",
+                           objective="rate:q<1")
+    (d / "r.events.jsonl").write_text(
+        json.dumps(ev.to_record()) + "\n")
+    assert obs_main(["tail", str(d), "--events", "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "SLO_BREACH" in out and "breach msg" in out
+    # no events stream anywhere -> exit 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs_main(["tail", str(empty), "--events", "--once"]) == 2
+
+
+def _write_run_dir(tmp_path, spec, quarantined):
+    d = tmp_path / "results"
+    d.mkdir(parents=True, exist_ok=True)
+    recs = [{"round": r, "train_loss": 0.5,
+             "clients_quarantined": q}
+            for r, q in enumerate(quarantined)]
+    with open(d / "runX.obs.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    with open(d / "runX.json", "w") as f:
+        json.dump({"config": {"slo_spec": spec}}, f)
+    return str(d)
+
+
+def test_obs_slo_subcommand_replay_and_enforce(tmp_path, capsys):
+    from neuroimagedisttraining_tpu.obs.__main__ import main as obs_main
+
+    spec = "rate:clients_quarantined<0.05@w=8"
+    d = _write_run_dir(tmp_path, spec, [0.0, 2.0, 2.0, 2.0, 2.0])
+    assert obs_main(["slo", d]) == 0
+    out = capsys.readouterr().out
+    assert "FAILING" in out and "SLO_BREACH" in out
+    assert obs_main(["slo", d, "--enforce"]) == 1
+    # a spec override re-judges the same stream
+    assert obs_main(["slo", d, "--slo_spec",
+                     "rate:clients_quarantined<99", "--enforce"]) == 0
+    # no streams -> 2; a run that recorded no spec and none given -> 2
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert obs_main(["slo", str(empty)]) == 2
+    d2 = _write_run_dir(tmp_path / "nospec", "", [0.0])
+    assert obs_main(["slo", d2]) == 2
+
+
+def test_flight_slo_bundle_contains_triggering_round(tmp_path):
+    """The runner flushes each record into the flight window BEFORE
+    the obs session's SLO evaluation, so an slo-triggered bundle's
+    window holds the round whose metrics breached."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+
+    argv = ["--model", "small3dcnn", "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--batch_size", "8",
+            "--epochs", "1", "--comm_round", "3", "--lr", "0.05",
+            "--frequency_of_the_test", "0", "--final_finetune", "0",
+            "--log_dir", str(tmp_path / "LOG"),
+            "--results_dir", str(tmp_path / "results"),
+            "--obs", "1", "--watchdog", "0",
+            "--fault_spec", "nan=0.5",
+            "--slo_spec", "rate:clients_quarantined<0.05@w=3",
+            "--flight_recorder", "slo"]
+    out = run_experiment(parse_args(argv, algo="fedavg"), "fedavg")
+    fdir = os.path.join(str(tmp_path / "results"), "synthetic",
+                        out["identity"] + ".flight")
+    bundles = sorted(b for b in os.listdir(fdir)
+                     if b.endswith("slo_breach"))
+    assert bundles, os.listdir(fdir)
+    bdir = os.path.join(fdir, bundles[0])
+    trig = json.load(open(os.path.join(bdir, "trigger.json")))
+    r = trig["round"]
+    window = export.read_jsonl(os.path.join(bdir, "window.jsonl"))
+    hit = [w for w in window if w.get("round") == r
+           and "clients_quarantined" in w]
+    assert hit, (r, [w.get("round") for w in window])
+
+
+# ---------------------------------------------------------------------------
+# analyzer schema v4
+# ---------------------------------------------------------------------------
+
+def test_analyzer_v4_slo_section_and_breach_attribution():
+    spec = "rate:clients_quarantined<0.05@w=8"
+    config = {"slo_spec": spec, "fault_spec": "nan=0.5",
+              "client_num_in_total": 4, "client_num_per_round": 4,
+              "seed": 0}
+    quarantined = [0.0, 2.0, 2.0, 2.0, 2.0, 2.0]
+    recs = []
+    engine = slo_mod.SloEngine(slo_mod.load_slo_spec(spec))
+    events = []
+    for r, q in enumerate(quarantined):
+        rec = {"round": r, "train_loss": 0.5,
+               "clients_quarantined": q}
+        for e in engine.observe(rec):
+            events.append(e.to_record())
+        rec["slo_health"] = engine.health
+        recs.append(rec)
+    a = analyze.analyze_records(recs, config=config, events=events)
+    analyze.validate_analysis(a)
+    assert a["schema_version"] == 4
+    sl = a["slo"]
+    assert sl["present"] and sl["health_final"] == "failing"
+    assert [t["to"] for t in sl["transitions"]] == [
+        "ok", "degraded", "failing"]
+    o = sl["objectives"][spec]
+    assert o["budget_exhausted"] and o["violations"] > 0
+    assert sl["budget"][spec]["exhausted"]
+    breaches = [b for b in sl["breaches"]
+                if b["event_type"] == "SLO_BREACH"]
+    assert breaches and breaches[0]["objectives"] == [spec]
+    # the fault-trace join names the injected clients for the breach
+    inj_fn = analyze._injected_fault_fn(config)
+    expected = inj_fn(breaches[0]["round"])["poisoned"]
+    assert breaches[0]["injected"]["poisoned"] == expected
+    assert breaches[0]["clients_quarantined"] == 2.0
+    assert "slo_failing" in a["flags"]
+    assert any(f.startswith("slo_breach_rounds_") for f in a["flags"])
+    report = analyze.render_report(a)
+    assert "slo (online run-health)" in report
+    assert "BREACH round" in report and "EXHAUSTED" in report
+
+
+def test_analyzer_slo_absent_for_pre_slo_streams():
+    recs = [{"round": r, "train_loss": 0.5, "round_time_s": 0.1}
+            for r in range(6)]
+    a = analyze.analyze_records(recs)
+    analyze.validate_analysis(a)
+    assert a["slo"]["present"] is False
+    assert not any(f.startswith("slo_") for f in a["flags"])
+
+
+def test_analyzer_run_dir_reads_events_sidecar(tmp_path):
+    spec = "rate:clients_quarantined<0.05@w=8"
+    d = _write_run_dir(tmp_path, spec, [0.0, 2.0, 2.0, 2.0, 2.0])
+    engine = slo_mod.SloEngine(slo_mod.load_slo_spec(spec))
+    with open(os.path.join(d, "runX.events.jsonl"), "w") as f:
+        for rec in export.read_jsonl(
+                os.path.join(d, "runX.obs.jsonl")):
+            for e in engine.observe(rec):
+                f.write(json.dumps(e.to_record()) + "\n")
+        f.write('{"torn')  # crashed mid-write: tolerated
+    (a,) = analyze.analyze_run_dir(d, write=False)
+    assert a["slo"]["present"]
+    assert a["slo"]["events"]["by_type"].get("SLO_BREACH", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the scripts/slo_smoke.py contract at CI scale
+# ---------------------------------------------------------------------------
+
+def test_slo_smoke_ci_scale(tmp_path):
+    """The full slo_smoke gate — inertness, clean twin, deterministic
+    seeded breach, fused parity, --slo_enforce exit, kill+resume
+    engine rebuild, analyzer v4 attribution — at 4 clients / 4
+    rounds."""
+    spec = importlib.util.spec_from_file_location(
+        "slo_smoke", os.path.join(REPO, "scripts", "slo_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    result = mod.main(["--clients", "4", "--rounds", "4",
+                       "--tmp", str(tmp_path)])
+    assert result["slo_ok"] is True
+    assert result["chaos_final_health"] == "failing"
+    assert result["clean_events"] == 0
+    assert result["enforce_exit"] != 0
+    assert result["breach_rounds"] and result["attributed_clients"]

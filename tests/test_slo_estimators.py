@@ -1,0 +1,113 @@
+"""Property-based validation of the SLO engine's streaming quantile
+estimators (hypothesis) against exact ``np.quantile``.
+
+obs/slo.py evaluates SLO objectives with O(1)-memory streaming
+estimators; these properties pin them to ground truth for arbitrary
+streams:
+
+* the WINDOWED estimator is exact — its value equals
+  ``np.quantile(window, q, method='linear')`` on the identical trailing
+  window, at every step of the stream;
+* the P² estimator (``w=0``, whole-run) stays inside the exact
+  quantile ENVELOPE ``[Q(q - 0.1), Q(q + 0.1)]`` (and the stream's
+  hull) once warm — the documented tolerance of the five-marker
+  approximation;
+* the fixed-reservoir estimator is EXACT (nearest-rank) while the
+  stream fits its reservoir;
+* all three are deterministic: the same stream yields the same
+  estimate sequence (the bit-reproducible-verdicts contract).
+
+The concrete (hypothesis-free) twins of these checks run in
+tests/test_slo.py on every host; this module skips where hypothesis
+is not installed (the ``test_comm_model_properties.py`` pattern).
+"""
+import numpy as np
+import pytest
+
+# hypothesis is an optional test extra (pyproject `test`); environments
+# without it must SKIP these property tests, not die at collection
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from neuroimagedisttraining_tpu.obs.slo import (
+    P2Quantile,
+    ReservoirQuantile,
+    WindowedQuantile,
+)
+
+_QS = [0.5, 0.9, 0.95, 0.99]
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(),
+       q=st.sampled_from(_QS),
+       window=st.integers(2, 32))
+def test_windowed_quantile_exact_on_every_window(data, q, window):
+    xs = data.draw(st.lists(
+        st.floats(-1e6, 1e6, allow_nan=False, allow_infinity=False),
+        min_size=1, max_size=80))
+    est = WindowedQuantile(q, window=window)
+    for i, x in enumerate(xs):
+        est.observe(x)
+        ref = np.quantile(np.asarray(xs[max(0, i + 1 - window):i + 1],
+                                     dtype=np.float64), q)
+        np.testing.assert_allclose(est.value(), ref, rtol=1e-9,
+                                   atol=1e-9)
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(), q=st.sampled_from(_QS))
+def test_p2_quantile_within_exact_envelope(data, q):
+    # unique, well-spread samples: the five-marker parabolic update's
+    # tolerance claim is about position error (<= ~1.5 ranks), which
+    # the VALUE envelope [Q(q-0.1), Q(q+0.1)] captures for distinct
+    # values; massive tie collapse is the windowed estimator's job
+    xs = data.draw(st.lists(
+        st.integers(-10_000_000, 10_000_000),
+        min_size=60, max_size=300, unique=True))
+    arr = np.asarray(xs, dtype=np.float64)
+    est = P2Quantile(q)
+    for x in arr:
+        est.observe(float(x))
+    v = est.value()
+    assert arr.min() <= v <= arr.max()
+    lo = np.quantile(arr, max(0.0, q - 0.1))
+    hi = np.quantile(arr, min(1.0, q + 0.1))
+    assert lo <= v <= hi, (q, v, lo, hi)
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(), q=st.sampled_from(_QS))
+def test_reservoir_quantile_exact_within_capacity(data, q):
+    xs = data.draw(st.lists(
+        st.floats(-1e6, 1e6, allow_nan=False, allow_infinity=False),
+        min_size=1, max_size=100))
+    est = ReservoirQuantile(q, reservoir_size=128)
+    for x in xs:
+        est.observe(x)
+    s = sorted(xs)
+    # metrics.Distribution's reservoir is the FULL sample here, so the
+    # nearest-rank estimate is exact by construction
+    assert est.value() == s[min(len(s) - 1,
+                                max(0, int(round(q * (len(s) - 1)))))]
+
+
+@settings(max_examples=30, deadline=None)
+@given(data=st.data(), q=st.sampled_from(_QS))
+def test_estimators_deterministic_per_stream(data, q):
+    xs = data.draw(st.lists(
+        st.floats(-1e3, 1e3, allow_nan=False, allow_infinity=False),
+        min_size=1, max_size=60))
+
+    def run(mk):
+        e = mk()
+        out = []
+        for x in xs:
+            e.observe(x)
+            out.append(e.value())
+        return out
+
+    for mk in (lambda: WindowedQuantile(q, 8),
+               lambda: P2Quantile(q),
+               lambda: ReservoirQuantile(q)):
+        assert run(mk) == run(mk)
