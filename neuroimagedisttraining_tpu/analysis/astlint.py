@@ -99,7 +99,6 @@ def _allowlisted(rel: str) -> bool:
 #: module prefixes exempt from the MODULE-WIDE host-sync family (the
 #: traced-context rules still apply): standalone kernel debug harnesses
 #: whose whole point is printing device values — not on any round path
-#: (and currently xfail'd for pallas API drift anyway)
 HOST_SYNC_ALLOWLIST_PREFIXES = ("ops/experimental/",)
 
 #: higher-order functions whose function-valued arguments are traced

@@ -106,6 +106,8 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "obs_tb_dir": ("inert", "telemetry output path"),
     "obs_numerics": ("inert", "in-jit telemetry, pure readout"),
     "obs_comm": ("inert", "comm telemetry, pure readout"),
+    "obs_catalog": ("inert", "fleet run-catalog append at session "
+                             "close, pure readout"),
     "slo_spec": ("inert", "online SLO evaluation, pure readout over "
                           "flushed records (bit-inert off, trajectory-"
                           "identical on)"),

@@ -404,6 +404,17 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "salientgrads/ditto) only. Off (the default) "
                         "is bit-inert; like every obs knob it never "
                         "enters run/checkpoint identity")
+    p.add_argument("--obs_catalog", type=int, default=1,
+                   help="fleet run catalog (obs/catalog.py): with "
+                        "--obs, append this run's entry (identity + "
+                        "lineage keys, identity-bearing flags, git "
+                        "SHA, final metrics, end run-health, event "
+                        "counts, artifact paths) to "
+                        "<results_dir>/runs_index.jsonl at session "
+                        "close — the index 'obs ls/diff/report' read. "
+                        "On by default under --obs; pure readout, "
+                        "bit-inert, never enters run/checkpoint "
+                        "identity")
     p.add_argument("--slo_spec", type=str, default="",
                    help="online SLO engine (obs/slo.py): declarative "
                         "objectives evaluated incrementally at the "

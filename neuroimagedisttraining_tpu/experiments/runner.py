@@ -767,6 +767,26 @@ def run_experiment(args: argparse.Namespace,
                 from ..obs.slo import SloEngine, load_slo_spec
 
                 slo_engine = SloEngine(load_slo_spec(args.slo_spec))
+            # fleet run catalog (--obs_catalog, obs/catalog.py): the
+            # append-only runs_index.jsonl entry written at session
+            # close. All entry fields are computable upfront: the
+            # stat_info JSON sidecar path is deterministic, and the
+            # checkpoint lineage key is already reconciled above.
+            cat_path, cat_info = "", None
+            if getattr(args, "obs_catalog", 1) and args.results_dir:
+                from ..obs import catalog as obs_catalog
+                from ..obs.regress import git_sha as _git_sha
+
+                cat_path = obs_catalog.catalog_path(args.results_dir)
+                cat_info = {
+                    "config": vars(args),
+                    "checkpoint_identity": run_identity(
+                        args, algo_name, for_checkpoint=True),
+                    "git_sha": _git_sha(),
+                    "stat_json": os.path.join(
+                        args.results_dir, args.dataset,
+                        identity + ".json"),
+                }
             obs_session = ObsSession(
                 jsonl_path=jsonl,
                 trace_dir=getattr(args, "trace_dir", ""),
@@ -783,7 +803,8 @@ def run_experiment(args: argparse.Namespace,
                 events_path=((jsonl[:-len(".obs.jsonl")]
                               if jsonl.endswith(".obs.jsonl")
                               else jsonl) + ".events.jsonl"
-                             if slo_engine is not None else ""))
+                             if slo_engine is not None else ""),
+                catalog_path=cat_path, catalog_info=cat_info)
             logger.info("obs: per-round JSONL -> %s", jsonl)
             if slo_engine is not None:
                 logger.info(

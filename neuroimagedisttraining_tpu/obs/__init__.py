@@ -56,6 +56,24 @@ The COMMUNICATION half — where the aggregation's bytes and time go:
   achieved wire GB/s vs the model), with a ``jit_cost_analysis``
   FLOPs/bytes fallback when no trace was captured.
 
+The FLEET half — across runs, not within one:
+
+* :mod:`~.catalog` — the append-only run catalog
+  (``results/runs_index.jsonl``): one line per recorded run (identity,
+  lineage keys, identity-bearing flags, git SHA, final metrics, end
+  run-health, event counts, artifact paths), written at session close,
+  rebuildable from run dirs for pre-catalog runs (``obs ls``).
+* :mod:`~.diff` — the three-plane cross-run diff engine (``obs
+  diff``): config plane (identity vs inert flag splits via the flag
+  census), trajectory plane (round-aligned per-metric comparison with
+  first-divergence round + MAD-band significance), event/health plane
+  (event diffs keyed ``(round, type)``, health-trajectory diffs) —
+  plus bit-exact param-tree diffs. ``--expect identical`` exit codes
+  make it the one comparator every smoke twin check routes through.
+* :mod:`~.report` — the byte-deterministic static HTML fleet report
+  (``obs report``): per-run sparklines, health/event timelines, the
+  wire-cost table, the rounds/sec-vs-cohort scatter.
+
 The ONLINE half — in-run SLO evaluation while the run is live:
 
 * :mod:`~.slo` — the online SLO engine (``--slo_spec``): a declarative
@@ -78,9 +96,11 @@ the pre-obs behavior — ``scripts/obs_smoke.py`` enforces it;
 """
 from . import (
     analyze,
+    catalog,
     comm,
     compile,
     devtrace,
+    diff,
     events,
     export,
     health,
@@ -89,10 +109,12 @@ from . import (
     numerics,
     recorder,
     regress,
+    report,
     slo,
     trace,
 )
 
-__all__ = ["analyze", "comm", "compile", "devtrace", "events",
-           "export", "health", "memory", "metrics", "numerics",
-           "recorder", "regress", "slo", "trace"]
+__all__ = ["analyze", "catalog", "comm", "compile", "devtrace",
+           "diff", "events", "export", "health", "memory", "metrics",
+           "numerics", "recorder", "regress", "report", "slo",
+           "trace"]

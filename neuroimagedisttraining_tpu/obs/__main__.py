@@ -27,11 +27,33 @@
         --metric salientgrads_rounds_per_sec_abcd_alexnet3d_8clients \
         [--history results/bench_history.jsonl]
 
+    # FLEET: list the run catalog (--rebuild rescans run dirs first —
+    # the pre-catalog migration)
+    python -m neuroimagedisttraining_tpu.obs ls results [--json] \
+        [--rebuild]
+
+    # three-plane cross-run diff (config/trajectory/event+health);
+    # --expect identical is the twin gate every smoke check routes
+    # through
+    python -m neuroimagedisttraining_tpu.obs diff \
+        results/synthetic/<runA>.obs.jsonl \
+        results/synthetic/<runB>.obs.jsonl \
+        [--expect identical] [--json] [--metrics train_loss,...]
+
+    # byte-deterministic static HTML fleet report from the catalog
+    python -m neuroimagedisttraining_tpu.obs report results \
+        [--out results/fleet_report.html] \
+        [--history results/bench_history.jsonl]
+
 Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
-tail — 0 (interrupt to stop; --once prints what's there and exits, 2
-when no stream resolves); slo — 0, 1 with --enforce when a replayed
-run ends FAILING, 2 when nothing replays; regress — the perf-gate
-codes (0 pass, 1 regression, 2 no history).
+tail — 0 (interrupt to stop; --once prints what's there and exits,
+--all prints the newest line of every cataloged run, 2 when no stream
+resolves); slo — 0, 1 with --enforce when a replayed run ends
+FAILING, 2 when nothing replays; regress — the perf-gate codes (0
+pass, 1 regression, 2 no history); ls — 0, 2 when the catalog is
+empty and nothing rescans; diff — 0 when the --expect expectation
+holds (or no expectation), 1 when it is violated, 2 when a run fails
+to load; report — 0, 2 when the catalog resolves empty.
 """
 from __future__ import annotations
 
@@ -73,6 +95,58 @@ def resolve_stream(target: str, identity: str = "",
         streams = [os.path.join(target, f) for f in os.listdir(target)
                    if f.endswith(".events.jsonl")]
     return max(streams, key=os.path.getmtime) if streams else None
+
+
+def resolve_all_streams(target: str,
+                        suffix: str = ".obs.jsonl") -> list:
+    """``tail --all``'s fan-out: every stream the target covers. A
+    results dir holding a run catalog resolves through it (each
+    cataloged run's recorded stream path); a plain run dir falls back
+    to its on-disk ``*<suffix>`` streams; a file is itself. Sorted,
+    deduped, existing streams only."""
+    from . import catalog as obs_catalog
+
+    if os.path.isfile(target):
+        return [target]
+    if not os.path.isdir(target):
+        return []
+    paths = []
+    cat = obs_catalog.catalog_path(target)
+    if os.path.exists(cat):
+        art_key = "events_jsonl" if suffix == ".events.jsonl" \
+            else "obs_jsonl"
+        for entry in obs_catalog.read_catalog(cat):
+            p = (entry.get("artifacts") or {}).get(art_key, "")
+            if p and os.path.exists(p):
+                paths.append(p)
+    if not paths:
+        paths = [os.path.join(target, f) for f in os.listdir(target)
+                 if f.endswith(suffix)]
+    return sorted(set(paths))
+
+
+def tail_all(target: str, suffix: str = ".obs.jsonl",
+             out: Callable[[str], None] = print) -> int:
+    """Print the NEWEST record of every resolved stream (one line per
+    run, identity-prefixed) — the fleet's at-a-glance state. Returns
+    streams printed."""
+    from .export import read_jsonl
+
+    printed = 0
+    for path in resolve_all_streams(target, suffix=suffix):
+        try:
+            records = read_jsonl(path, allow_partial_tail=True)
+        except (OSError, ValueError):
+            continue
+        if not records:
+            continue
+        ident = os.path.basename(path)
+        for s in (".obs.jsonl", ".events.jsonl"):
+            if ident.endswith(s):
+                ident = ident[:-len(s)]
+        out(f"{ident}: {format_tail_line(records[-1])}")
+        printed += 1
+    return printed
 
 
 def format_tail_line(rec: dict) -> str:
@@ -241,6 +315,103 @@ def slo_replay_cli(run_dir: str, identity: str = "",
     return 1 if (enforce and any_failing) else 0
 
 
+def fleet_ls_cli(target: str, as_json: bool = False,
+                 rebuild: bool = False,
+                 out: Callable[[str], None] = print) -> int:
+    """``obs ls``: list the run catalog (one line per run). ``target``
+    is a results dir (its ``runs_index.jsonl``) or a catalog path;
+    ``rebuild`` rescans the run dirs first — the pre-catalog
+    migration. Exit 2 when nothing lists."""
+    import json as _json
+
+    from . import catalog as obs_catalog
+
+    path = target
+    if os.path.isdir(target):
+        path = obs_catalog.catalog_path(target)
+        if rebuild:
+            obs_catalog.rebuild(target, path=path, force=True)
+    entries = obs_catalog.read_catalog(path)
+    if not entries:
+        print(f"no catalog entries at {path} "
+              "(run with --obs, or rescan with --rebuild)",
+              file=sys.stderr)
+        return 2
+    if as_json:
+        out(_json.dumps(entries, indent=1, sort_keys=True))
+        return 0
+    out(f"{'run':<44} {'rounds':>6} {'health':<9} {'done':<4} "
+        "final")
+    for e in entries:
+        key = f"{e.get('dataset', '')}/{e.get('identity', '')}"
+        finals = e.get("final_metrics") or {}
+        final_txt = " ".join(f"{k}={v:.4g}"
+                             for k, v in sorted(finals.items()))
+        out(f"{key:<44} {e.get('rounds_recorded', 0):>6} "
+            f"{(e.get('slo_health') or '-'):<9} "
+            f"{'yes' if e.get('completed') else 'NO':<4} "
+            f"{final_txt}")
+    return 0
+
+
+def fleet_diff_cli(target_a: str, target_b: str,
+                   identity_a: str = "", identity_b: str = "",
+                   expect: str = "", as_json: bool = False,
+                   metrics: str = "",
+                   out: Callable[[str], None] = print) -> int:
+    """``obs diff``: the three-plane cross-run diff. Exit 0 when the
+    ``--expect`` expectation holds (or none was given), 1 when it is
+    violated, 2 when a run fails to load."""
+    import json as _json
+
+    from . import diff as obs_diff
+
+    try:
+        run_a = obs_diff.load_run(target_a, identity=identity_a)
+        run_b = obs_diff.load_run(target_b, identity=identity_b)
+    except (OSError, ValueError) as e:
+        print(f"obs diff: {e}", file=sys.stderr)
+        return 2
+    metric_list = [m for m in metrics.split(",") if m] or None
+    doc = obs_diff.diff_runs(run_a, run_b, metrics=metric_list)
+    if as_json:
+        out(_json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        out(obs_diff.render_diff(doc))
+    try:
+        return obs_diff.expect_exit_code(doc, expect)
+    except ValueError as e:
+        print(f"obs diff: {e}", file=sys.stderr)
+        return 2
+
+
+def fleet_report_cli(target: str, out_path: str = "",
+                     history: str = "",
+                     out: Callable[[str], None] = print) -> int:
+    """``obs report``: render the static HTML fleet report from the
+    catalog. Exit 2 when the catalog resolves empty."""
+    from . import catalog as obs_catalog, report as obs_report
+
+    path = target
+    results_dir = os.path.dirname(target) or "."
+    if os.path.isdir(target):
+        path = obs_catalog.catalog_path(target)
+        results_dir = target
+    if not obs_catalog.read_catalog(path):
+        print(f"no catalog entries at {path} — nothing to report "
+              "(obs ls --rebuild migrates pre-catalog runs)",
+              file=sys.stderr)
+        return 2
+    out_path = out_path or os.path.join(results_dir,
+                                        "fleet_report.html")
+    history = history or os.path.join(results_dir,
+                                      "bench_history.jsonl")
+    written = obs_report.write_report(out_path, path,
+                                      history_path=history)
+    out(f"fleet report -> {written}")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m neuroimagedisttraining_tpu.obs",
@@ -273,6 +444,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="follow the run's <identity>.events.jsonl "
                          "stream (the typed SLO/guard/watchdog event "
                          "bus) instead of the per-round records")
+    pt.add_argument("--all", action="store_true",
+                    help="print the newest record of EVERY run the "
+                         "target covers (catalog-resolved when the "
+                         "dir holds runs_index.jsonl) and exit — the "
+                         "fleet's at-a-glance state")
 
     ps = sub.add_parser(
         "slo", help="offline SLO replay over a recorded run")
@@ -296,6 +472,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     pr.add_argument("--metric", required=True)
     pr.add_argument("--value", type=float, required=True)
     pr.add_argument("--lower-is-better", action="store_true")
+
+    pl = sub.add_parser("ls", help="list the run catalog")
+    pl.add_argument("target", nargs="?", default="results",
+                    help="results dir (its runs_index.jsonl) or a "
+                         "catalog path")
+    pl.add_argument("--json", action="store_true",
+                    help="print the entries as JSON")
+    pl.add_argument("--rebuild", action="store_true",
+                    help="rescan the run dirs and rewrite the catalog "
+                         "first (migrates pre-catalog runs)")
+
+    pd = sub.add_parser(
+        "diff", help="three-plane cross-run diff (the twin gate)")
+    pd.add_argument("a", help="run A: run dir or *.obs.jsonl path")
+    pd.add_argument("b", help="run B: run dir or *.obs.jsonl path")
+    pd.add_argument("--identity-a", default="",
+                    help="stream when run A is a multi-stream dir")
+    pd.add_argument("--identity-b", default="",
+                    help="stream when run B is a multi-stream dir")
+    pd.add_argument("--expect", default="",
+                    choices=["", "identical", "different"],
+                    help="gate the verdict: exit 1 when violated")
+    pd.add_argument("--json", action="store_true",
+                    help="print the machine diff instead of the "
+                         "report")
+    pd.add_argument("--metrics", default="",
+                    help="comma-separated metric allowlist for the "
+                         "trajectory plane (default: every shared "
+                         "non-volatile metric)")
+
+    pp = sub.add_parser(
+        "report", help="byte-deterministic static HTML fleet report")
+    pp.add_argument("target", nargs="?", default="results",
+                    help="results dir (its runs_index.jsonl) or a "
+                         "catalog path")
+    pp.add_argument("--out", default="",
+                    help="output path (default "
+                         "<results_dir>/fleet_report.html)")
+    pp.add_argument("--history", default="",
+                    help="bench history for the rounds/sec scatter "
+                         "(default <results_dir>/bench_history.jsonl)")
 
     args = p.parse_args(argv)
 
@@ -322,6 +539,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.cmd == "tail":
         suffix = ".events.jsonl" if args.events else ".obs.jsonl"
+        if args.all:
+            return 0 if tail_all(args.target, suffix=suffix) else 2
         path = resolve_stream(args.target, args.identity,
                               suffix=suffix)
         if path is None:
@@ -343,6 +562,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               slo_spec=args.slo_spec,
                               enforce=args.enforce,
                               as_json=args.json)
+
+    if args.cmd == "ls":
+        return fleet_ls_cli(args.target, as_json=args.json,
+                            rebuild=args.rebuild)
+
+    if args.cmd == "diff":
+        return fleet_diff_cli(args.a, args.b,
+                              identity_a=args.identity_a,
+                              identity_b=args.identity_b,
+                              expect=args.expect, as_json=args.json,
+                              metrics=args.metrics)
+
+    if args.cmd == "report":
+        return fleet_report_cli(args.target, out_path=args.out,
+                                history=args.history)
 
     from . import regress as obs_regress
 

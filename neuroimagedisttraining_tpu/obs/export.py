@@ -313,7 +313,9 @@ class ObsSession:
     def __init__(self, jsonl_path: str = "", trace_dir: str = "",
                  identity: str = "run", sample_every: int = 1,
                  tb_dir: str = "", comm: bool = False, slo=None,
-                 events_path: str = ""):
+                 events_path: str = "",
+                 catalog_path: str = "",
+                 catalog_info: Optional[Dict[str, Any]] = None):
         self.identity = identity
         self.registry = obs_metrics.MetricsRegistry()
         self.registry.gauge("obs_schema_version").set(OBS_SCHEMA_VERSION)
@@ -381,7 +383,21 @@ class ObsSession:
                 c.labels(type=ev.type).inc()
 
             self.event_bus.subscribe(_count_event)
+        # fleet catalog (--obs_catalog, obs/catalog.py): one entry
+        # appended at close — on the CLOSE path, not finish, so a
+        # crashed run still catalogs (with completed=False)
+        self.catalog_path = catalog_path
+        self._catalog_info: Dict[str, Any] = dict(catalog_info or {})
+        self._final_metrics: Dict[str, float] = {}
+        self._rounds_recorded = 0
+        self._finished = False
         self._closed = False
+
+    def set_catalog_info(self, **info: Any) -> None:
+        """Late-bound catalog-entry fields (``config``,
+        ``checkpoint_identity``, ``git_sha``, ``stat_json``) — the
+        runner knows some of them only after session construction."""
+        self._catalog_info.update(info)
 
     # -- comm telemetry --------------------------------------------------
     def set_comm_metrics(self, metrics: Dict[str, Any]) -> None:
@@ -408,6 +424,18 @@ class ObsSession:
         r = record.get("round")
         reg = self.registry
         reg.counter("rounds_recorded").inc()
+        if isinstance(r, int) and r >= 0:
+            self._rounds_recorded += 1
+        if self.catalog_path:
+            # the catalog entry's final-metrics snapshot: last-seen
+            # fold, the same fold catalog.entry_from_run rebuilds
+            from .catalog import FINAL_METRIC_KEYS
+
+            for k in FINAL_METRIC_KEYS:
+                v = record.get(k)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    self._final_metrics[k] = float(v)
         for key in ("train_loss", "round_time_s", "global_loss",
                     "personal_loss"):
             v = record.get(key)
@@ -524,8 +552,43 @@ class ObsSession:
                 self.trace_path = self.tracer.write(os.path.join(
                     self.trace_dir, self.identity + ".trace.json"))
         snap = self.registry.snapshot()
+        self._finished = True
         self.close()
         return snap
+
+    def _write_catalog_entry(self) -> None:
+        """The fleet-catalog append (--obs_catalog): one entry built
+        from this session's observed state. Never raises — a catalog
+        failure must not mask the run's own exit path."""
+        from . import catalog as obs_catalog
+
+        info = self._catalog_info
+        artifacts = {
+            "obs_jsonl": self.jsonl_path,
+            "events_jsonl": self.events_path
+            if self.event_writer is not None else "",
+            "metrics_json": self.metrics_json_path or "",
+            "trace": self.trace_path or "",
+            "stat_json": str(info.get("stat_json", "")),
+        }
+        entry = obs_catalog.build_entry(
+            identity=self.identity,
+            config=info.get("config") or {},
+            checkpoint_identity=str(info.get("checkpoint_identity",
+                                             "")),
+            git_sha=str(info.get("git_sha", "")),
+            final_metrics=self._final_metrics,
+            slo_health=self.slo.health if self.slo is not None else "",
+            event_counts=dict(self.event_bus.counts)
+            if self.event_bus is not None else {},
+            rounds_recorded=self._rounds_recorded,
+            artifacts=artifacts,
+            completed=self._finished)
+        try:
+            obs_catalog.append_entry(self.catalog_path, entry)
+        except OSError:  # pragma: no cover - disk-full edge
+            logger.warning("run-catalog append failed",
+                           exc_info=True)
 
     def close(self) -> None:
         """Idempotent teardown (the runner's ``finally`` path — a crash
@@ -533,6 +596,8 @@ class ObsSession:
         if self._closed:
             return
         self._closed = True
+        if self.catalog_path and self.exports:
+            self._write_catalog_entry()
         obs_trace.set_tracer(self._prev_tracer)
         self.compile_watch.uninstall()
         if self._msg_hook is not None:

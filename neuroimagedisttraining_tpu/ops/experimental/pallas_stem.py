@@ -91,23 +91,26 @@ def stem_conv_pallas(x, wt):
     SD = 4 if x.dtype == jnp.bfloat16 else 2
     SD = min(SD, D)
     NSTRIP = -(-D // SD)
-    E = pl.Element
 
     def start(b, s):
         return (b, jnp.minimum(s * SD, D - SD), 0, 0, 0)
 
     interpret = jax.default_backend() != "tpu"
     kern = functools.partial(_kernel, SD=SD, H=H, W=W)
+    # `start` returns ELEMENT offsets (overlapping d-strips), so these
+    # specs use unblocked indexing (the pl.Element mode of older jax)
     return pl.pallas_call(
         kern,
         grid=(B, NSTRIP),
         in_specs=[
-            pl.BlockSpec((E(1), E(SD + 2), E(Hp), E(P), E(Wp)), start,
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SD + 2, Hp, P, Wp), start,
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=pl.Unblocked()),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)), start,
-                               memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, SD, H, W, F), start,
+                               memory_space=pltpu.VMEM,
+                               indexing_mode=pl.Unblocked()),
         out_shape=jax.ShapeDtypeStruct((B, D, H, W, F), x.dtype),
         scratch_shapes=[pltpu.VMEM((216, 64 * HG), x.dtype)],
         interpret=interpret,

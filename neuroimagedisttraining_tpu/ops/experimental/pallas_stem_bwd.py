@@ -140,28 +140,35 @@ def _bwd(res, cts):
     gs2 = zero if isinstance(gs2, jax.interpreters.ad.Zero) \
         else gs2.astype(jnp.float32)
     gs = jnp.stack([gs1, gs2], axis=1)  # (B, 2, F)
-    E = pl.Element
+    # element-offset index maps (the pl.Element mode of older jax):
+    # unblocked indexing with plain int block shapes
+    unblocked = pl.Unblocked()
     dzs = pl.pallas_call(
         _bwd_kernel,
         grid=(B, NSTRIP),
         in_specs=[
-            pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+            pl.BlockSpec((1, SD, H, W, F),
                          lambda b, s: (b, _d0(s), 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
+            pl.BlockSpec((1, 1, PH, PW, F),
                          lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
                                        0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
+            pl.BlockSpec((1, 1, PH, PW, F),
                          lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
                                        0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((E(1), E(2), E(F)), lambda b, s: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
+            pl.BlockSpec((1, 2, F), lambda b, s: (b, 0, 0),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
         ],
-        out_specs=pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+        out_specs=pl.BlockSpec((1, SD, H, W, F),
                                lambda b, s: (b, _d0(s), 0, 0, 0),
-                               memory_space=pltpu.VMEM),
+                               memory_space=pltpu.VMEM,
+                               indexing_mode=unblocked),
         out_shape=jax.ShapeDtypeStruct(zs.shape, zs.dtype),
         interpret=jax.default_backend() != "tpu",
     )(zs, m, gm.astype(m.dtype), gs)

@@ -160,28 +160,34 @@ def fused_stem_fwd_v3(x, lhs, bias):
     """x: (B, 61, 73, 8, 61) phased bf16; lhs: make_stem_lhs(kernel);
     bias: (F,) f32. Returns (zs+bias, maxpool3(zs+bias), stat partials
     [B, NSTRIP, 2, F])."""
-    E = pl.Element
+    # element-offset index maps (the pl.Element mode of older jax):
+    # unblocked indexing with plain int block shapes
+    unblocked = pl.Unblocked()
     zs, pooled, stats = pl.pallas_call(
         kernel,
         grid=(B, NSTRIP),
         in_specs=[
-            pl.BlockSpec((E(1), E(SD + 2), E(Hp), E(P8), E(Wp)),
+            pl.BlockSpec((1, SD + 2, Hp, P8, Wp),
                          lambda b, s: (b, _d0(s), 0, 0, 0),
-                         memory_space=pltpu.VMEM),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((E(1), E(SD), E(H), E(W), E(F)),
+            pl.BlockSpec((1, SD, H, W, F),
                          lambda b, s: (b, _d0(s), 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((E(1), E(1), E(PH), E(PW), E(F)),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
+            pl.BlockSpec((1, 1, PH, PW, F),
                          lambda b, s: (b, jnp.minimum(_d0(s) // 3, PD - 1),
                                        0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((E(1), E(1), E(2), E(F)),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
+            pl.BlockSpec((1, 1, 2, F),
                          lambda b, s: (b, s, 0, 0),
-                         memory_space=pltpu.VMEM),
+                         memory_space=pltpu.VMEM,
+                         indexing_mode=unblocked),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, D, H, W, F), x.dtype),

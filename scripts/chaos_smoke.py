@@ -94,7 +94,6 @@ def run_bench_guard(clients: int, rounds: int, tmp: str,
     ``model``/``epochs`` size the per-round compute the overhead is
     relative to (the smoke model's rounds are nearly compute-free, which
     inflates the percentage vs. the real dry-run workload)."""
-    import numpy as np
 
     from neuroimagedisttraining_tpu.experiments import run_experiment
 
@@ -130,14 +129,16 @@ def run_bench_guard(clients: int, rounds: int, tmp: str,
     guard_ms, out_on = per_round(["--guard", "1", "--watchdog", "0"],
                                  "on")
     # clean-path guard is all selects: the params must be bit-identical
-    import jax
+    # — through the fleet comparator's params plane (obs/diff.py),
+    # which names the diverging leaves
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
 
-    for a, b in zip(
-            jax.tree_util.tree_leaves(out_off["state"].global_params),
-            jax.tree_util.tree_leaves(out_on["state"].global_params)):
-        if not np.array_equal(np.asarray(a), np.asarray(b)):
-            raise SystemExit(
-                "guard-on clean run is not bit-identical to guard-off")
+    pd = obs_diff.params_diff(out_off["state"].global_params,
+                              out_on["state"].global_params)
+    if not pd["identical"]:
+        raise SystemExit(
+            f"guard-on clean run is not bit-identical to guard-off: "
+            f"{pd['diverged'][:3]}")
     return {
         "bench_guard": True, "clients": clients, "rounds": rounds,
         "model": model, "epochs": epochs,

@@ -31,7 +31,13 @@ asserts the obs acceptance contract:
      run is bit-identical to obs-off, every round line carries the
      comm_bytes_* / comm_agg_* keys (stamped obs-schema v3), the
      analyzer emits a schema-v3 comm section with the what-if table,
-     and the same per-round overhead budget holds.
+     and the same per-round overhead budget holds,
+  7. the FLEET leg (obs/catalog.py, obs/diff.py, obs/report.py): the
+     obs run self-catalogs into runs_index.jsonl at session close
+     (and a rebuilt entry matches the live one), an exact-twin rerun
+     passes the comparator's ``obs diff --expect identical`` gate on
+     all three planes plus the params plane, and the fleet report is
+     byte-identical across two generations.
 
     python scripts/obs_smoke.py                     # CI gate
     python scripts/obs_smoke.py --clients 8 --rounds 8
@@ -138,8 +144,6 @@ def main(argv=None) -> dict:
     import logging
     import tempfile
 
-    import numpy as np
-
     logging.getLogger().setLevel(logging.WARNING)
     tmp = args.tmp or tempfile.mkdtemp(prefix="obs_smoke_")
 
@@ -180,9 +184,10 @@ def main(argv=None) -> dict:
         return max(min(w2s) - min(w1s), 1e-9) / args.rounds, out2
 
     # process-level warmup per config (page cache / BLAS pools), then the
-    # measured N and 2N runs
+    # measured N and 2N runs (the obs warmup's output feeds the fleet
+    # leg's twin diff below)
     timed_wall([], "warm_off", 1)
-    timed_wall(obs_flags, "warm_on", 1)
+    _, out_warm = timed_wall(obs_flags, "warm_on", 1)
     off_s, out_off = per_round([], "off")
     on_s, out_on = per_round(obs_flags, "on")
     overhead_pct = 100.0 * (on_s - off_s) / max(off_s, 1e-9)
@@ -206,15 +211,16 @@ def main(argv=None) -> dict:
 
     wall_gate = wall_gate_state()
 
-    # 1. bit-identical final model
-    import jax
+    # 1. bit-identical final model — through the fleet comparator's
+    # params plane (obs/diff.py), which names the diverging leaves
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
 
-    for a, b in zip(
-            jax.tree_util.tree_leaves(out_off["state"].global_params),
-            jax.tree_util.tree_leaves(out_on["state"].global_params)):
-        if not np.array_equal(np.asarray(a), np.asarray(b)):
-            raise SystemExit(
-                "obs-on run is not bit-identical to obs-off")
+    pd = obs_diff.params_diff(out_off["state"].global_params,
+                              out_on["state"].global_params)
+    if not pd["identical"]:
+        raise SystemExit(
+            f"obs-on run is not bit-identical to obs-off: "
+            f"{pd['diverged'][:3]}")
 
     # 2. artifact contract (on the last 2N obs run)
     on_2n_dir = os.path.join(tmp, f"on_2n{args.repeats - 1}")
@@ -266,12 +272,11 @@ def main(argv=None) -> dict:
     num_s, out_num = per_round(obs_flags + ["--obs_numerics", "1"],
                                "num")
     num_overhead_pct = 100.0 * (num_s - off_s) / max(off_s, 1e-9)
-    for a, b in zip(
-            jax.tree_util.tree_leaves(out_off["state"].global_params),
-            jax.tree_util.tree_leaves(out_num["state"].global_params)):
-        if not np.array_equal(np.asarray(a), np.asarray(b)):
-            raise SystemExit(
-                "obs_numerics run is not bit-identical to obs-off")
+    if not obs_diff.params_diff(
+            out_off["state"].global_params,
+            out_num["state"].global_params)["identical"]:
+        raise SystemExit(
+            "obs_numerics run is not bit-identical to obs-off")
     from neuroimagedisttraining_tpu.obs.export import read_jsonl
 
     num_dir = os.path.join(tmp, f"num_2n{args.repeats - 1}")
@@ -306,12 +311,11 @@ def main(argv=None) -> dict:
     comm_s, out_comm = per_round(obs_flags + ["--obs_comm", "1"],
                                  "comm")
     comm_overhead_pct = 100.0 * (comm_s - off_s) / max(off_s, 1e-9)
-    for a, b in zip(
-            jax.tree_util.tree_leaves(out_off["state"].global_params),
-            jax.tree_util.tree_leaves(out_comm["state"].global_params)):
-        if not np.array_equal(np.asarray(a), np.asarray(b)):
-            raise SystemExit(
-                "obs_comm run is not bit-identical to obs-off")
+    if not obs_diff.params_diff(
+            out_off["state"].global_params,
+            out_comm["state"].global_params)["identical"]:
+        raise SystemExit(
+            "obs_comm run is not bit-identical to obs-off")
     comm_dir = os.path.join(tmp, f"comm_2n{args.repeats - 1}")
     comm_jsonl = os.path.join(comm_dir, "results", "synthetic",
                               out_comm["identity"] + ".obs.jsonl")
@@ -348,6 +352,63 @@ def main(argv=None) -> dict:
             f"exceeds the {args.max_overhead_pct:g}% budget "
             f"(off {off_s * 1e3:.1f} ms, comm {comm_s * 1e3:.1f} ms)")
 
+    # 7. fleet leg (obs/catalog.py + obs/diff.py + obs/report.py):
+    # the obs run self-cataloged at session close; an exact-twin rerun
+    # passes the comparator's --expect identical gate; the fleet
+    # report is byte-deterministic across two generations.
+    from neuroimagedisttraining_tpu.obs import (
+        catalog as obs_catalog,
+        report as obs_report,
+    )
+
+    cat = obs_catalog.catalog_path(os.path.join(on_2n_dir, "results"))
+    entries = obs_catalog.read_catalog(cat)
+    if len(entries) != 1:
+        raise SystemExit(
+            f"obs run did not self-catalog: {len(entries)} entries "
+            f"at {cat}")
+    entry = entries[0]
+    if entry["rounds_recorded"] != 2 * args.rounds or \
+            not entry["completed"]:
+        raise SystemExit(f"catalog entry wrong: {entry}")
+    if not os.path.exists(entry["artifacts"].get("obs_jsonl", "")):
+        raise SystemExit(
+            f"catalog entry's stream path missing: {entry['artifacts']}")
+    # scan-vs-live equivalence: a rebuilt entry matches the one the
+    # session wrote (modulo the after-the-fact-unknowable git SHA)
+    rebuilt = obs_catalog.entry_from_run(run_dir, out_on["identity"],
+                                         git_sha=entry["git_sha"])
+    for k in ("final_metrics", "rounds_recorded", "completed",
+              "flags", "dataset", "slo_health"):
+        if rebuilt[k] != entry[k]:
+            raise SystemExit(
+                f"catalog rebuild diverges from the live entry on "
+                f"{k}: {rebuilt[k]!r} != {entry[k]!r}")
+    # exact-twin rerun through the comparator's --expect identical
+    # gate (1 round each keeps the fleet leg cheap on 1-vCPU CI)
+    _, out_twin = timed_wall(obs_flags, "fleet_twin", 1)
+    twin_doc = obs_diff.diff_runs(
+        obs_diff.load_run(os.path.join(tmp, "warm_on", "results",
+                                       "synthetic")),
+        obs_diff.load_run(os.path.join(tmp, "fleet_twin", "results",
+                                       "synthetic")))
+    if obs_diff.expect_exit_code(twin_doc, "identical") != 0:
+        raise SystemExit(
+            "exact-twin rerun failed obs diff --expect identical\n"
+            + obs_diff.render_diff(twin_doc))
+    if not obs_diff.params_diff(
+            out_warm["state"].global_params,
+            out_twin["state"].global_params)["identical"]:
+        raise SystemExit("exact-twin rerun's final params diverged")
+    # fleet-report byte determinism: two generations over the same
+    # catalog are byte-identical (no timestamps, sorted iteration)
+    r1 = obs_report.write_report(os.path.join(tmp, "fleet1.html"), cat)
+    r2 = obs_report.write_report(os.path.join(tmp, "fleet2.html"), cat)
+    with open(r1, "rb") as f1, open(r2, "rb") as f2:
+        b1, b2 = f1.read(), f2.read()
+    if b1 != b2:
+        raise SystemExit("fleet report is not byte-deterministic")
+
     result = {
         "obs_ok": True, "clients": args.clients, "rounds": args.rounds,
         "model": args.model,
@@ -361,7 +422,11 @@ def main(argv=None) -> dict:
             100.0 * noise_round_s[0] / max(off_s, 1e-9), 2),
         "comm_wire_mb": round(
             comm_recs[-1]["comm_bytes_wire"] / 1e6, 4),
-        "bit_identical": True, **art,
+        "bit_identical": True,
+        "catalog_entries": len(entries),
+        "twin_diff_identical": True,
+        "report_bytes": len(b1),
+        "report_deterministic": True, **art,
     }
     print(json.dumps(result))
     return result

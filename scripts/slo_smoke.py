@@ -16,12 +16,18 @@ contract of the online SLO engine (obs/slo.py + obs/events.py):
      events; two identical runs produce byte-identical events streams;
      ``--slo_enforce`` makes the FAILING run exit nonzero (after
      writing every artifact).
-  4. FUSED PARITY — the fused (``--fuse_rounds``) chaos twin emits the
-     identical event sequence and health trajectory.
+  4. FUSED PARITY — the fused (``--fuse_rounds``) chaos twin passes
+     the fleet comparator's full three-plane ``obs diff --expect
+     identical`` gate against the unfused run (config splits only on
+     the inert fuse_rounds axis).
   5. RESUME — a kill+``--resume`` pair (first half checkpointed, second
      half resumed; the engine deterministically rebuilds its state from
-     the JSONL) reproduces the uninterrupted run's events and health
-     stamps after the events-fold dedupe.
+     the JSONL) passes the same ``obs diff --expect identical`` gate
+     against the uninterrupted run after the keep-last dedupe — and
+     the chaos-vs-clean pair diffs NON-trivially: ``--expect
+     different`` holds, the config plane splits on the
+     identity-bearing fault_spec, and the event plane names exactly
+     the injected breach rounds.
   6. ANALYZER — obs/analyze.py emits a schema-v4 ``slo`` section whose
      breach timeline names the injected rounds and clients (the
      fault-trace join).
@@ -113,21 +119,30 @@ def main(argv=None) -> dict:
     import logging
     import tempfile
 
-    import numpy as np
-
     logging.getLogger().setLevel(logging.WARNING)
     tmp = args.tmp or tempfile.mkdtemp(prefix="slo_smoke_")
     spec = _slo_spec(args.rounds)
     slo_flags = ["--obs", "1", "--slo_spec", spec, "--watchdog", "0"]
     chaos = ["--fault_spec", CHAOS_SPEC]
 
-    import jax
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
 
     def params_equal(a, b):
-        return all(np.array_equal(np.asarray(x), np.asarray(y))
-                   for x, y in zip(
-                       jax.tree_util.tree_leaves(a.global_params),
-                       jax.tree_util.tree_leaves(b.global_params)))
+        # the params-plane twin comparator (obs/diff.py): bit-level,
+        # path-named divergences
+        return obs_diff.params_diff(a.global_params,
+                                    b.global_params)["identical"]
+
+    def twin_gate(run_dir_a, run_dir_b, label):
+        """Route a twin contract through the fleet comparator: the
+        full three-plane ``obs diff --expect identical`` gate."""
+        doc = obs_diff.diff_runs(obs_diff.load_run(run_dir_a),
+                                 obs_diff.load_run(run_dir_b))
+        if obs_diff.expect_exit_code(doc, "identical") != 0:
+            raise SystemExit(
+                f"{label}: obs diff --expect identical failed\n"
+                + obs_diff.render_diff(doc))
+        return doc
 
     def streams(sub, out, jsonl_override=""):
         d = os.path.join(tmp, sub, "results", "synthetic")
@@ -184,20 +199,20 @@ def main(argv=None) -> dict:
         raise SystemExit("two identical chaos runs emitted different "
                          "event streams")
 
-    # -- 4. fused parity ------------------------------------------------
+    # -- 4. fused parity: the full three-plane comparator gate ----------
+    # (obs diff --expect identical: config splits only on inert
+    # fuse_rounds, trajectories/events/health bit-match)
     out_fused = _run(args.clients, args.rounds, tmp, "fused",
                      slo_flags + chaos + ["--fuse_rounds", "2"])
-    recs_fused, events_fused = streams("fused", out_fused)
-    if _event_sig(events_fused) != _event_sig(events_slo):
-        raise SystemExit("fused chaos run emitted a different event "
-                         "sequence than unfused")
-    fused_health = [(r["round"], r["slo_health"]) for r in recs_fused
-                    if isinstance(r.get("round"), int)
-                    and r["round"] >= 0]
+    fused_doc = twin_gate(
+        os.path.join(tmp, "slo", "results", "synthetic"),
+        os.path.join(tmp, "fused", "results", "synthetic"),
+        "fused parity")
+    if "fuse_rounds" not in fused_doc["planes"]["config"]["inert"]:
+        raise SystemExit("fused twin's config plane did not report "
+                         "the inert fuse_rounds split")
     unfused_health = [(r["round"], r["slo_health"])
                       for r in rounds_rec]
-    if fused_health != unfused_health:
-        raise SystemExit("fused health trajectory differs from unfused")
 
     # -- 2. clean twin stays OK (zero breach events), enforce exits 0 ---
     out_clean = _run(args.clients, args.rounds, tmp, "clean",
@@ -211,6 +226,31 @@ def main(argv=None) -> dict:
     if not all(r.get("slo_health") == "ok" for r in recs_clean
                if isinstance(r.get("round"), int) and r["round"] >= 0):
         raise SystemExit("clean twin left the OK state")
+
+    # -- 2b. chaos vs clean: the comparator's NON-trivial diff ----------
+    # (--expect different holds, the config plane splits on the
+    # identity-bearing fault_spec, and the event plane names the
+    # injected rounds)
+    cc_doc = obs_diff.diff_runs(
+        obs_diff.load_run(os.path.join(tmp, "slo", "results",
+                                       "synthetic")),
+        obs_diff.load_run(os.path.join(tmp, "clean", "results",
+                                       "synthetic")))
+    if obs_diff.expect_exit_code(cc_doc, "different") != 0:
+        raise SystemExit("chaos vs clean compared identical")
+    if "fault_spec" not in cc_doc["planes"]["config"]["identity"]:
+        raise SystemExit("chaos-vs-clean config plane missed the "
+                         "identity-bearing fault_spec split")
+    chaos_only_rounds = {e["round"]
+                         for e in cc_doc["planes"]["events"]["only_a"]
+                         if e["event_type"] == "SLO_BREACH"}
+    breach_event_rounds = {e["round"] for e in events_slo
+                           if e["event_type"] == "SLO_BREACH"}
+    if chaos_only_rounds != breach_event_rounds:
+        raise SystemExit(
+            f"chaos-vs-clean event plane named rounds "
+            f"{sorted(chaos_only_rounds)}, expected "
+            f"{sorted(breach_event_rounds)}")
 
     # -- 3b. --slo_enforce: the FAILING chaos run exits nonzero ---------
     enforce_code = 0
@@ -241,15 +281,20 @@ def main(argv=None) -> dict:
     if not params_equal(out_slo["state"], out_b["state"]):
         raise SystemExit("resumed run's final state differs from the "
                          "uninterrupted run")
-    recs_b = _read(jsonl_b)
+    # the full three-plane comparator gate over the streams (the
+    # override stream has no stat sidecar, so the config plane
+    # abstains; trajectory/events/health must bit-match after the
+    # keep-last dedupe)
+    resume_doc = twin_gate(
+        os.path.join(tmp, "slo", "results", "synthetic"), jsonl_b,
+        "kill+resume")
+    health_b = [tuple(x) for x in resume_doc["planes"]["health"]["b"]]
+    if [tuple(x) for x in resume_doc["planes"]["health"]["a"]] != \
+            health_b:
+        raise SystemExit(
+            f"resumed health trajectory {health_b} != uninterrupted")
     events_b = _read(jsonl_b[:-len(".obs.jsonl")] + ".events.jsonl",
                      events=True)
-    health_b = [(r["round"], r["slo_health"]) for r in recs_b
-                if isinstance(r.get("round"), int) and r["round"] >= 0]
-    if health_b != unfused_health:
-        raise SystemExit(
-            f"resumed health trajectory {health_b} != uninterrupted "
-            f"{unfused_health}")
     if _event_sig(events_b) != _event_sig(events_slo):
         raise SystemExit("resumed event stream (deduped) differs from "
                          "the uninterrupted run's")
@@ -286,6 +331,8 @@ def main(argv=None) -> dict:
         "clean_events": len(events_clean),
         "enforce_exit": enforce_code,
         "resume_events_match": True, "fused_events_match": True,
+        "twin_comparator": "obs_diff",
+        "chaos_vs_clean_breach_rounds": sorted(chaos_only_rounds),
         "breach_rounds": sorted({b["round"] for b in breaches}),
         "attributed_clients": sorted({
             c for b in attributed for c in b["injected"]["poisoned"]}),

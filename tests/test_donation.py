@@ -189,7 +189,10 @@ def test_watchdog_last_good_survives_donated_retry():
 def test_runner_donate_on_off_bit_identical(tmp_path):
     """The CLI default (--donate_state 1) against an explicit
     --donate_state 0 run: identical histories — donation never enters
-    run identity because there is nothing to key."""
+    run identity because there is nothing to key. Both runs record
+    their obs streams and the twin verdict routes through the fleet
+    comparator (``obs diff --expect identical``) — the same gate the
+    fused-parity and kill+resume twins use."""
     from neuroimagedisttraining_tpu.experiments import (
         parse_args,
         run_experiment,
@@ -197,13 +200,15 @@ def test_runner_donate_on_off_bit_identical(tmp_path):
     from neuroimagedisttraining_tpu.experiments.config import (
         run_identity,
     )
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
 
     def argv(tag, donate):
         return ["--model", "small3dcnn", "--dataset", "synthetic",
                 "--client_num_in_total", "4", "--batch_size", "8",
                 "--epochs", "1", "--comm_round", "3", "--lr", "0.05",
                 "--frac", "0.5", "--frequency_of_the_test", "1",
-                "--donate_state", donate, "--results_dir", "",
+                "--donate_state", donate, "--obs", "1",
+                "--results_dir", str(tmp_path / tag / "results"),
                 "--log_dir", str(tmp_path / f"LOG{tag}")]
 
     out_on = run_experiment(parse_args(argv("on", "1"), algo="fedavg"),
@@ -213,13 +218,18 @@ def test_runner_donate_on_off_bit_identical(tmp_path):
     assert out_on["identity"] == out_off["identity"]
     assert "donate" not in run_identity(
         parse_args(argv("i", "1"), algo="fedavg"), "fedavg")
-    h_on = [h for h in out_on["history"] if h["round"] >= 0]
-    h_off = [h for h in out_off["history"] if h["round"] >= 0]
-    assert len(h_on) == len(h_off) == 3
-    for a, b in zip(h_on, h_off):
-        for k in a:
-            if k != "round_time_s":
-                assert float(a[k]) == float(b[k]), (a["round"], k)
+    doc = obs_diff.diff_runs(
+        obs_diff.load_run(str(tmp_path / "on" / "results" /
+                              "synthetic")),
+        obs_diff.load_run(str(tmp_path / "off" / "results" /
+                              "synthetic")))
+    assert obs_diff.expect_exit_code(doc, "identical") == 0, \
+        obs_diff.render_diff(doc)
+    # the varied axis lands in the INERT bucket — reported, allowed
+    assert "donate_state" in doc["planes"]["config"]["inert"]
+    pd = obs_diff.params_diff(out_on["state"].global_params,
+                              out_off["state"].global_params)
+    assert pd["identical"], pd["diverged"][:3]
 
 
 def test_c256_cohort_fused_smoke():

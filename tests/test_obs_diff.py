@@ -1,0 +1,318 @@
+"""Cross-run diff engine (obs/diff.py): the three-plane twin gate.
+
+Covers the comparator contract: config-plane bucketing by the
+identity census (hard-rule inert prefixes included) and the abstention
+on bare streams, trajectory-plane first-bit-divergence semantics
+(NaN==NaN is NOT a divergence; volatile wall-clock keys never count),
+event/health plane diffs, the twin verdict (inert differences allowed,
+identity differences fatal), ``--expect`` exit-code mapping, the
+params-plane bit comparator, and the CLI's load-error exit code.
+"""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.obs import diff
+from neuroimagedisttraining_tpu.obs.__main__ import fleet_diff_cli
+
+
+def _run(records=None, events=None, config=None, identity="run"):
+    return {"identity": identity, "records": records or [],
+            "events": events or [], "config": config or {}}
+
+
+def _rounds(n, **overrides):
+    out = []
+    for r in range(n):
+        rec = {"round": r, "train_loss": 1.0 / (r + 1),
+               "sum_comm_params": 100.0 * (r + 1),
+               "round_time_s": 0.1 * (r + 1)}  # volatile: may differ
+        rec.update({k: v(r) if callable(v) else v
+                    for k, v in overrides.items()})
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# config plane
+# ---------------------------------------------------------------------------
+
+def test_config_diff_buckets_by_census():
+    a = {"fault_spec": "", "fuse_rounds": 1, "obs_comm": 0,
+         "not_a_flag": 1, "lr": 0.05}
+    b = {"fault_spec": "nan=0.1", "fuse_rounds": 4, "obs_comm": 1,
+         "not_a_flag": 2, "lr": 0.05}
+    d = diff.config_diff(a, b)
+    assert "fault_spec" in d["identity"]  # census: identity-bearing
+    assert "fuse_rounds" in d["inert"]  # census: inert
+    assert "obs_comm" in d["inert"]  # hard rule: obs_ prefix
+    assert "not_a_flag" in d["unclassified"]
+    assert "lr" not in d["identity"]  # equal values never listed
+    assert not d["identical"] and not d["same_experiment"]
+
+
+def test_config_diff_identical():
+    d = diff.config_diff({"lr": 0.05}, {"lr": 0.05})
+    assert d["identical"] and d["same_experiment"]
+
+
+def test_config_plane_abstains_on_bare_stream():
+    # an --obs_jsonl override stream has no stat sidecar: fabricating
+    # every-flag differences would poison the twin verdict
+    doc = diff.diff_runs(
+        _run(records=_rounds(2), config={}),
+        _run(records=_rounds(2), config={"dataset": "synthetic",
+                                         "fault_spec": "nan=0.1"}))
+    cfg = doc["planes"]["config"]
+    assert cfg["unavailable"] and cfg["identical"]
+    assert doc["identical"]  # streams match → still a twin
+    assert "abstains" in diff.render_diff(doc)
+
+
+# ---------------------------------------------------------------------------
+# trajectory plane
+# ---------------------------------------------------------------------------
+
+def test_trajectory_identical_streams():
+    t = diff.trajectory_diff(_rounds(4), _rounds(4))
+    assert t["identical"] and t["first_divergence_round"] is None
+    assert t["diverged_metrics"] == []
+
+
+def test_trajectory_first_bit_divergence_round():
+    a = _rounds(5)
+    b = _rounds(5)
+    b[3]["train_loss"] += 1e-12  # one ULP-ish nudge IS a divergence
+    t = diff.trajectory_diff(a, b)
+    assert not t["identical"]
+    assert t["first_divergence_round"] == 3
+    m = t["metrics"]["train_loss"]
+    assert m["first_divergence_round"] == 3
+    assert m["diverged_rounds"] == 1
+    # a tiny nudge is bit-different but NOT significant vs the MAD band
+    assert not m["significant"]
+
+
+def test_trajectory_spike_is_significant():
+    # the band is a MAD over the POOLED series — a one-round spike
+    # stands clear of the shared noise floor and flags significant
+    a = _rounds(6, train_loss=1.0)
+    b = _rounds(6, train_loss=lambda r: 100.0 if r == 3 else 1.0)
+    t = diff.trajectory_diff(a, b)
+    assert "train_loss" in t["significant_metrics"]
+    assert t["metrics"]["train_loss"]["first_divergence_round"] == 3
+
+
+def test_trajectory_nan_matches_nan():
+    a = _rounds(3, train_loss=lambda r: float("nan") if r == 1
+                else 1.0)
+    b = _rounds(3, train_loss=lambda r: float("nan") if r == 1
+                else 1.0)
+    t = diff.trajectory_diff(a, b)
+    assert t["identical"]  # a deterministic twin reproduces its NaNs
+
+
+def test_trajectory_nan_vs_value_diverges():
+    a = _rounds(3, train_loss=lambda r: float("nan") if r == 1
+                else 1.0)
+    b = _rounds(3, train_loss=1.0)
+    t = diff.trajectory_diff(a, b)
+    assert t["metrics"]["train_loss"]["first_divergence_round"] == 1
+    assert t["metrics"]["train_loss"]["max_abs_delta"] == float("inf")
+
+
+def test_trajectory_volatile_keys_never_count():
+    a = _rounds(3)
+    b = _rounds(3, round_time_s=99.0, mem_rss_mb=1e9)
+    t = diff.trajectory_diff(a, b)
+    assert t["identical"]
+    assert "round_time_s" not in t["metrics"]
+
+
+def test_trajectory_missing_rounds_and_keys():
+    a = _rounds(4, extra_metric=1.0)
+    b = _rounds(3)
+    t = diff.trajectory_diff(a, b)
+    assert not t["identical"]
+    assert t["rounds_only_a"] == [3]
+    assert "extra_metric" in t["keys_only_a"]
+
+
+def test_trajectory_metric_allowlist():
+    a = _rounds(3)
+    b = _rounds(3, sum_comm_params=0.0)
+    t = diff.trajectory_diff(a, b, metrics=["train_loss"])
+    assert t["identical"]  # the diverging metric is filtered out
+
+
+# ---------------------------------------------------------------------------
+# event / health plane
+# ---------------------------------------------------------------------------
+
+def _ev(r, t, **kw):
+    return {"round": r, "event_type": t, "severity": "warning", **kw}
+
+
+def test_events_diff_only_and_changed():
+    a = [_ev(0, "SLO_BREACH"), _ev(2, "SLO_RECOVERY")]
+    b = [_ev(0, "SLO_BREACH", severity="critical"),
+         _ev(3, "SLO_BREACH")]
+    d = diff.events_diff(a, b)
+    assert [(e["round"], e["event_type"]) for e in d["only_a"]] == \
+        [(2, "SLO_RECOVERY")]
+    assert [(e["round"], e["event_type"]) for e in d["only_b"]] == \
+        [(3, "SLO_BREACH")]
+    assert d["changed"] == [{"round": 0, "event_type": "SLO_BREACH",
+                             "fields": ["severity"]}]
+    assert not d["identical"]
+
+
+def test_events_diff_identical():
+    a = [_ev(0, "SLO_BREACH")]
+    assert diff.events_diff(a, list(a))["identical"]
+
+
+def test_health_diff_trajectory_and_divergence():
+    a = _rounds(4, slo_health=lambda r: "ok" if r < 2 else "degraded")
+    b = _rounds(4, slo_health="ok")
+    d = diff.health_diff(a, b)
+    assert d["a"] == [[0, "ok"], [2, "degraded"]]
+    assert d["b"] == [[0, "ok"]]
+    assert d["end_a"] == "degraded" and d["end_b"] == "ok"
+    assert d["first_divergence_round"] == 2
+    assert not d["identical"]
+    assert diff.health_diff(a, list(a))["identical"]
+
+
+# ---------------------------------------------------------------------------
+# the full diff + expect gate
+# ---------------------------------------------------------------------------
+
+def test_diff_runs_twin_allows_inert_config_differences():
+    cfg_a = {"dataset": "synthetic", "fuse_rounds": 1, "obs_comm": 0}
+    cfg_b = {"dataset": "synthetic", "fuse_rounds": 4, "obs_comm": 1}
+    doc = diff.diff_runs(_run(records=_rounds(3), config=cfg_a),
+                         _run(records=_rounds(3), config=cfg_b))
+    assert doc["identical"]  # the inert axes ARE the twin variation
+    assert "fuse_rounds" in doc["planes"]["config"]["inert"]
+    assert diff.expect_exit_code(doc, "identical") == 0
+    assert diff.expect_exit_code(doc, "different") == 1
+
+
+def test_diff_runs_identity_difference_breaks_twin():
+    cfg_a = {"dataset": "synthetic", "fault_spec": ""}
+    cfg_b = {"dataset": "synthetic", "fault_spec": "nan=0.1"}
+    doc = diff.diff_runs(_run(records=_rounds(3), config=cfg_a),
+                         _run(records=_rounds(3), config=cfg_b))
+    assert not doc["identical"]
+    assert "fault_spec" in doc["planes"]["config"]["identity"]
+    assert diff.expect_exit_code(doc, "different") == 0
+
+
+def test_expect_exit_code_empty_and_unknown():
+    doc = diff.diff_runs(_run(records=_rounds(2)),
+                         _run(records=_rounds(2)))
+    assert diff.expect_exit_code(doc, "") == 0  # report-only
+    with pytest.raises(ValueError):
+        diff.expect_exit_code(doc, "bogus")
+
+
+def test_render_diff_names_divergence():
+    a = _rounds(4)
+    b = _rounds(4)
+    b[2]["train_loss"] = 99.0
+    doc = diff.diff_runs(_run(a, identity="A"), _run(b, identity="B"))
+    text = diff.render_diff(doc)
+    assert "DIFFERENT" in text
+    assert "first bit divergence at round 2" in text
+    doc2 = diff.diff_runs(_run(a), _run(list(a)))
+    assert "IDENTICAL (twin)" in diff.render_diff(doc2)
+
+
+# ---------------------------------------------------------------------------
+# params plane
+# ---------------------------------------------------------------------------
+
+def test_params_diff_identical_and_nan_bits():
+    tree = {"w": np.array([1.0, float("nan")], np.float32),
+            "b": np.zeros(3, np.float32)}
+    clone = {k: v.copy() for k, v in tree.items()}
+    d = diff.params_diff(tree, clone)
+    assert d["identical"] and d["leaves"] == 2  # same NaN bytes match
+
+
+def test_params_diff_names_diverged_leaf():
+    a = {"w": np.array([1.0, 2.0], np.float32),
+         "b": np.zeros(3, np.float32)}
+    b = {"w": np.array([1.0, 2.5], np.float32),
+         "b": np.zeros(3, np.float32)}
+    d = diff.params_diff(a, b)
+    assert not d["identical"]
+    (leaf,) = d["diverged"]
+    assert "w" in leaf["leaf"] and leaf["n_diff"] == 1
+    assert leaf["max_abs_delta"] == 0.5
+
+
+def test_params_diff_shape_mismatch():
+    d = diff.params_diff({"w": np.zeros(2, np.float32)},
+                         {"w": np.zeros(3, np.float32)})
+    assert not d["identical"]
+    assert d["diverged"][0]["reason"] == "shape/dtype"
+
+
+# ---------------------------------------------------------------------------
+# load_run + CLI exit codes
+# ---------------------------------------------------------------------------
+
+def _seed_stream(run_dir, identity, records, config=None):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, identity + ".obs.jsonl"),
+              "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    if config is not None:
+        with open(os.path.join(run_dir, identity + ".json"),
+                  "w") as f:
+            json.dump({"config": config}, f)
+
+
+def test_load_run_from_dir_and_stream(tmp_path):
+    run_dir = str(tmp_path / "synthetic")
+    _seed_stream(run_dir, "run-a", _rounds(2),
+                 config={"dataset": "synthetic"})
+    by_dir = diff.load_run(run_dir)  # single stream: no identity
+    assert by_dir["identity"] == "run-a"
+    assert len(by_dir["records"]) == 2
+    assert by_dir["config"]["dataset"] == "synthetic"
+    by_path = diff.load_run(
+        os.path.join(run_dir, "run-a.obs.jsonl"))
+    assert by_path["records"] == by_dir["records"]
+
+
+def test_load_run_ambiguous_dir_raises(tmp_path):
+    run_dir = str(tmp_path / "synthetic")
+    _seed_stream(run_dir, "run-a", _rounds(1))
+    _seed_stream(run_dir, "run-b", _rounds(1))
+    with pytest.raises(ValueError):
+        diff.load_run(run_dir)
+    assert diff.load_run(run_dir, identity="run-b")["identity"] == \
+        "run-b"
+
+
+def test_fleet_diff_cli_exit_codes(tmp_path, capsys):
+    run_dir = str(tmp_path / "synthetic")
+    _seed_stream(run_dir, "run-a", _rounds(3))
+    _seed_stream(run_dir, "run-b", _rounds(3))
+    a = os.path.join(run_dir, "run-a.obs.jsonl")
+    b = os.path.join(run_dir, "run-b.obs.jsonl")
+    assert fleet_diff_cli(a, b, expect="identical") == 0
+    assert fleet_diff_cli(a, b, expect="different") == 1
+    # ambiguous dir → load error → 2
+    assert fleet_diff_cli(run_dir, b) == 2
+    out = []
+    assert fleet_diff_cli(a, b, as_json=True, out=out.append) == 0
+    doc = json.loads(out[0])
+    assert doc["identical"] is True

@@ -17,11 +17,6 @@ def _ref_conv(x, w):
                                     dimension_numbers=dn)
 
 
-@pytest.mark.xfail(
-    reason="jax.experimental.pallas API drift: this jax version removed "
-           "pl.Element (stem_conv_pallas's BlockSpec indexing mode); the "
-           "experimental kernel needs a port to the current pallas API",
-    strict=False)
 @pytest.mark.parametrize("shape,feat", [
     ((2, 12, 13, 8, 12), 16),
     ((1, 8, 10, 8, 9), 8),

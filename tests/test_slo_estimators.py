@@ -18,16 +18,20 @@ streams:
   estimate sequence (the bit-reproducible-verdicts contract).
 
 The concrete (hypothesis-free) twins of these checks run in
-tests/test_slo.py on every host; this module skips where hypothesis
-is not installed (the ``test_comm_model_properties.py`` pattern).
+tests/test_slo.py on every host; where hypothesis is not installed,
+``tests/_hypothesis_fallback.py`` supplies a deterministic example
+generator so the properties still run (no silent skip).
 """
 import numpy as np
 import pytest
 
-# hypothesis is an optional test extra (pyproject `test`); environments
-# without it must SKIP these property tests, not die at collection
-pytest.importorskip("hypothesis")
-from hypothesis import given, settings, strategies as st
+# hypothesis is an optional test extra (pyproject `test`); without it
+# the deterministic shim keeps the properties exercised (weaker — no
+# shrinking — but never a silent skip)
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    from _hypothesis_fallback import given, settings, strategies as st
 
 from neuroimagedisttraining_tpu.obs.slo import (
     P2Quantile,
