@@ -649,6 +649,79 @@ def tracked_config(name: str):
                     print(f"# cohort history append skipped: {e}",
                           file=sys.stderr, flush=True)
             del data, algo, state, ys  # free this cohort before the next
+        # Population cells (ISSUE 14): C=1k/4k/16k through the
+        # --client_store host streamed-residency path — only the S=8
+        # sampled rows (and the fused block's row union) ever reach
+        # device, so HBM stays flat in C while the resident cells above
+        # grow linearly. Data is HOST numpy (the residency contract:
+        # per-round slabs device_put on demand), volumes shrink to 8^3 /
+        # 2 samples per client so the 16k cohort's host footprint stays
+        # tens of MB. Three gated series per cell: rounds/sec, the
+        # device-memory ledger (expected FLAT — the acceptance curve in
+        # RESULTS.md), and the new store_gather_ms_* host->device
+        # gather timing (per-round mean; lower-is-better prefix).
+        from neuroimagedisttraining_tpu.data.synthetic import (
+            make_synthetic_federated,
+        )
+
+        pop_sizes = tuple(int(c) for c in os.environ.get(
+            "BENCH_POP_COHORTS", "1024,4096,16384").split(",") if c)
+        for n_clients in pop_sizes:
+            data = make_synthetic_federated(
+                seed=0, n_clients=n_clients, samples_per_client=2,
+                test_per_client=1, sample_shape=(8, 8, 8, 1),
+                class_num=2, loss_type="bce")
+            algo = FedAvg(model, data, hp, loss_type="bce",
+                          frac=8.0 / n_clients, seed=0,
+                          donate_state=True,
+                          client_store="host", store_hot_clients=64)
+            state = algo.init_state(jax.random.PRNGKey(0))
+            # warmup block (compile; store mode refuses in-graph eval,
+            # so blocks run eval_every=0), then timed whole blocks
+            state, ys = algo.run_rounds_fused(state, 0, block,
+                                              eval_every=0)
+            ys.materialize()
+            _sync_state(state)
+            g0 = algo._store.stats()["store_gather_ms"]
+            with obs_metrics.get_registry().timer(
+                    f"bench_pop_c{n_clients}") as tm:
+                r0 = block
+                while r0 < block + rounds:
+                    state, ys = algo.run_rounds_fused(
+                        state, r0, block, eval_every=0)
+                    r0 += block
+                ys.materialize()
+                _sync_state(state)
+            rps = rounds / tm.elapsed
+            gather_ms = (algo._store.stats()["store_gather_ms"] - g0) \
+                / rounds
+            devs = obs_memory.device_memory()
+            in_use = max((d["bytes_in_use"] for d in devs), default=0)
+            cells[f"pop_c{n_clients}"] = {
+                "rounds_per_sec": round(rps, 4),
+                "mem_bytes": int(in_use),
+                "store_gather_ms": round(gather_ms, 3),
+                "mem_source": devs[0]["source"] if devs
+                else "unavailable",
+            }
+            for metric, value, unit in (
+                    (f"cohort_rounds_per_sec_pop_c{n_clients}", rps,
+                     "rounds/sec"),
+                    (f"cohort_mem_bytes_pop_c{n_clients}",
+                     float(in_use), "bytes"),
+                    (f"store_gather_ms_c{n_clients}", gather_ms,
+                     "ms/round")):
+                try:
+                    regress.append_history(
+                        history, {"metric": metric, "value": value,
+                                  "unit": unit},
+                        source="bench_cohort", repo_root=root)
+                except Exception as e:  # read-only checkout
+                    import sys
+
+                    print(f"# cohort history append skipped: {e}",
+                          file=sys.stderr, flush=True)
+            del data, algo, state, ys
         biggest = f"c{max(sizes)}"
         result = {
             "metric": ("fedavg_cohort_rounds_per_sec_small3dcnn_"
@@ -658,6 +731,7 @@ def tracked_config(name: str):
             "vs_baseline": 0.0,  # scaling cell, not a rate target
             "extra": {"cells": cells, "block": block,
                       "trained_per_round": 8, "volume": list(vol),
+                      "pop_volume": [8, 8, 8],
                       "n_devices": len(jax.devices())},
         }
         return _emit_result(result)

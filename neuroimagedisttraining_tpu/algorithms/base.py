@@ -165,6 +165,9 @@ class FedAlgorithm(abc.ABC):
         guard: Optional[bool] = None,
         obs_numerics: bool = False,
         donate_state: bool = False,
+        client_store: str = "device",
+        store_hot_clients: int = 64,
+        store_dir: Optional[str] = None,
     ):
         from ..parallel.collectives import AGG_IMPLS, DEFAULT_BUCKET_SIZE
 
@@ -385,6 +388,72 @@ class FedAlgorithm(abc.ABC):
             # the forward-count test can wrap it and pin the width
             self._eval_cache_rows = self._vmap_clients(
                 self.eval_client, in_axes=(0, 0, 0, 0))
+        # client_store: the population-residency mode (core/client_store
+        # .py — ROADMAP Open item 2). "device" (default) is today's
+        # fully-resident layout; "host"/"disk" move the per-client rows
+        # (personal_params, topk agg_residual) OFF device: state holds
+        # None between rounds, each round attaches a transient [S]
+        # cohort slab gathered from the store and stages the trained
+        # slab back. The round program is the SAME round_fn traced at
+        # slab width — sel_idx becomes stack positions arange(S) and the
+        # population ids ride in through _trace_pop_idx for the two
+        # reads that need them (fault keying, eval-cache scatter) — so
+        # streamed runs are bit-identical to resident runs
+        # (tests/test_client_store.py pins it) with HBM flat in C.
+        # Residency never enters run identity (inert, like donate_state).
+        self._trace_pop_idx = None  # set ONLY while tracing a store round
+        self._store = None
+        self._round_jit_store = None
+        self._store_round_raw = None
+        self._store_eval_cache = None   # host (correct, loss_sum, total)
+        self._store_eval_dirty: List[np.ndarray] = []
+        self._host_data = None          # cached numpy views of the shards
+        self._host_test = None
+        self.client_store = client_store
+        self.store_hot_clients = int(store_hot_clients)
+        if client_store != "device":
+            from ..core.client_store import STORE_MODES, ClientStore
+
+            if client_store not in ("device",) + STORE_MODES:
+                raise ValueError(
+                    f"client_store {client_store!r} not in "
+                    f"{('device',) + STORE_MODES}")
+            if not self.store_supported:
+                raise ValueError(
+                    f"{self.name}: client_store={client_store!r} needs "
+                    "the store-backed round entry (fedavg/salientgrads/"
+                    "ditto — the central-aggregate algorithms whose "
+                    "per-client rows stream by cohort)")
+            if self.clients_per_round >= self.num_clients:
+                raise ValueError(
+                    f"{self.name}: client_store streams the SAMPLED "
+                    "cohort; full participation keeps every row on "
+                    "device each round, so there is nothing to stream "
+                    "— use client_store='device' (or frac < 1)")
+            if self._eval_idx is not None:
+                raise ValueError(
+                    f"{self.name}: eval_clients indexes the resident "
+                    "[C] personal stack; with client_store the stack "
+                    "is not resident — use one or the other")
+            if not getattr(self, "track_personal", True) \
+                    and self.agg_impl != "topk":
+                raise ValueError(
+                    f"{self.name}: client_store={client_store!r} with "
+                    "track_personal=False and no topk residual has no "
+                    "per-client rows to stream — drop --client_store "
+                    "(the run is already O(S) in device memory)")
+            self._store = ClientStore(
+                self.num_clients, mode=client_store,
+                hot_clients=store_hot_clients, root=store_dir)
+            # The residency contract covers the DATA shards too: loaders
+            # hand back device-backed [C] stacks (pad_stack ends in
+            # jnp.asarray), and a full-[C] x_train alone defeats
+            # HBM-flat-in-C before the first round runs. Pull the shards
+            # to host once so the device copies free; every store-mode
+            # read goes through the numpy views in _store_host_rows.
+            self.data = jax.tree_util.tree_map(
+                lambda a: np.array(jax.device_get(a), copy=True),
+                self.data)
         self._fused_cache: Dict[Any, Any] = {}  # (block, eval_every) -> jit
         self._personal_cache_reset()
         self._build()
@@ -413,8 +482,13 @@ class FedAlgorithm(abc.ABC):
         impl = getattr(self, "_eval_impl", None)
         if impl is not None:
             # traceable: the in-state eval cache when it is live (the
-            # O(C)-forwards-free re-reduce), else the full personal eval
-            pf = self._cache_personal_fn(state) or self._eval_personal
+            # O(C)-forwards-free re-reduce), else the full personal
+            # eval. Store mode without the cache routes to the host-side
+            # store eval (NOT traceable — but the only in-graph caller,
+            # the fused eval cadence, is refused with the store)
+            pf = self._cache_personal_fn(state) or (
+                self._personal_eval_store if self._store is not None
+                else self._eval_personal)
             return impl(state, x_test, y_test, n_test, pf)
         raise NotImplementedError(
             f"{type(self).__name__} must implement eval_metrics (traceable"
@@ -434,9 +508,12 @@ class FedAlgorithm(abc.ABC):
         impl = getattr(self, "_eval_impl", None)
         if impl is not None:
             # in-state eval cache first (jitted [C] re-reduce, zero
-            # forwards), then the host-side incremental cache
-            pf = self._cache_personal_fn(state, jit=True) \
-                or self._personal_eval_cached
+            # forwards), then the store-backed incremental eval (the
+            # personal stack is not resident), then the host-side
+            # incremental cache
+            pf = self._cache_personal_fn(state, jit=True) or (
+                self._personal_eval_store if self._store is not None
+                else self._personal_eval_cached)
             return impl(state, d.x_test, d.y_test, d.n_test, pf)
         return self.eval_metrics(state, d.x_test, d.y_test, d.n_test)
 
@@ -482,6 +559,14 @@ class FedAlgorithm(abc.ABC):
     #: layout: the donating fused program returns the threaded data
     #: arrays and ``run_rounds_fused`` rebinds ``self.data`` from them.
     donate_supported: bool = False
+
+    #: whether this algorithm's round entry composes with the population
+    #: client store (``--client_store host|disk``): its round_fn takes
+    #: (state, sel_idx, round_idx, x, y, n[, test...]) with the
+    #: per-client rows living on State.personal_params/agg_residual, and
+    #: its body is width-polymorphic — the same trace runs at cohort-slab
+    #: width [S] with sel_idx = arange(S) (FedAvg/SalientGrads/Ditto).
+    store_supported: bool = False
 
     def clone_state(self, state: Any) -> Any:
         """Borrow API of the state-ownership protocol: a deep on-device
@@ -827,9 +912,14 @@ class FedAlgorithm(abc.ABC):
         if self.fault_fn is not None:
             # inject AFTER training: faults model what leaves the client
             # (dropout, partial work, NaN poison, Byzantine scaling), so
-            # the faulted tree is also what the personal stack would see
+            # the faulted tree is also what the personal stack would see.
+            # The injector keys each fault off the POPULATION client id;
+            # in store mode sel_idx is slab positions arange(S), so the
+            # ids ride in via _trace_pop_idx — same values as resident.
+            fault_idx = sel_idx if self._trace_pop_idx is None \
+                else self._trace_pop_idx
             params_out, dropped = self.fault_fn(
-                params_out, global_params, sel_idx, round_idx)
+                params_out, global_params, fault_idx, round_idx)
         # the defense guards the *aggregate*; each client's own (personal)
         # model stays its locally-trained weights, as in the reference where
         # w_per_mdls is set before any server-side processing
@@ -1229,14 +1319,22 @@ class FedAlgorithm(abc.ABC):
                 c, ls, t = self._eval_cache_rows(
                     new_personal, x_test, y_test, n_test)
                 return {"correct": c, "loss_sum": ls, "total": t}
+            # store mode: sel_idx addresses the [S] slab (stack
+            # positions; the gathers below are identity over the slab
+            # and the test rows arrive pre-gathered at the same width),
+            # while the [C] cache scatter needs the population ids the
+            # store wrapper parked in _trace_pop_idx. Same indices, same
+            # values, same width-S eval program as resident.
+            scatter_idx = sel_idx if self._trace_pop_idx is None \
+                else self._trace_pop_idx
             sub = tree_index(new_personal, sel_idx)
             c, ls, t = self._eval_cache_rows(
                 sub, jnp.take(x_test, sel_idx, axis=0),
                 jnp.take(y_test, sel_idx, axis=0),
                 jnp.take(n_test, sel_idx))
-            return {"correct": cache["correct"].at[sel_idx].set(c),
-                    "loss_sum": cache["loss_sum"].at[sel_idx].set(ls),
-                    "total": cache["total"].at[sel_idx].set(t)}
+            return {"correct": cache["correct"].at[scatter_idx].set(c),
+                    "loss_sum": cache["loss_sum"].at[scatter_idx].set(ls),
+                    "total": cache["total"].at[scatter_idx].set(t)}
 
     def _cache_personal_fn(self, state, jit: bool = False):
         """The personal-eval fn backed by ``state.eval_cache`` (the
@@ -1282,7 +1380,8 @@ class FedAlgorithm(abc.ABC):
         d = self.data
         return (d.x_train, d.y_train, d.n_train)
 
-    def _get_fused_fn(self, block: int, eval_every: int):
+    def _get_fused_fn(self, block: int, eval_every: int,
+                      store: bool = False):
         """Build (and cache per (block, eval_every)) the jitted K-round
         program: ``lax.scan`` over the round body with the eval cadence
         folded in-graph via ``lax.cond`` (zero host round-trips inside a
@@ -1303,10 +1402,18 @@ class FedAlgorithm(abc.ABC):
         ``run_rounds_fused`` rebinds ``self.data`` to the aliased
         outputs so the caller's view stays valid)."""
         cache = self._fused_cache
-        key = (block, eval_every)
+        key = (block, eval_every, store)
         if key in cache:
             return cache[key]
-        n_host = len(self._fused_host_inputs(0))
+        # store=True: same program shape over the block-union [U] slab —
+        # the two host inputs per round are (slab positions, population
+        # ids) instead of the single resident draw, the data args are
+        # the union's [U] rows instead of the full cohort, and the round
+        # call is the store wrapper (parks the population ids in
+        # _trace_pop_idx around the unchanged round_fn). Within-block
+        # row chaining rides the carried slab exactly as it rides the
+        # carried [C] stack resident — bit-identical by construction.
+        n_host = 2 if store else len(self._fused_host_inputs(0))
         n_data = len(self._fused_data_args())
         # test arrays enter the loop only when consumed (eval cadence
         # in-graph, or the per-round eval-cache update); an eval-free
@@ -1317,7 +1424,12 @@ class FedAlgorithm(abc.ABC):
         # scan body: same primitives inlined, and it keeps a donated
         # _round_jit's donate_argnums from being re-interpreted inside
         # an outer trace
-        round_call = getattr(self, "_round_fn", None) or self._round_jit
+        if store:
+            self._get_store_round_jit()  # builds _store_round_raw
+            round_call = self._store_round_raw
+        else:
+            round_call = getattr(self, "_round_fn", None) or \
+                self._round_jit
 
         def fused(state, host_stack, round_ids, *args):
             def body(carry, xs):
@@ -1417,6 +1529,9 @@ class FedAlgorithm(abc.ABC):
         ``clone_state`` first; callers holding the pre-call data arrays
         must re-read them from ``self.data``.
         """
+        if self._store is not None:
+            return self._run_rounds_fused_store(
+                state, start_round, n_rounds, eval_every)
         if not self.supports_fused:
             raise ValueError(
                 f"{self.name}: fused rounds need every per-round host "
@@ -1457,6 +1572,294 @@ class FedAlgorithm(abc.ABC):
         if t:
             kw.update(x_test=t[0], y_test=t[1], n_test=t[2])
         self.data = self.data.replace(**kw)
+
+    # -- population client store (--client_store host|disk) -------------------
+    # The round program in store mode IS the resident round program with
+    # the [C] axis replaced by the cohort slab: sel_idx = arange(S)
+    # (unfused) or the block-union stack positions (fused), so every
+    # slab gather in the round body is an identity/slab-local take of
+    # rows whose VALUES match what the resident gather would have
+    # produced — jnp.take of equal rows + the same vmapped per-row math
+    # at the same width + the same reductions is bit-identical output.
+    # The two places the body needs POPULATION ids (fault keying, the
+    # [C] eval-cache scatter) read them from _trace_pop_idx, parked by
+    # the wrapper below for the duration of the trace. Quarantined slab
+    # rows keep their previous values in the round body (merge_updates /
+    # merge_residual) and are staged back unchanged, so the store ends
+    # up holding the pre-poison value: the no-poison-leak pin extends to
+    # host RAM and disk by construction.
+
+    def _get_store_round_jit(self):
+        """The jitted store-mode round entry: the UNCHANGED round_fn
+        traced at slab width behind the population-id wrapper. Donates
+        its state arg exactly like ``_round_jit`` — under donate_state
+        the cohort slab MOVES through the round rather than copying."""
+        if self._round_jit_store is None:
+            raw = getattr(self, "_round_fn", None)
+            if raw is None:
+                raise ValueError(
+                    f"{self.name}: client_store needs the raw round fn "
+                    "(self._round_fn) to wrap")
+
+            def store_round(state, stack_idx, pop_idx, round_idx,
+                            *row_args):
+                self._trace_pop_idx = pop_idx
+                try:
+                    return raw(state, stack_idx, round_idx, *row_args)
+                finally:
+                    self._trace_pop_idx = None
+
+            self._store_round_raw = store_round
+            self._round_jit_store = self._jit_entry(store_round)
+        return self._round_jit_store
+
+    def _store_host_rows(self, test: bool = False):
+        """Cached host (numpy) views of the training/test shards: store
+        mode never materializes the full [C] data on device — each
+        round's [S] rows are host-side ``np.take`` copies, device_put as
+        part of the gather. On numpy-backed data (the population-scale
+        path) the cache is a zero-copy view."""
+        d = self.data
+        if test:
+            if self._host_test is None:
+                self._host_test = (np.asarray(d.x_test),
+                                   np.asarray(d.y_test),
+                                   np.asarray(d.n_test))
+            return self._host_test
+        if self._host_data is None:
+            self._host_data = (np.asarray(d.x_train),
+                               np.asarray(d.y_train),
+                               np.asarray(d.n_train))
+        return self._host_data
+
+    def _store_gather_rows(self, state, ids):
+        """Host->device staging for one round/block: gather the
+        cohort's store rows (timed inside the store — the cumulative
+        ``store_gather_ms`` gauge) plus the ids' data/test rows from the
+        cached host views. Returns (state.replace kwargs, row args).
+        The gather commits any still-staged previous-round slabs first,
+        so chained rounds read the newest adopted rows."""
+        store = self._store
+        kw = {}
+        with obs_trace.span("store_gather"):
+            if store.has_field("personal_params"):
+                kw["personal_params"] = jax.device_put(
+                    store.gather("personal_params", ids))
+            if store.has_field("agg_residual"):
+                kw["agg_residual"] = jax.device_put(
+                    store.gather("agg_residual", ids))
+            xh, yh, nh = self._store_host_rows()
+            row_args = [jnp.asarray(np.take(xh, ids, axis=0)),
+                        jnp.asarray(np.take(yh, ids, axis=0)),
+                        jnp.asarray(np.take(nh, ids))]
+            if self.eval_cache:
+                xt, yt, nt = self._store_host_rows(test=True)
+                row_args += [jnp.asarray(np.take(xt, ids, axis=0)),
+                             jnp.asarray(np.take(yt, ids, axis=0)),
+                             jnp.asarray(np.take(nt, ids))]
+        return kw, tuple(row_args)
+
+    def _store_adopt_round(self, new_state, ids):
+        """Post-round adoption: park the trained row slabs in the
+        store's staging area (still device arrays — the host transfer is
+        deferred to commit, so the async dispatch pipelining survives)
+        and drop them from state. They reach storage at the next
+        gather/flush; a watchdog rollback (``store_discard``) drops them
+        first, so a rolled-back attempt's rows never touch storage."""
+        store = self._store
+        kw = {}
+        if store.has_field("personal_params"):
+            store.stage("personal_params", ids, new_state.personal_params)
+            kw["personal_params"] = None
+            self._store_eval_dirty.append(np.asarray(ids))
+        if store.has_field("agg_residual"):
+            store.stage("agg_residual", ids, new_state.agg_residual)
+            kw["agg_residual"] = None
+        return new_state.replace(**kw) if kw else new_state
+
+    def _store_prefetch_next(self, next_ids, cur_ids) -> None:
+        """The double-buffering hook: warm the predicted next cohort's
+        host rows while the current (async-dispatched) program runs.
+        Rows the current cohort dirtied are excluded — their newest
+        values are the staged slabs the next gather commits."""
+        cur = set(int(i) for i in np.asarray(cur_ids))
+        ids = [int(i) for i in np.asarray(next_ids) if int(i) not in cur]
+        if not ids:
+            return
+        for name in self._store.field_names():
+            self._store.prefetch(name, ids)
+
+    def _run_round_store(self, state: Any, round_idx: int):
+        """One streamed round (the store-mode ``run_round`` body):
+        gather the sampled cohort's rows host->device, run the
+        slab-width round program, stage the trained slab back, prefetch
+        the next round's cohort."""
+        sel = self._selected_client_indexes(round_idx)
+        kw, row_args = self._store_gather_rows(state, sel)
+        slab_state = state.replace(**kw) if kw else state
+        s = int(sel.shape[0])
+        with obs_trace.span("dispatch_round"):
+            out = self._get_store_round_jit()(
+                slab_state, jnp.arange(s, dtype=jnp.int32),
+                jnp.asarray(sel), jnp.asarray(round_idx, jnp.float32),
+                *row_args)
+        new_state, metrics = out[0], out[1:]
+        if len(metrics) != len(self._round_metric_names):
+            raise ValueError(
+                f"{type(self).__name__} store round returned "
+                f"{len(metrics)} metrics but _round_metric_names has "
+                f"{len(self._round_metric_names)}")
+        new_state = self._store_adopt_round(new_state, sel)
+        self._store_prefetch_next(
+            sample_client_indexes(round_idx + 1, self.num_clients,
+                                  self.clients_per_round), sel)
+        return new_state, dict(zip(self._round_metric_names, metrics))
+
+    def _run_rounds_fused_store(self, state: Any, start_round: int,
+                                n_rounds: int, eval_every: int = 0):
+        """Fused blocks over the store: one gather of the block-UNION's
+        [U] rows, one jitted scan in which round i addresses the slab at
+        ``searchsorted(union, sels[i])`` (so within-block row chaining
+        rides the carried slab exactly as it rides the resident [C]
+        stack), one writeback of the whole union on the flush path. The
+        in-graph eval cadence needs the full cohort resident and is
+        refused — the runner evaluates between blocks instead."""
+        if eval_every:
+            raise ValueError(
+                f"{self.name}: the fused in-graph eval cadence "
+                "(frequency_of_the_test with fuse_rounds>1) evaluates "
+                "the full [C] cohort inside the block; with "
+                "--client_store the cohort is not resident — evaluate "
+                "between blocks (eval_every=0) or run fuse_rounds=1")
+        sels = np.stack([
+            self._selected_client_indexes(r)
+            for r in range(start_round, start_round + n_rounds)])
+        union = np.unique(sels).astype(np.int32)
+        views = np.searchsorted(union, sels).astype(np.int32)
+        kw, row_args = self._store_gather_rows(state, union)
+        slab_state = state.replace(**kw) if kw else state
+        host_stack = (jnp.asarray(views),
+                      jnp.asarray(sels.astype(np.int32)))
+        round_ids = jnp.arange(
+            start_round, start_round + n_rounds, dtype=jnp.float32)
+        fn = self._get_fused_fn(n_rounds, eval_every, store=True)
+        out = fn(slab_state, host_stack, round_ids, *row_args)
+        if self._donate:
+            new_state, ys, packed, _rets = out
+            # _rets: the donated [U] row slabs threaded through the
+            # carry so every donated input has an aliasable output —
+            # dropped here (self.data still holds the full cohort on
+            # host; there is nothing to rebind in store mode)
+        else:
+            new_state, ys, packed = out
+        new_state = self._store_adopt_round(new_state, union)
+        nxt = np.unique(np.concatenate([
+            sample_client_indexes(r, self.num_clients,
+                                  self.clients_per_round)
+            for r in range(start_round + n_rounds,
+                           start_round + 2 * n_rounds)]))
+        self._store_prefetch_next(nxt, union)
+        return new_state, FusedMetrics(ys, packed)
+
+    def _store_register_fields(self, params) -> None:
+        """init_state hook (store mode): register the streamed fields
+        with their lazy per-row defaults — personal rows default to the
+        init params (what the resident broadcast would hold), topk
+        residual rows to zeros. An untrained row costs NOTHING until
+        first written: at --track_personal 0 under topk the residual no
+        longer allocates full-population zeros, only trained rows.
+        Re-registration resets the store (a fresh init_state)."""
+        store = self._store
+        if getattr(self, "track_personal", True):
+            store.register("personal_params", params)
+        if self.agg_impl == "topk":
+            store.register(
+                "agg_residual",
+                jax.tree_util.tree_map(jnp.zeros_like, params))
+        self._store_eval_cache = None
+        self._store_eval_dirty = []
+
+    def _store_has_personal(self) -> bool:
+        """True when the personal stack lives in the client store (state
+        holds None between rounds) — ``_eval_impl``'s personal-branch
+        test alongside ``state.personal_params is not None``."""
+        return self._store is not None and \
+            self._store.has_field("personal_params")
+
+    def store_discard(self) -> None:
+        """Watchdog RETRY/SKIP hook (the runner calls it on rollback):
+        drop the rolled-back attempt's staged rows before anything
+        commits them — the no-poison-leak pin extended to host RAM and
+        disk — and invalidate the store eval cache (a full reseed at the
+        next eval is always correct)."""
+        if self._store is None:
+            return
+        self._store.discard()
+        self._store_eval_cache = None
+        self._store_eval_dirty = []
+
+    def store_flush(self) -> None:
+        """Commit staged rows to storage — the runner's pre-checkpoint
+        barrier (the store snapshot must carry the adopted rows)."""
+        if self._store is not None:
+            self._store.commit()
+
+    def _personal_eval_store(self, _pers, x_test, y_test, n_test):
+        """Personal-eval protocol result over the STORE-resident stack —
+        the host-side incremental twin of ``_personal_eval_cached``,
+        with the dirty-row gather going to the store instead of the (not
+        resident) [C] device stack. Same three tiers at the same widths
+        and with the same jitted reductions, so results match the
+        resident incremental path bitwise (accuracy) / to its documented
+        1-ulp loss tolerance. ``_pers`` is ignored (None in store
+        mode)."""
+        store = self._store
+        dirty = np.concatenate(self._store_eval_dirty) \
+            if self._store_eval_dirty else np.zeros((0,), np.int64)
+        if self._store_eval_cache is None or \
+                dirty.size >= self.num_clients:
+            # full pass: the one O(C) transfer (seed / post-resume /
+            # post-rollback); population-scale runs eval rarely or not
+            # at all (the runner's eval cadence flag)
+            stack = jax.device_put(store.gather_all("personal_params"))
+            ev = self._eval_personal(stack, x_test, y_test, n_test)
+        elif dirty.size == 0:
+            if not hasattr(self, "_pers_metrics_fn"):
+                self._pers_metrics_fn = jax.jit(_personal_metrics)
+            ev = self._pers_metrics_fn(*self._store_eval_cache)
+        else:
+            if not hasattr(self, "_store_eval_merge_fn"):
+                self._store_eval_merge_fn = self._make_store_eval_merge()
+            sel = dirty.astype(np.int32)
+            sub = jax.device_put(store.gather("personal_params", sel))
+            ev = self._store_eval_merge_fn(
+                sub, jnp.asarray(sel), *self._store_eval_cache,
+                x_test, y_test, n_test)
+        self._store_eval_cache = (ev["correct"], ev["loss_sum"],
+                                  ev["total"])
+        self._store_eval_dirty = []
+        return ev
+
+    def _make_store_eval_merge(self):
+        """jit twin of ``_make_personal_eval_merge`` taking the dirty
+        rows PRE-GATHERED (host rows from the store) instead of indexing
+        the resident stack: the same vmapped row eval at the same
+        |dirty| width, the same scatter, the same reductions."""
+        vmapped = self._vmap_clients(self.eval_client,
+                                     in_axes=(0, 0, 0, 0))
+
+        @jax.jit
+        def eval_merge_rows(sub, sel, correct, loss_sum, total,
+                            x_test, y_test, n_test):
+            c_s, l_s, t_s = vmapped(
+                sub, jnp.take(x_test, sel, axis=0),
+                jnp.take(y_test, sel, axis=0), jnp.take(n_test, sel))
+            correct = correct.at[sel].set(c_s)
+            loss_sum = loss_sum.at[sel].set(l_s)
+            total = total.at[sel].set(t_s)
+            return _personal_metrics(correct, loss_sum, total)
+
+        return eval_merge_rows
 
     def _fused_block_loop(self, state, start_round: int, total: int,
                           block: int, eval_every: int, on_record,

@@ -39,6 +39,7 @@ class Ditto(FedAlgorithm):
     name = "ditto"
     supports_fused = True
     donate_supported = True
+    store_supported = True
     _round_metric_names = ("train_loss", "personal_train_loss")
 
     def cost_trained_clients_per_round(self) -> int:
@@ -108,6 +109,13 @@ class Ditto(FedAlgorithm):
     def init_state(self, rng: jax.Array) -> DittoState:
         p_rng, s_rng = jax.random.split(rng)
         params = init_params(self.model, p_rng, self.init_sample_shape)
+        if self._store is not None:
+            # store mode: the personal stack lives in the client store
+            # (lazy init-params default rows); state holds None between
+            # rounds. See FedAvg.init_state.
+            self._store_register_fields(params)
+            return DittoState(global_params=params,
+                              personal_params=None, rng=s_rng)
         return DittoState(
             global_params=params,
             personal_params=broadcast_tree(params, self.num_clients),
@@ -115,6 +123,9 @@ class Ditto(FedAlgorithm):
         )
 
     def run_round(self, state: DittoState, round_idx: int):
+        if self._store is not None:
+            # streamed cohort residency: same round body at slab width
+            return self._run_round_store(state, round_idx)
         sel = self._selected_client_indexes(round_idx)
         # read BEFORE dispatch: under donate_state the call consumes
         # `state` (the ownership lint holds driver paths to this order)

@@ -21,6 +21,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..core.state import (
@@ -66,6 +67,7 @@ class FedAvg(FedAlgorithm):
     numerics_supported = True
     topk_supported = True
     donate_supported = True
+    store_supported = True
 
     def __init__(self, *args, defense=None, track_personal: bool = True,
                  eval_cache: bool = False, **kwargs):
@@ -148,6 +150,21 @@ class FedAvg(FedAlgorithm):
     def init_state(self, rng: jax.Array) -> FedAvgState:
         p_rng, s_rng = jax.random.split(rng)
         params = init_params(self.model, p_rng, self.init_sample_shape)
+        if self._store is not None:
+            # store mode: the per-client rows live in the client store
+            # (lazy defaults — init params / zero residual; nothing
+            # materializes until a row trains) and state holds None
+            # between rounds. The eval cache is seeded from a TRANSIENT
+            # resident broadcast — identical values to the resident
+            # seed — and freed right after.
+            self._store_register_fields(params)
+            ev_cache = None
+            if self.eval_cache:
+                ev_cache = self._seed_eval_cache(
+                    broadcast_tree(params, self.num_clients))
+            return FedAvgState(
+                global_params=params, personal_params=None, rng=s_rng,
+                agg_residual=None, eval_cache=ev_cache)
         personal = (broadcast_tree(params, self.num_clients)
                     if self.track_personal else None)
         return FedAvgState(
@@ -165,6 +182,10 @@ class FedAvg(FedAlgorithm):
         )
 
     def run_round(self, state: FedAvgState, round_idx: int):
+        if self._store is not None:
+            # streamed cohort residency: gather [S] rows host->device,
+            # run the same round body at slab width, stage rows back
+            return self._run_round_store(state, round_idx)
         sel = self._selected_client_indexes(round_idx)
         d = self.data
         # read BEFORE dispatch: under donate_state the call consumes
@@ -197,6 +218,19 @@ class FedAvg(FedAlgorithm):
             state = self._finetune_jit(
                 state, self.data.x_train, self.data.y_train,
                 self.data.n_train)
+        if self._store is not None:
+            # the fine-tune retrained EVERY client from the final
+            # global — a transient O(C) device stack (population-scale
+            # runs skip finalize; this serves the reference protocol at
+            # moderate C). Adopt it into the store wholesale, drop it
+            # from state; the final eval below re-seeds from the store.
+            self._store.stage("personal_params",
+                              np.arange(self.num_clients),
+                              state.personal_params)
+            self._store.commit()
+            self._store_eval_cache = None
+            self._store_eval_dirty = []
+            state = state.replace(personal_params=None)
         if self.eval_cache:
             # the fine-tune retrained EVERY personal row: the cache is
             # stale wholesale — drop it so evaluate falls back to the
@@ -215,7 +249,8 @@ class FedAvg(FedAlgorithm):
         ev = self._eval_global(state.global_params, x_test, y_test, n_test)
         out = {"global_acc": ev["acc"], "global_loss": ev["loss"],
                "acc_per_client": ev["acc_per_client"]}
-        if state.personal_params is not None:
+        if state.personal_params is not None or \
+                self._store_has_personal():
             evp = personal_fn(
                 state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])

@@ -70,6 +70,7 @@ class SalientGrads(FedAlgorithm):
     numerics_with_mask = True
     topk_supported = True
     donate_supported = True
+    store_supported = True
 
     def __init__(self, *args, dense_ratio: float = 0.5,
                  itersnip_iterations: int = 1, defense=None,
@@ -242,6 +243,19 @@ class SalientGrads(FedAlgorithm):
                 )
         from ..core.state import zeros_like_tree
 
+        if self._store is not None:
+            # store mode: per-client rows live in the client store with
+            # lazy defaults (dense init-params rows — the reference's
+            # commented-out init mask multiply — / zero residual); state
+            # holds None between rounds. See FedAvg.init_state.
+            self._store_register_fields(params)
+            ev_cache = None
+            if self.eval_cache:
+                ev_cache = self._seed_eval_cache(
+                    broadcast_tree(params, self.num_clients))
+            return SalientGradsState(
+                global_params=params, mask=mask, personal_params=None,
+                rng=s_rng, agg_residual=None, eval_cache=ev_cache)
         personal = (broadcast_tree(params, self.num_clients)
                     if self.track_personal else None)
         return SalientGradsState(
@@ -279,7 +293,10 @@ class SalientGrads(FedAlgorithm):
             self._agg_sparse_plan = build_sparse_plan(state.mask)
 
     def run_round(self, state: SalientGradsState, round_idx: int):
-        self._ensure_agg_plan(state)
+        self._ensure_agg_plan(state)  # host-side, before any trace
+        if self._store is not None:
+            # streamed cohort residency: same round body at slab width
+            return self._run_round_store(state, round_idx)
         sel = self._selected_client_indexes(round_idx)
         d = self.data
         # read BEFORE dispatch: under donate_state the call consumes
@@ -332,7 +349,8 @@ class SalientGrads(FedAlgorithm):
             "mask_density": mask_density(state.mask),
             "acc_per_client": ev["acc_per_client"],
         }
-        if state.personal_params is not None:
+        if state.personal_params is not None or \
+                self._store_has_personal():
             evp = personal_fn(
                 state.personal_params, x_test, y_test, n_test)
             out.update(personal_acc=evp["acc"], personal_loss=evp["loss"])

@@ -150,6 +150,12 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "donate_state": ("inert", "buffer aliasing only — bit-identical "
                               "outputs (tests/test_donation.py pins "
                               "donated==undonated)"),
+    "client_store": ("inert", "row residency only — streamed cohorts "
+                              "are bit-identical to device residency "
+                              "(tests/test_client_store.py pins "
+                              "resident==streamed)"),
+    "store_hot_clients": ("inert", "host LRU capacity — residency/"
+                                   "eviction knob, never values"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

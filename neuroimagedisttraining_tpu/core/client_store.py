@@ -1,0 +1,402 @@
+"""Population-scale client store: host/disk-resident per-client rows.
+
+The simulated federation keeps every per-client array — the ``[C, model]``
+personal stack, the topk ``agg_residual`` — fully device-resident, so
+population size is capped by HBM (PR 9 proved C=256 on one chip; the
+ROADMAP north star needs populations that dwarf device memory). This
+module is the memory hierarchy below the device: the device holds only
+the active cohort's S trained rows, host RAM holds a pinned hot-client
+LRU cache, and a memory-mapped on-disk store holds the full population
+keyed by client id — all behind one gather/stage/commit API (the
+ZeRO-Offload shape: host-resident state, overlapped transfers, the hot
+working set on device).
+
+Residency contract (pinned by tests/test_client_store.py): a streamed
+run is **bit-identical** to the fully-resident run. The store never
+computes — it moves byte-exact rows between device, host RAM, and disk,
+and rows synthesized from a field's registered default are byte-exact
+copies of the default template (zero storage until a row is actually
+trained: a C=10^6 population with S=8 trained/round materializes 8 rows
+per round, not 10^6 zeros — the ``--track_personal 0`` + topk residual
+fix rides on exactly this laziness).
+
+Staging protocol (the watchdog/no-poison composition):
+
+* ``stage(name, ids, slab)`` parks a round's output rows WITHOUT
+  touching storage — the slab may still be an in-flight device array
+  (``np.asarray`` is deferred so dispatch pipelining survives);
+* ``commit()`` materializes staged slabs into storage (one host
+  transfer per leaf); ``gather``/``gather_all`` commit first, so reads
+  always see the newest adopted rows;
+* ``discard()`` drops staged slabs unconverted — the watchdog's
+  rollback-retry path: a rolled-back round's rows never reach storage,
+  extending PR 7's no-poison-leak pin to host RAM and disk.
+
+``prefetch`` warms a host-side row cache off the gather clock (the
+double-buffering hook: the driver prefetches the next block's
+not-dirtied rows while the current block computes); ``stats`` exposes
+the ``mem_store_*`` gauges/counters and the cumulative
+``store_gather_ms`` the obs ledger records per round.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ClientStore", "STORE_MODES"]
+
+#: residency modes below "device" (device = no store at all)
+STORE_MODES = ("host", "disk")
+
+
+def _np_leaves(tree: Any) -> Tuple[List[np.ndarray], Any]:
+    """Flatten ``tree`` to host numpy leaves + treedef (no-copy for
+    arrays already on host)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [np.asarray(x) for x in leaves], treedef
+
+
+class _Field:
+    """One registered per-client field: a default row template plus the
+    materialized rows (host dict in ``host`` mode; LRU-capped hot dict
+    over per-leaf ``np.memmap`` files in ``disk`` mode)."""
+
+    def __init__(self, name: str, template: Any, num_clients: int,
+                 mode: str, hot_clients: int, root: Optional[str]):
+        self.name = name
+        leaves, self.treedef = _np_leaves(template)
+        self.leaf_templates = leaves
+        self.num_clients = num_clients
+        self.mode = mode
+        self.hot_clients = max(1, int(hot_clients))
+        #: host-RAM rows: the whole materialized set (host mode) or the
+        #: pinned hot-client LRU (disk mode) — id -> list of np leaves
+        self.rows: "OrderedDict[int, List[np.ndarray]]" = OrderedDict()
+        self.materialized = np.zeros(num_clients, dtype=bool)
+        self.mmaps: List[np.memmap] = []
+        self._mmap_paths: List[str] = []
+        if mode == "disk":
+            if root is None:
+                raise ValueError(
+                    "ClientStore(mode='disk') needs a root directory "
+                    "for the per-leaf memmap files")
+            os.makedirs(root, exist_ok=True)
+            for i, leaf in enumerate(leaves):
+                path = os.path.join(root, f"{name}_leaf{i}.mmap")
+                mm = np.memmap(path, dtype=leaf.dtype, mode="w+",
+                               shape=(num_clients,) + leaf.shape)
+                self.mmaps.append(mm)
+                self._mmap_paths.append(path)
+
+    def default_row(self) -> List[np.ndarray]:
+        # a fresh copy per synthesis: callers may mutate rows in place
+        return [t.copy() for t in self.leaf_templates]
+
+    def read_row(self, cid: int) -> Tuple[List[np.ndarray], bool]:
+        """(leaves, host_hit). Synthesizes the default for a row that
+        was never written — byte-exact, zero storage."""
+        row = self.rows.get(cid)
+        if row is not None:
+            if self.mode == "disk":  # LRU touch
+                self.rows.move_to_end(cid)
+            return row, True
+        if self.mode == "disk" and self.materialized[cid]:
+            return [np.array(mm[cid]) for mm in self.mmaps], False
+        return self.default_row(), False
+
+    def write_row(self, cid: int, leaves: List[np.ndarray]) -> None:
+        self.materialized[cid] = True
+        if self.mode == "host":
+            self.rows[cid] = leaves
+            return
+        self.rows[cid] = leaves
+        self.rows.move_to_end(cid)
+        while len(self.rows) > self.hot_clients:
+            old_id, old_leaves = self.rows.popitem(last=False)
+            for mm, leaf in zip(self.mmaps, old_leaves):
+                mm[old_id] = leaf
+
+    def flush_hot(self) -> None:
+        """Disk mode: spill every hot row to its memmap (checkpoint
+        snapshots read the authoritative bytes from one place)."""
+        if self.mode != "disk":
+            return
+        for cid, leaves in self.rows.items():
+            for mm, leaf in zip(self.mmaps, leaves):
+                mm[cid] = leaf
+
+    def host_cache_bytes(self) -> int:
+        row_bytes = sum(int(t.nbytes) for t in self.leaf_templates)
+        return row_bytes * len(self.rows)
+
+    def disk_bytes(self) -> int:
+        return sum(int(mm.nbytes) for mm in self.mmaps)
+
+
+class ClientStore:
+    """Host/disk-resident per-client state keyed by client id.
+
+    One store instance serves every registered field (``personal_params``,
+    ``agg_residual``) uniformly; rows move device->host through the
+    stage/commit protocol and host->device through ``gather`` (the
+    caller ``jax.device_put``s the returned slab)."""
+
+    def __init__(self, num_clients: int, mode: str = "host",
+                 hot_clients: int = 64, root: Optional[str] = None):
+        if mode not in STORE_MODES:
+            raise ValueError(
+                f"client store mode {mode!r} not in {STORE_MODES} "
+                "(mode 'device' means: no store)")
+        if num_clients < 1:
+            raise ValueError("ClientStore needs num_clients >= 1")
+        self.num_clients = int(num_clients)
+        self.mode = mode
+        self.hot_clients = int(hot_clients)
+        self._root = root
+        if mode == "disk" and root is None:
+            import tempfile
+
+            self._root = tempfile.mkdtemp(prefix="client_store_")
+        self._fields: Dict[str, _Field] = {}
+        #: staged (uncommitted) round outputs: list of (name, ids, slab)
+        #: — slab leaves may be device arrays (np.asarray deferred)
+        self._staged: List[Tuple[str, np.ndarray, Any]] = []
+        #: prefetched committed rows: name -> {id: leaves}
+        self._prefetched: Dict[str, Dict[int, List[np.ndarray]]] = {}
+        # counters (floats: the obs record contract)
+        self.hits = 0
+        self.misses = 0
+        self.prefetched_rows = 0
+        self.gather_ms = 0.0
+
+    # -- registration -------------------------------------------------------
+    def register(self, name: str, template: Any) -> None:
+        """Register field ``name`` with its lazy per-row default
+        (``template`` — e.g. the init params row for the personal
+        stack, zeros for the topk residual). Unwritten rows synthesize
+        byte-exact copies of the default on gather, with no storage.
+        Re-registration resets the field (a fresh ``init_state``)."""
+        self._fields[name] = _Field(
+            name, template, self.num_clients, self.mode,
+            self.hot_clients, self._root)
+        self._prefetched.pop(name, None)
+        self._staged = [s for s in self._staged if s[0] != name]
+
+    def has_field(self, name: str) -> bool:
+        return name in self._fields
+
+    def field_names(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._fields))
+
+    def _field(self, name: str) -> _Field:
+        f = self._fields.get(name)
+        if f is None:
+            raise KeyError(
+                f"client store has no field {name!r} (registered: "
+                f"{self.field_names()}) — init_state registers fields "
+                "before the first round")
+        return f
+
+    # -- staging protocol ---------------------------------------------------
+    def stage(self, name: str, ids: Sequence[int], slab: Any) -> None:
+        """Park a round's output rows (``slab`` = pytree with leading
+        axis ``len(ids)``) without converting or writing — commit()
+        materializes, discard() (the watchdog rollback) drops them."""
+        self._field(name)  # fail fast on unknown fields
+        self._staged.append((name, np.asarray(ids), slab))
+
+    def commit(self) -> None:
+        """Write staged slabs into storage (one host transfer per leaf;
+        later stages of the same id win — round order)."""
+        staged, self._staged = self._staged, []
+        for name, ids, slab in staged:
+            field = self._field(name)
+            leaves, treedef = jax.tree_util.tree_flatten(slab)
+            host_leaves = [np.asarray(x) for x in leaves]
+            pre = self._prefetched.get(name)
+            for pos, cid in enumerate(ids):
+                cid = int(cid)
+                if pre is not None:  # staged rows outdate prefetched
+                    pre.pop(cid, None)
+                field.write_row(
+                    cid, [np.array(hl[pos]) for hl in host_leaves])
+
+    def discard(self) -> None:
+        """Drop staged slabs unconverted (watchdog RETRY/SKIP: the
+        rolled-back round's rows never reach host RAM or disk)."""
+        self._staged = []
+
+    def dirty_ids(self) -> np.ndarray:
+        """Ids with staged (uncommitted) rows — the checkpoint layer
+        flushes these before snapshotting."""
+        if not self._staged:
+            return np.zeros((0,), np.int64)
+        return np.unique(np.concatenate(
+            [ids for _, ids, _ in self._staged]))
+
+    # -- reads --------------------------------------------------------------
+    def gather(self, name: str, ids: Sequence[int]) -> Any:
+        """Stacked host rows ``[len(ids), ...]`` for ``ids`` (commits
+        staged rows first so reads see the newest adopted state). The
+        caller device-puts the returned pytree."""
+        t0 = time.perf_counter()
+        self.commit()
+        field = self._field(name)
+        pre = self._prefetched.get(name)
+        stacked: Optional[List[np.ndarray]] = None
+        for pos, cid in enumerate(ids):
+            cid = int(cid)
+            row = pre.pop(cid, None) if pre is not None else None
+            if row is not None:
+                self.hits += 1
+            else:
+                row, host_hit = field.read_row(cid)
+                if host_hit:
+                    self.hits += 1
+                else:
+                    self.misses += 1
+            if stacked is None:
+                stacked = [
+                    np.empty((len(ids),) + leaf.shape, leaf.dtype)
+                    for leaf in row]
+            for li, leaf in enumerate(row):
+                stacked[li][pos] = leaf
+        self.gather_ms += (time.perf_counter() - t0) * 1e3
+        if stacked is None:  # zero-id gather
+            stacked = [np.empty((0,) + t.shape, t.dtype)
+                       for t in field.leaf_templates]
+        return jax.tree_util.tree_unflatten(field.treedef, stacked)
+
+    def gather_all(self, name: str) -> Any:
+        """The full ``[C, ...]`` stack (store-backed full personal eval
+        / reseed). O(C) host RAM transiently — population-scale callers
+        use the incremental paths instead."""
+        return self.gather(name, np.arange(self.num_clients))
+
+    def prefetch(self, name: str, ids: Sequence[int]) -> None:
+        """Warm the host row cache for ``ids`` off the gather clock —
+        the double-buffering hook (the driver calls it for the NEXT
+        block's not-dirtied rows right after dispatching the current
+        block, so disk reads / default synthesis overlap device
+        compute). Only committed rows are prefetched; commit()
+        invalidates any entry a newer staged row outdates."""
+        if not self.has_field(name):
+            return
+        field = self._field(name)
+        staged_ids = set(int(i) for i in self.dirty_ids())
+        pre = self._prefetched.setdefault(name, {})
+        for cid in ids:
+            cid = int(cid)
+            if cid in pre or cid in staged_ids:
+                continue
+            row, _ = field.read_row(cid)
+            pre[cid] = row
+            self.prefetched_rows += 1
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        """The obs ledger's per-round store sample: ``mem_``-prefixed
+        gauges (volatile for the fleet comparator by the existing
+        prefix rule) plus the cumulative ``store_gather_ms``."""
+        host_bytes = sum(f.host_cache_bytes()
+                         for f in self._fields.values())
+        pre_bytes = 0
+        for name, rows in self._prefetched.items():
+            f = self._fields.get(name)
+            if f is None or not rows:
+                continue
+            pre_bytes += sum(int(t.nbytes)
+                             for t in f.leaf_templates) * len(rows)
+        disk_bytes = sum(f.disk_bytes() for f in self._fields.values())
+        return {
+            "mem_host_cache_bytes": float(host_bytes + pre_bytes),
+            "mem_store_disk_bytes": float(disk_bytes),
+            "mem_store_hits": float(self.hits),
+            "mem_store_misses": float(self.misses),
+            "mem_store_prefetched": float(self.prefetched_rows),
+            "store_gather_ms": float(self.gather_ms),
+        }
+
+    # -- checkpoint lineage -------------------------------------------------
+    def snapshot_save(self, path: str) -> None:
+        """One-file npz snapshot: every MATERIALIZED row of every field
+        plus a manifest (population size, field layouts). Default-only
+        rows are not stored — the restoring side re-synthesizes them
+        from its own registered defaults, which the deterministic
+        ``init_state`` reproduces bit-exactly."""
+        self.commit()
+        arrays: Dict[str, np.ndarray] = {}
+        manifest: Dict[str, Any] = {"num_clients": self.num_clients,
+                                    "fields": {}}
+        for name, field in self._fields.items():
+            field.flush_hot()
+            ids = np.nonzero(field.materialized)[0]
+            manifest["fields"][name] = {
+                "n_leaves": len(field.leaf_templates),
+                "n_rows": int(ids.size),
+            }
+            arrays[f"{name}::ids"] = ids.astype(np.int64)
+            for li in range(len(field.leaf_templates)):
+                if field.mode == "disk":
+                    rows = np.stack(
+                        [np.array(field.mmaps[li][int(i)])
+                         for i in ids]) if ids.size else np.empty(
+                        (0,) + field.leaf_templates[li].shape,
+                        field.leaf_templates[li].dtype)
+                else:
+                    rows = np.stack(
+                        [field.rows[int(i)][li] for i in ids]) \
+                        if ids.size else np.empty(
+                        (0,) + field.leaf_templates[li].shape,
+                        field.leaf_templates[li].dtype)
+                arrays[f"{name}::leaf{li}"] = rows
+        arrays["__manifest__"] = np.frombuffer(
+            json.dumps(manifest).encode(), dtype=np.uint8)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)  # atomic: a SIGKILL mid-write cannot
+        # leave a truncated sidecar that poisons every later --resume
+
+    def snapshot_load(self, path: str) -> None:
+        """Replace this store's contents with a snapshot's. Fields must
+        already be registered (init_state ran) — the snapshot carries
+        rows, not layouts; a field-set mismatch is the store analogue
+        of the checkpoint schema mismatch and raises."""
+        with np.load(path) as z:
+            manifest = json.loads(bytes(z["__manifest__"]).decode())
+            snap_fields = set(manifest["fields"])
+            if snap_fields != set(self._fields):
+                raise RuntimeError(
+                    f"client-store snapshot {path} carries fields "
+                    f"{sorted(snap_fields)} but this run registered "
+                    f"{list(self.field_names())} — the lineage was "
+                    "written under different flags (track_personal / "
+                    "agg_impl)")
+            if int(manifest["num_clients"]) != self.num_clients:
+                raise RuntimeError(
+                    f"client-store snapshot {path} was written for "
+                    f"C={manifest['num_clients']}, this run has "
+                    f"C={self.num_clients}")
+            self._staged = []
+            self._prefetched = {}
+            for name, field in self._fields.items():
+                # reset to all-default, then write the snapshot rows
+                field.rows = OrderedDict()
+                field.materialized[:] = False
+                ids = z[f"{name}::ids"]
+                leaves = [z[f"{name}::leaf{li}"]
+                          for li in range(len(field.leaf_templates))]
+                for pos, cid in enumerate(ids):
+                    field.write_row(
+                        int(cid),
+                        [np.array(lf[pos]) for lf in leaves])

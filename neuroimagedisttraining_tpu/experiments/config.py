@@ -314,6 +314,28 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "cohort — bounds the O(N) full-cohort / O(N^2) "
                         "personal eval cost at large client counts "
                         "(0 = all)")
+    p.add_argument("--client_store", type=str, default="device",
+                   choices=["device", "host", "disk"],
+                   help="population-scale client store (core/"
+                        "client_store.py): device (default) keeps the "
+                        "full [C, model] personal stack / topk residual "
+                        "resident in HBM; host / disk stream only the "
+                        "sampled cohort's rows to device each round "
+                        "(host-RAM LRU hot cache, memory-mapped on-disk "
+                        "cold tier for 'disk'), written back on the "
+                        "fused-flush path with the next cohort "
+                        "prefetched off the gather clock. Bit-identical "
+                        "to device residency (tests/test_client_store."
+                        "py pins it) — never enters run identity; HBM "
+                        "stays flat in --client_num_in_total. "
+                        "fedavg/salientgrads/ditto, sampled "
+                        "participation only")
+    p.add_argument("--store_hot_clients", type=int, default=64,
+                   help="client-store host-RAM hot-cache capacity in "
+                        "clients per field (LRU; overflow spills to the "
+                        "disk tier under 'disk', stays host-resident "
+                        "under 'host'). Residency knob only — never "
+                        "enters run identity")
     p.add_argument("--fused_kernels", type=int, default=0,
                    help="route the optimizer update through the Pallas "
                         "fused masked-SGD kernel (salientgrads; measured "

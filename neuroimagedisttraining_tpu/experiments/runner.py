@@ -305,7 +305,49 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         # state-ownership protocol (on by default — bit-identical
         # aliasing; only donate_supported algorithms consume it)
         donate_state=bool(getattr(args, "donate_state", 1)),
+        # population-scale client store (core/client_store.py):
+        # host/disk-resident per-client rows, streamed cohort residency.
+        # Bit-identical to device residency — never enters identity.
+        client_store=getattr(args, "client_store", "device"),
+        store_hot_clients=getattr(args, "store_hot_clients", 64),
     )
+    store_mode = getattr(args, "client_store", "device")
+    if store_mode != "device":
+        if algo_name not in ("fedavg", "salientgrads", "ditto"):
+            raise SystemExit(
+                f"--client_store {store_mode} streams the per-client "
+                "state rows (personal stack / topk residual) through "
+                "the central round entry; only fedavg/salientgrads/"
+                f"ditto thread the streamed slab ({algo_name} does not)")
+        if args.frac >= 1.0:
+            raise SystemExit(
+                f"--client_store {store_mode} exists to keep only the "
+                "SAMPLED cohort device-resident; full participation "
+                "(--frac 1.0) touches every row every round — run "
+                "device-resident instead")
+        if getattr(args, "eval_clients", 0):
+            raise SystemExit(
+                f"--client_store {store_mode} routes personal eval "
+                "through the store-backed cache; the sampled-eval "
+                "subset (--eval_clients) composes poorly with it — "
+                "use one or the other")
+        if not getattr(args, "track_personal", 1) and \
+                getattr(args, "agg_impl", "dense") != "topk":
+            raise SystemExit(
+                f"--client_store {store_mode} with --track_personal 0 "
+                "has no per-client rows to store: the personal stack "
+                "is untracked and no topk error-feedback residual "
+                "exists (--agg_impl is not 'topk'). Drop "
+                "--client_store (nothing scales with C) or track "
+                "something per-client")
+        if max(1, getattr(args, "fuse_rounds", 1) or 1) > 1 and \
+                getattr(args, "frequency_of_the_test", 0):
+            raise SystemExit(
+                f"--client_store {store_mode} with --fuse_rounds K "
+                "runs block-union slabs; the fused IN-GRAPH eval "
+                "(--frequency_of_the_test > 0) needs the full resident "
+                "[C] personal stack — pass --frequency_of_the_test 0 "
+                "(eval at the end) or --fuse_rounds 1")
     if (getattr(args, "fault_spec", "") or getattr(args, "guard", 0)) \
             and algo_name not in ("fedavg", "salientgrads", "ditto"):
         raise SystemExit(
@@ -591,7 +633,11 @@ def _ckpt_metadata(args, algo, cost):
             # records which impl wrote this lineage's states
             "agg_impl": algo.agg_impl,
             # diagnostic only (evcache lineages already split identity)
-            "eval_cache": bool(getattr(algo, "eval_cache", False))}
+            "eval_cache": bool(getattr(algo, "eval_cache", False)),
+            # diagnostic only (residency modes are bit-identical and
+            # share one lineage; store-backed steps additionally carry
+            # a store_<step>.npz row-snapshot sidecar)
+            "client_store": getattr(algo, "client_store", "device")}
 
 
 def _cost_round_record(algo, cost, samples_per_client, state):
@@ -651,8 +697,13 @@ def _run_fused_rounds(algo, algo_name, state, start_round, total, block,
 
     def on_block(end_round, state_out):
         if ckpt_mgr is not None:
+            # store-backed lineage: the block's staged row writebacks
+            # ride the same boundary as a store_<step>.npz sidecar
+            # (snapshot_save commits staged rows first — the fused-flush
+            # writeback path)
             ckpt_mgr.save(end_round, state_out,
-                          metadata=_ckpt_metadata(args, algo, cost))
+                          metadata=_ckpt_metadata(args, algo, cost),
+                          store=getattr(algo, "_store", None))
 
     # with obs on, fused records get round_time_s stamped at flush
     # boundaries (block wall split evenly — the documented fused
@@ -822,6 +873,13 @@ def run_experiment(args: argparse.Namespace,
         if mesh is not None:
             logger.info("sharding clients over mesh %s", dict(mesh.shape))
         _check_augment_consistency(args, algo)
+        if obs_session is not None and \
+                getattr(algo, "_store", None) is not None:
+            # client-store residency ledger: host-cache/disk bytes,
+            # hit/miss/prefetch counters and cumulative gather ms join
+            # the round-boundary memory watermark samples (JSONL +
+            # registry) — the mem-flat-in-C acceptance readout
+            obs_session.memory.attach_extra(algo._store.stats)
 
         # obs-only fault-trace stamper: fault draws are pure functions of
         # (seed, round, client id), so the deterministic replay
@@ -875,9 +933,18 @@ def run_experiment(args: argparse.Namespace,
                     "cache; evcache lineages live under their own "
                     "checkpoint identity and are not interchangeable "
                     "with cache-less ones)")
+            if getattr(algo, "_store", None) is not None:
+                hints.append(
+                    "(--client_store lineages keep the per-client rows "
+                    "in a store_<step>.npz sidecar next to each step; "
+                    "a step without a loadable sidecar is skipped)")
+            # store mode: init_state registers the store fields the
+            # sidecar load below validates against, then snapshot_load
+            # replaces the fresh rows with the checkpointed ones
             restored = ckpt_mgr.restore_latest(
                 algo.init_state(jax.random.PRNGKey(args.seed)),
-                schema_hint=" ".join(hints))
+                schema_hint=" ".join(hints),
+                store=getattr(algo, "_store", None))
             if restored is not None:
                 state, start_round = restored
                 logger.info("resumed from round %d", start_round)
@@ -1091,7 +1158,8 @@ def run_experiment(args: argparse.Namespace,
                 norm_threshold=getattr(args, "watchdog_norm", 0.0),
                 ckpt_mgr=ckpt_mgr,
                 template_fn=lambda: algo.init_state(
-                    jax.random.PRNGKey(args.seed)))
+                    jax.random.PRNGKey(args.seed)),
+                store=getattr(algo, "_store", None))
         if fuse > 1:
             # K-round fused programs (FedAlgorithm.run_rounds_fused): one
             # dispatch + one metric fetch per block. Per-round host
@@ -1176,6 +1244,11 @@ def run_experiment(args: argparse.Namespace,
                         # already host-synced this attempt's metrics, so
                         # this adds no extra sync
                         counters.update(record)
+                        # store mode: the attempt STAGED its trained
+                        # rows into the client store pre-judge — drop
+                        # them with the attempt (the rollback's
+                        # no-poison rule extended to host/disk rows)
+                        algo.store_discard()
                         # the pre-round state in hand IS last-good; the
                         # checkpoint lineage (saved only after OK/SKIP
                         # verdicts) backs it for cross-process recovery
@@ -1183,6 +1256,7 @@ def run_experiment(args: argparse.Namespace,
                         continue
                     if verdict == _recovery.SKIP:
                         new_state = state  # degrade: carry last-good
+                        algo.store_discard()  # same no-poison rule
                         record["round_skipped"] = 1.0
                     record.update(watchdog.round_counters())
                 if prof_dir is not None:  # no watchdog judge ran
@@ -1212,7 +1286,8 @@ def run_experiment(args: argparse.Namespace,
                 deferred.push(record)  # counters accumulate at flush
                 if ckpt_mgr is not None:
                     ckpt_mgr.save(r + 1, state,
-                                  metadata=_ckpt_metadata(args, algo, cost))
+                                  metadata=_ckpt_metadata(args, algo, cost),
+                                  store=getattr(algo, "_store", None))
                 r += 1
             if watchdog is not None:
                 algo.set_retry_nonce(0)

@@ -44,7 +44,7 @@ __all__ = [
 #: per-round keys that legitimately differ between bit-identical runs
 #: (wall clock, probed timings) — never part of any plane's verdict
 VOLATILE_KEYS = {"round_time_s", "comm_agg_ms", "comm_agg_share",
-                 "host", "obs_schema"}
+                 "host", "obs_schema", "store_gather_ms"}
 
 #: key prefixes with the same exemption (memory watermarks are host
 #: state, not run state)

@@ -110,6 +110,15 @@ class MemoryWatermark:
         self._registry = registry
         self._every = max(1, int(sample_every))
         self.samples = 0
+        self._extra_fn = None
+
+    def attach_extra(self, fn) -> None:
+        """Attach a zero-arg provider of extra float gauges merged into
+        every :meth:`sample` (the --client_store residency ledger:
+        ``mem_host_cache_bytes`` / ``mem_store_*`` / ``store_gather_ms``
+        from ``ClientStore.stats``). Host-side readout only — sampled at
+        round boundaries with the rest of the watermark."""
+        self._extra_fn = fn
 
     def maybe_sample(self, round_idx: int):
         """Cadence-gated :meth:`sample`: the sampled values dict when a
@@ -152,4 +161,13 @@ class MemoryWatermark:
             out["mem_device_bytes_in_use"] = float(in_use_max)
         if peak_max is not None:
             out["mem_device_peak_bytes"] = float(peak_max)
+        if self._extra_fn is not None:
+            try:
+                extra = self._extra_fn()
+            except Exception:  # never let telemetry kill the run
+                logger.debug("extra memory gauges failed", exc_info=True)
+                extra = {}
+            for k, v in extra.items():
+                reg.gauge(k).set(float(v))
+                out[k] = float(v)
         return out

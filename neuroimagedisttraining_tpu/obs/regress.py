@@ -217,7 +217,9 @@ def metric_gate_defaults(metric: str) -> Dict[str, Any]:
     better, default band (the live-arrays fallback on backends without
     memory_stats carries some run-to-run spread); the sweep's
     ``cohort_rounds_per_sec_`` rates use the generic higher-is-better
-    defaults."""
+    defaults. ``store_gather_ms_`` covers the sweep's client-store
+    host->device gather timings (lower is better, default band —
+    host-side timings carry run-to-run spread)."""
     if metric in METRIC_GATE_DEFAULTS:
         return dict(METRIC_GATE_DEFAULTS[metric])
     if metric.startswith("agg_ms_"):
@@ -226,6 +228,8 @@ def metric_gate_defaults(metric: str) -> Dict[str, Any]:
         return {"higher_is_better": False, "rel_threshold": 0.01,
                 "mad_k": 0.0}
     if metric.startswith("cohort_mem_bytes_"):
+        return {"higher_is_better": False}
+    if metric.startswith("store_gather_ms_"):
         return {"higher_is_better": False}
     return {}
 

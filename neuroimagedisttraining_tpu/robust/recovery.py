@@ -78,6 +78,7 @@ class RoundWatchdog:
                  loss_threshold: float = 0.0, norm_threshold: float = 0.0,
                  ckpt_mgr=None,
                  template_fn: Optional[Callable[[], Any]] = None,
+                 store=None,
                  sleep: Callable[[float], None] = time.sleep):
         self.max_retries = max(0, int(max_retries))
         self.backoff_s = float(backoff_s)
@@ -85,6 +86,9 @@ class RoundWatchdog:
         self.norm_threshold = float(norm_threshold)
         self.ckpt_mgr = ckpt_mgr
         self.template_fn = template_fn
+        # --client_store lineage: the checkpoint-restore rollback path
+        # must reload the per-client row sidecar with the state
+        self.store = store
         self._sleep = sleep
         # cumulative run counters (flow into records / stat_info)
         self.rounds_retried = 0
@@ -186,7 +190,8 @@ class RoundWatchdog:
             raise RuntimeError(
                 "watchdog rollback: no in-memory last-good state and no "
                 "checkpoint manager to restore from")
-        restored = self.ckpt_mgr.restore_latest(self.template_fn())
+        restored = self.ckpt_mgr.restore_latest(self.template_fn(),
+                                                store=self.store)
         if restored is None:
             raise RuntimeError(
                 "watchdog rollback: checkpoint directory is empty")

@@ -43,7 +43,8 @@ class CheckpointManager:
         )
 
     def save(self, round_idx: int, state: Any, force: bool = False,
-             metadata: Optional[dict] = None) -> bool:
+             metadata: Optional[dict] = None,
+             store: Optional[Any] = None) -> bool:
         """Best-effort save of ``state`` under step ``round_idx``
         (respects save_every): an orbax/disk failure (ENOSPC, a flaky
         network filesystem, a GC race) logs a warning, bumps
@@ -54,7 +55,14 @@ class CheckpointManager:
         step (e.g. cumulative cost counters — for evolving-mask algorithms
         the replayed rounds had different densities, so a resumed run must
         restore the exact totals rather than re-estimate them from the
-        final density)."""
+        final density).
+
+        ``store``: optional ``core.client_store.ClientStore`` — under
+        ``--client_store host/disk`` the per-client rows (personal
+        params / topk residual) live OUTSIDE the orbax state pytree, so
+        the step is only resumable together with a store snapshot.
+        Saved as a ``store_<step>.npz`` sidecar with the same
+        atomic-publish + prune lifecycle as the metadata sidecar."""
         if not force and round_idx % self.save_every:
             return False
         try:
@@ -63,6 +71,8 @@ class CheckpointManager:
             self.mgr.wait_until_finished()
             if metadata is not None:
                 self._save_metadata(round_idx, metadata)
+            if store is not None:
+                self._save_store(round_idx, store)
         except Exception:
             self.save_failures += 1
             logger.warning(
@@ -100,6 +110,28 @@ class CheckpointManager:
                 except OSError:
                     pass
 
+    def _save_store(self, round_idx: int, store: Any) -> None:
+        import glob as _glob
+        import os
+        import re as _re
+
+        # snapshot_save is itself atomic (tmp + os.replace) — a SIGKILL
+        # mid-write can't publish a truncated sidecar
+        store.snapshot_save(self._store_path(round_idx))
+        alive = set(self.mgr.all_steps())
+        for p in _glob.glob(os.path.join(self.directory, "store_*.npz")):
+            m = _re.match(r"store_(\d+)\.npz$", os.path.basename(p))
+            if m and int(m.group(1)) not in alive:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _store_path(self, round_idx: int) -> str:
+        import os
+
+        return os.path.join(self.directory, f"store_{round_idx}.npz")
+
     def load_metadata(self, round_idx: int) -> Optional[dict]:
         import json
         import os
@@ -118,8 +150,9 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self.mgr.latest_step()
 
-    def restore_latest(self, template: Any,
-                       schema_hint: str = "") -> Optional[Tuple[Any, int]]:
+    def restore_latest(self, template: Any, schema_hint: str = "",
+                       store: Optional[Any] = None,
+                       ) -> Optional[Tuple[Any, int]]:
         """Restore the newest restorable checkpoint, shaped like
         ``template`` (an ``algo.init_state(...)`` pytree); returns
         (state, round_idx) or None when the directory is empty.
@@ -133,7 +166,15 @@ class CheckpointManager:
         state-schema feature most likely to explain an all-steps
         failure (e.g. the agg_impl='topk' error-feedback residual or
         the --eval_cache per-client eval cache — both carried by the
-        runner's template only under their flag).
+        runner's template only under their flag, or the
+        --client_store store-backed lineage, whose states carry no
+        resident per-client stacks at all).
+
+        ``store``: optional ``ClientStore`` — a store-backed lineage
+        (--client_store host/disk) is only resumable from a step whose
+        ``store_<step>.npz`` sidecar exists and loads; a step missing
+        it counts as unrestorable and falls back to the next older
+        retained step, same as a partial orbax write.
 
         Ownership: the restored state is freshly allocated — the
         caller owns it outright and may hand it to a donating entry
@@ -152,6 +193,12 @@ class CheckpointManager:
             try:
                 state = self.mgr.restore(
                     step, args=self._ocp.args.StandardRestore(abstract))
+                if store is not None:
+                    # store-backed lineage: the step is only as good as
+                    # its row snapshot — load it BEFORE declaring the
+                    # step restored so a missing/truncated sidecar falls
+                    # through to an older step like any partial write
+                    store.snapshot_load(self._store_path(step))
             except Exception as e:
                 last_err = e
                 logger.warning(

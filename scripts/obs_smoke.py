@@ -37,7 +37,11 @@ asserts the obs acceptance contract:
      (and a rebuilt entry matches the live one), an exact-twin rerun
      passes the comparator's ``obs diff --expect identical`` gate on
      all three planes plus the params plane, and the fleet report is
-     byte-identical across two generations.
+     byte-identical across two generations,
+  8. the STORE leg (--client_store, core/client_store.py): a
+     streamed-residency twin of a store-off run diffs ``identical``
+     on the trajectory/events planes with ``client_store`` in the
+     config plane's inert bucket, and final params bit-match.
 
     python scripts/obs_smoke.py                     # CI gate
     python scripts/obs_smoke.py --clients 8 --rounds 8
@@ -409,6 +413,37 @@ def main(argv=None) -> dict:
     if b1 != b2:
         raise SystemExit("fleet report is not byte-deterministic")
 
+    # 8. store leg (core/client_store.py): a --client_store host twin
+    # of a store-off run (same seed, sampled participation) must pass
+    # the comparator's identical gate on the trajectory/events planes
+    # with client_store classified INERT in the config plane — the
+    # streamed-residency bit-identity contract, end-to-end through the
+    # runner/obs stack — and the final params must bit-match.
+    store_part = ["--frac", "0.5"]  # store refuses full participation
+    _, out_soff = timed_wall(obs_flags + store_part, "store_off", 2)
+    _, out_son = timed_wall(
+        obs_flags + store_part
+        + ["--client_store", "host", "--store_hot_clients", "4"],
+        "store_on", 2)
+    store_doc = obs_diff.diff_runs(
+        obs_diff.load_run(os.path.join(tmp, "store_off", "results",
+                                       "synthetic")),
+        obs_diff.load_run(os.path.join(tmp, "store_on", "results",
+                                       "synthetic")))
+    if obs_diff.expect_exit_code(store_doc, "identical") != 0:
+        raise SystemExit(
+            "store-on twin failed obs diff --expect identical\n"
+            + obs_diff.render_diff(store_doc))
+    cfg_plane = store_doc["planes"]["config"]
+    if "client_store" not in cfg_plane["inert"]:
+        raise SystemExit(
+            "client_store did not land in the config plane's inert "
+            f"bucket: {cfg_plane}")
+    if not obs_diff.params_diff(
+            out_soff["state"].global_params,
+            out_son["state"].global_params)["identical"]:
+        raise SystemExit("store-on twin's final params diverged")
+
     result = {
         "obs_ok": True, "clients": args.clients, "rounds": args.rounds,
         "model": args.model,
@@ -425,6 +460,7 @@ def main(argv=None) -> dict:
         "bit_identical": True,
         "catalog_entries": len(entries),
         "twin_diff_identical": True,
+        "store_twin_identical": True,
         "report_bytes": len(b1),
         "report_deterministic": True, **art,
     }

@@ -1,0 +1,451 @@
+"""Population-scale client store (``--client_store`` — ISSUE 14 /
+ROADMAP Open item 2).
+
+The residency contract: a streamed-cohort run (host/disk-resident
+per-client rows, only the sampled slab on device) is BIT-IDENTICAL to
+the fully device-resident run — across dense/topk aggregation, the
+guard's quarantine, fused 2-round blocks, the in-state eval cache, and
+a kill+resume through a store-backed checkpoint — while device memory
+stays flat in the population size C. Per the BASELINE notes the 1-vCPU
+sandbox cannot measure HBM directly; the flatness gate reads the
+obs/memory.py live-arrays ledger, and the throughput gate uses the
+generous 2x bound the acceptance names."""
+import gc
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.algorithms import Ditto, FedAvg
+from neuroimagedisttraining_tpu.core.client_store import ClientStore
+from neuroimagedisttraining_tpu.core.state import HyperParams
+from neuroimagedisttraining_tpu.data import make_synthetic_federated
+from neuroimagedisttraining_tpu.models import create_model
+
+
+def _data(n_clients=12, vol=6, n=8, m=4):
+    return make_synthetic_federated(
+        n_clients=n_clients, samples_per_client=n, test_per_client=m,
+        sample_shape=(vol, vol, vol, 1),
+    )
+
+
+def _hp():
+    return HyperParams(lr=0.05, lr_decay=0.998, momentum=0.9,
+                       local_epochs=1, steps_per_epoch=2, batch_size=4)
+
+
+def _mk(cls, store, tmp_path, data=None, frac=0.25, seed=3, **kw):
+    extra = {}
+    if store:
+        extra = dict(client_store=store, store_hot_clients=3,
+                     store_dir=str(tmp_path / f"store_{id(kw)}"))
+    return cls(create_model("small3dcnn", num_classes=1),
+               data if data is not None else _data(), _hp(),
+               loss_type="bce", frac=frac, seed=seed, **kw, **extra)
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------- unit
+
+
+def _template():
+    return {"w": np.zeros((3, 2), np.float32),
+            "b": np.ones((4,), np.float32)}
+
+
+def test_store_default_rows_and_roundtrip(tmp_path):
+    """Unmaterialized rows synthesize the registered default; written
+    rows read back exactly."""
+    st = ClientStore(8, mode="host", hot_clients=4)
+    st.register("personal_params", _template())
+    got = st.gather("personal_params", np.array([5]))
+    assert np.array_equal(np.asarray(got["w"])[0], np.zeros((3, 2)))
+    row = {"w": np.full((1, 3, 2), 7.0, np.float32),
+           "b": np.full((1, 4), -1.0, np.float32)}
+    st.stage("personal_params", np.array([5]), row)
+    st.commit()
+    back = st.gather("personal_params", np.array([5, 0]))
+    assert np.array_equal(np.asarray(back["w"])[0], row["w"][0])
+    assert np.array_equal(np.asarray(back["w"])[1], np.zeros((3, 2)))
+
+
+def test_store_lru_eviction_and_writeback_order(tmp_path):
+    """Disk mode with a 2-row hot cache: overflow spills to the memmap
+    tier, evicted rows read back exactly, and when the same id is
+    staged twice the LATER stage wins at commit (writeback ordering)."""
+    st = ClientStore(6, mode="disk", hot_clients=2,
+                     root=str(tmp_path / "d"))
+    st.register("agg_residual", _template())
+    for cid in range(4):
+        st.stage("agg_residual",
+                 np.array([cid]),
+                 {"w": np.full((1, 3, 2), float(cid), np.float32),
+                  "b": np.full((1, 4), float(cid), np.float32)})
+    # same id staged twice: the second write must win
+    st.stage("agg_residual", np.array([1]),
+             {"w": np.full((1, 3, 2), 99.0, np.float32),
+              "b": np.full((1, 4), 99.0, np.float32)})
+    st.commit()
+    assert len(st._fields["agg_residual"].rows) <= 2  # LRU capacity
+    assert st.stats()["mem_store_disk_bytes"] > 0
+    got = st.gather("agg_residual", np.arange(4))
+    w = np.asarray(got["w"])
+    for cid in range(4):
+        want = 99.0 if cid == 1 else float(cid)
+        assert np.all(w[cid] == want), (cid, w[cid])
+
+
+def test_store_discard_drops_staged_rows():
+    """The watchdog no-poison hook: discarded stages never reach
+    storage — the previous committed value survives."""
+    st = ClientStore(4, mode="host", hot_clients=4)
+    st.register("personal_params", _template())
+    good = {"w": np.full((1, 3, 2), 1.0, np.float32),
+            "b": np.full((1, 4), 1.0, np.float32)}
+    st.stage("personal_params", np.array([2]), good)
+    st.commit()
+    st.stage("personal_params", np.array([2]),
+             {"w": np.full((1, 3, 2), np.nan, np.float32),
+              "b": np.full((1, 4), np.nan, np.float32)})
+    assert list(st.dirty_ids()) == [2]
+    st.discard()
+    assert list(st.dirty_ids()) == []
+    back = st.gather("personal_params", np.array([2]))
+    assert np.all(np.asarray(back["w"]) == 1.0)
+
+
+def test_store_snapshot_roundtrip_and_schema_guard(tmp_path):
+    st = ClientStore(5, mode="host", hot_clients=2)
+    st.register("personal_params", _template())
+    st.stage("personal_params", np.array([0, 3]),
+             {"w": np.stack([np.full((3, 2), 4.0, np.float32)] * 2),
+              "b": np.stack([np.full((4,), 4.0, np.float32)] * 2)})
+    snap = str(tmp_path / "snap.npz")
+    st.snapshot_save(snap)
+    st2 = ClientStore(5, mode="host", hot_clients=2)
+    st2.register("personal_params", _template())
+    st2.snapshot_load(snap)
+    assert _leaves_equal(st.gather_all("personal_params"),
+                         st2.gather_all("personal_params"))
+    # field-set mismatch is the store analogue of a checkpoint schema
+    # mismatch and must refuse, not silently drop rows
+    st3 = ClientStore(5, mode="host", hot_clients=2)
+    st3.register("agg_residual", _template())
+    with pytest.raises(RuntimeError, match="fields"):
+        st3.snapshot_load(snap)
+    st4 = ClientStore(7, mode="host", hot_clients=2)
+    st4.register("personal_params", _template())
+    with pytest.raises(RuntimeError, match="C="):
+        st4.snapshot_load(snap)
+
+
+# -------------------------------------------------------- bit-identity
+
+
+def _run_pair(cls, tmp_path, mode, rounds=3, **kw):
+    a = _mk(cls, None, tmp_path, **kw)
+    b = _mk(cls, mode, tmp_path, **kw)
+    sa = a.init_state(jax.random.PRNGKey(0))
+    sb = b.init_state(jax.random.PRNGKey(0))
+    for r in range(rounds):
+        sa, ma = a.run_round(sa, r)
+        sb, mb = b.run_round(sb, r)
+        for k in ma:
+            assert float(ma[k]) == float(mb[k]), (r, k)
+    return a, sa, b, sb
+
+
+def _assert_rows_match(a, sa, b, sb):
+    """Every streamed row bit-matches its resident twin, global params
+    and the full evaluate() protocol output included."""
+    assert _leaves_equal(sa.global_params, sb.global_params)
+    b.store_flush()
+    if getattr(sa, "personal_params", None) is not None:
+        assert _leaves_equal(sa.personal_params,
+                             b._store.gather_all("personal_params"))
+    if getattr(sa, "agg_residual", None) is not None:
+        assert _leaves_equal(sa.agg_residual,
+                             b._store.gather_all("agg_residual"))
+    ev_a, ev_b = a.evaluate(sa), b.evaluate(sb)
+    for k in ev_a:
+        assert np.array_equal(np.asarray(ev_a[k]),
+                              np.asarray(ev_b[k])), k
+
+
+@pytest.mark.parametrize("mode,agg_impl,guarded", [
+    ("host", "dense", False),
+    ("host", "topk", True),
+    ("disk", "dense", True),
+    ("disk", "topk", False),
+])
+def test_streamed_bitwise_equals_resident(tmp_path, mode, agg_impl,
+                                          guarded):
+    """The tentpole pin: dense/topk x guard on/off x host/disk — the
+    streamed run's metrics, rows, residuals, and eval outputs all
+    bit-match the resident run (guarded cells inject NaN faults, so the
+    quarantine path — kept previous rows — is exercised through the
+    store writeback, the no-poison-leak rule extended to disk)."""
+    kw = dict(agg_impl=agg_impl)
+    if guarded:
+        kw.update(fault_spec="nan=0.3", guard=True)
+    a, sa, b, sb = _run_pair(FedAvg, tmp_path, mode, **kw)
+    _assert_rows_match(a, sa, b, sb)
+
+
+def test_streamed_fused_blocks_bitwise(tmp_path):
+    """Fused 2-round blocks through the block-union slab: metrics and
+    final rows bit-match the resident fused run (dense + topk)."""
+    for agg_impl in ("dense", "topk"):
+        a = _mk(FedAvg, None, tmp_path, agg_impl=agg_impl)
+        b = _mk(FedAvg, "host", tmp_path, agg_impl=agg_impl)
+        sa = a.init_state(jax.random.PRNGKey(0))
+        sb = b.init_state(jax.random.PRNGKey(0))
+        for r0 in (0, 2):
+            sa, ya = a.run_rounds_fused(sa, r0, 2, eval_every=0)
+            sb, yb = b.run_rounds_fused(sb, r0, 2, eval_every=0)
+            ma, mb = ya.materialize(), yb.materialize()
+            assert _leaves_equal(ma, mb)
+        _assert_rows_match(a, sa, b, sb)
+
+
+def test_streamed_ditto_and_eval_cache(tmp_path):
+    """Ditto's unchanged round body at slab width, and FedAvg's
+    in-state eval cache composed with the store-backed eval path."""
+    a, sa, b, sb = _run_pair(Ditto, tmp_path, "host")
+    _assert_rows_match(a, sa, b, sb)
+    a, sa, b, sb = _run_pair(FedAvg, tmp_path, "host", eval_cache=True)
+    _assert_rows_match(a, sa, b, sb)
+
+
+def test_watchdog_discard_keeps_streamed_identity(tmp_path):
+    """A discarded attempt (the watchdog RETRY/SKIP path) leaves the
+    store exactly where the accepted rounds put it: run round 0 on both
+    twins, run a doomed extra attempt on the streamed twin and discard
+    it, then continue — everything still bit-matches."""
+    a = _mk(FedAvg, None, tmp_path)
+    b = _mk(FedAvg, "disk", tmp_path)
+    sa = a.init_state(jax.random.PRNGKey(0))
+    sb = b.init_state(jax.random.PRNGKey(0))
+    sa, _ = a.run_round(sa, 0)
+    sb, _ = b.run_round(sb, 0)
+    doomed = b.clone_state(sb)
+    b.run_round(doomed, 1)  # attempt whose rows must NOT leak
+    b.store_discard()
+    for r in (1, 2):
+        sa, ma = a.run_round(sa, r)
+        sb, mb = b.run_round(sb, r)
+        assert float(ma["train_loss"]) == float(mb["train_loss"]), r
+    _assert_rows_match(a, sa, b, sb)
+
+
+# ------------------------------------------------- checkpoint / resume
+
+
+def test_store_backed_checkpoint_resume(tmp_path):
+    """Kill+resume through a store-backed lineage: checkpoint rounds
+    0-1 (orbax state + store_<step>.npz sidecar), rebuild everything
+    from scratch, restore, run rounds 2-3 — bit-identical to the
+    uninterrupted streamed run AND to the resident run."""
+    from neuroimagedisttraining_tpu.utils.checkpoint import (
+        CheckpointManager,
+    )
+
+    def fresh():
+        return _mk(FedAvg, "host", tmp_path, agg_impl="topk")
+
+    # uninterrupted twin (resident) for the final cross-check
+    a = _mk(FedAvg, None, tmp_path, agg_impl="topk")
+    sa = a.init_state(jax.random.PRNGKey(0))
+    for r in range(4):
+        sa, _ = a.run_round(sa, r)
+
+    b = fresh()
+    sb = b.init_state(jax.random.PRNGKey(0))
+    mgr = CheckpointManager(str(tmp_path / "ck"), "lineage")
+    for r in range(2):
+        sb, _ = b.run_round(sb, r)
+        mgr.save(r + 1, sb, force=True, store=b._store)
+    assert os.path.exists(mgr._store_path(2))
+    mgr.close()
+    del b, sb
+
+    c = fresh()  # the post-kill process: nothing survives but disk
+    mgr2 = CheckpointManager(str(tmp_path / "ck"), "lineage")
+    template = c.init_state(jax.random.PRNGKey(0))
+    sc, step = mgr2.restore_latest(template, store=c._store)
+    assert step == 2
+    for r in range(2, 4):
+        sc, _ = c.run_round(sc, r)
+    assert _leaves_equal(sa.global_params, sc.global_params)
+    c.store_flush()
+    assert _leaves_equal(sa.personal_params,
+                         c._store.gather_all("personal_params"))
+    assert _leaves_equal(sa.agg_residual,
+                         c._store.gather_all("agg_residual"))
+    # a step whose sidecar is missing is unrestorable: fall back older
+    os.unlink(mgr2._store_path(2))
+    d = fresh()
+    sd, step = mgr2.restore_latest(
+        d.init_state(jax.random.PRNGKey(0)), store=d._store)
+    assert step == 1
+    mgr2.close()
+
+
+# ------------------------------------------------------------ refusals
+
+
+def test_ctor_refusals(tmp_path):
+    data = _data()
+    with pytest.raises(ValueError, match="track_personal"):
+        _mk(FedAvg, "host", tmp_path, data=data, track_personal=False)
+    with pytest.raises(ValueError, match="full participation"):
+        _mk(FedAvg, "host", tmp_path, data=data, frac=1.0)
+    # residual-only store: track_personal=0 IS allowed under topk
+    algo = _mk(FedAvg, "host", tmp_path, data=data,
+               track_personal=False, agg_impl="topk")
+    s = algo.init_state(jax.random.PRNGKey(0))
+    assert algo._store.has_field("agg_residual")
+    assert not algo._store.has_field("personal_params")
+    s, _ = algo.run_round(s, 0)
+    algo.store_flush()
+    assert algo._store.stats()["mem_host_cache_bytes"] > 0
+
+
+def test_runner_refuses_contradictory_flags():
+    """Satellite 1: the runner names the contradiction before any model
+    or data is built."""
+    from neuroimagedisttraining_tpu.experiments import parse_args
+    from neuroimagedisttraining_tpu.experiments.runner import (
+        build_algorithm,
+    )
+
+    base = ["--dataset", "synthetic", "--model", "small3dcnn",
+            "--client_num_in_total", "8", "--comm_round", "1",
+            "--frac", "0.5"]
+    cases = [
+        (["--client_store", "host", "--track_personal", "0"],
+         "track_personal"),
+        (["--client_store", "host", "--frac", "1.0"], "frac 1.0"),
+        (["--client_store", "disk", "--eval_clients", "4"],
+         "eval_clients"),
+        (["--client_store", "host", "--fuse_rounds", "2",
+          "--frequency_of_the_test", "1"], "fuse_rounds"),
+    ]
+    for extra, needle in cases:
+        with pytest.raises(SystemExit, match=needle):
+            build_algorithm(parse_args(base + extra, algo="fedavg"),
+                            "fedavg")
+    with pytest.raises(SystemExit, match="client_store"):
+        build_algorithm(
+            parse_args(base + ["--client_store", "host"], algo="dpsgd"),
+            "dpsgd")
+
+
+# ------------------------------------------- population-scale / ledger
+
+
+def _device_in_use():
+    from neuroimagedisttraining_tpu.obs.memory import device_memory
+
+    gc.collect()
+    return max((d["bytes_in_use"] for d in device_memory()), default=0)
+
+
+def test_population_memory_flat_in_C(tmp_path):
+    """The acceptance curve: C=10240 streamed uses no more device
+    memory than C=256 resident at equal per-round S (within 5%), via
+    the obs/memory.py ledger. Data stays host numpy in store mode, so
+    only the S-row slabs and the model-sized state ever reach device."""
+    hp = _hp()
+    model = create_model("small3dcnn", num_classes=1)
+
+    def measure(n_clients, store):
+        data = _data(n_clients=n_clients, vol=6, n=2, m=1)
+        extra = (dict(client_store="host", store_hot_clients=16)
+                 if store else {})
+        algo = FedAvg(model, data, hp, loss_type="bce",
+                      frac=8.0 / n_clients, seed=0, **extra)
+        # The contract is about what the ALGO keeps resident: once the
+        # shards are handed over (store mode copies them to host in the
+        # ctor), the loader-side device stacks must be droppable.
+        del data
+        gc.collect()
+        state = algo.init_state(jax.random.PRNGKey(0))
+        for r in range(2):
+            state, _ = algo.run_round(state, r)
+        jax.block_until_ready(state.global_params)
+        used = _device_in_use()
+        del algo, state
+        gc.collect()
+        return used
+
+    resident_256 = measure(256, store=False)
+    streamed_10k = measure(10240, store=True)
+    assert streamed_10k <= 1.05 * resident_256, (
+        f"streamed C=10240 uses {streamed_10k} device bytes vs "
+        f"{resident_256} for resident C=256 — residency not flat in C")
+
+
+def test_store_throughput_within_2x(tmp_path):
+    """Acceptance: streamed rounds within 2x of resident at C=256
+    (min-of-2 per side; the gather/writeback overhead is a handful of
+    S-row host copies against a full round of training compute)."""
+    import time
+
+    hp = _hp()
+    model = create_model("small3dcnn", num_classes=1)
+
+    def rate(store):
+        data = _data(n_clients=256, vol=6, n=2, m=1)
+        extra = (dict(client_store="host", store_hot_clients=16)
+                 if store else {})
+        algo = FedAvg(model, data, hp, loss_type="bce", frac=8.0 / 256,
+                      seed=0, **extra)
+        state = algo.init_state(jax.random.PRNGKey(0))
+        state, _ = algo.run_round(state, 0)  # compile warmup
+        jax.block_until_ready(state.global_params)
+        best = float("inf")
+        for rep in range(2):
+            t0 = time.perf_counter()
+            for r in range(1 + 2 * rep, 3 + 2 * rep):
+                state, _ = algo.run_round(state, r)
+            jax.block_until_ready(state.global_params)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    resident = rate(store=False)
+    streamed = rate(store=True)
+    assert streamed <= 2.0 * resident, (
+        f"streamed {streamed:.3f}s vs resident {resident:.3f}s per "
+        "2 rounds — store overhead exceeds the 2x acceptance bound")
+
+
+def test_store_stats_ledger_keys(tmp_path):
+    """The obs residency ledger: ClientStore.stats feeds
+    MemoryWatermark.attach_extra — every gauge present, float-typed,
+    and hit/miss/prefetch counters move once rounds run."""
+    from neuroimagedisttraining_tpu.obs.memory import MemoryWatermark
+    from neuroimagedisttraining_tpu.obs.metrics import MetricsRegistry
+
+    b = _mk(FedAvg, "host", tmp_path)
+    sb = b.init_state(jax.random.PRNGKey(0))
+    for r in range(3):
+        sb, _ = b.run_round(sb, r)
+    wm = MemoryWatermark(MetricsRegistry())
+    wm.attach_extra(b._store.stats)
+    sample = wm.sample()
+    for key in ("mem_host_cache_bytes", "mem_store_disk_bytes",
+                "mem_store_hits", "mem_store_misses",
+                "mem_store_prefetched", "store_gather_ms"):
+        assert key in sample and isinstance(sample[key], float), key
+    assert sample["mem_store_hits"] + sample["mem_store_misses"] > 0
+    assert sample["store_gather_ms"] > 0
