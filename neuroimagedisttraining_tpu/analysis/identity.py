@@ -156,6 +156,33 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
                               "resident==streamed)"),
     "store_hot_clients": ("inert", "host LRU capacity — residency/"
                                    "eviction knob, never values"),
+    # federated deployment (fed/): the MODE and its policy knobs change
+    # the trained model; the role/topology/timing knobs name where the
+    # same computation runs
+    "fed_mode": ("identity", "sync-vs-buffered changes the aggregation "
+                             "policy and hence the trained model"),
+    "fed_sites": ("identity", "the site partition shapes buffered "
+                              "deltas (and the deployment lineage)"),
+    "fed_buffer_k": ("identity", "FedBuff flush depth — which deltas "
+                                 "average together"),
+    "fed_staleness_bound": ("identity", "which late deltas fold vs "
+                                        "drop — changes the model"),
+    "fed_replay": ("identity", "pinned arrival order IS the buffered "
+                               "trajectory"),
+    "fed_site_faults": ("identity", "real-process drops/straggles "
+                                    "change which deltas exist"),
+    "fed_role": ("inert", "names WHICH process this is, not what the "
+                          "federation computes"),
+    "fed_backend": ("inert", "transport choice; the wire is "
+                             "bit-transparent (tests/test_fed_wire.py)"),
+    "fed_site_rank": ("inert", "process placement"),
+    "fed_endpoints": ("inert", "process placement"),
+    "fed_timeout_s": ("inert", "wall-clock degradation budget — "
+                               "timing, not policy"),
+    "fed_retries": ("inert", "send retry budget, timing only"),
+    "fed_backoff_s": ("inert", "send retry backoff, timing only"),
+    "fed_trace": ("inert", "trace output path"),
+    "fed_out": ("inert", "federation output path"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

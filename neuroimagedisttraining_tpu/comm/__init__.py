@@ -6,8 +6,8 @@ is the *cross-silo* message layer — the rebuild of
 MPI/gRPC/MQTT backends) with a native C++ TCP transport
 (``native/comm/tcp_comm.cpp``) plus an in-process backend for simulation.
 """
-from .base import BaseCommunicationManager, Observer
-from .cross_silo import CrossSiloClient, CrossSiloServer
+from .base import BaseCommunicationManager, CommCounters, Observer
+from .cross_silo import CrossSiloClient, CrossSiloServer, RoundOutcome
 from .grpc_backend import GrpcCommManager, endpoints_from_hosts, grpc_available
 from .local import LocalCommManager, LocalRouter
 from .manager import ClientManager, DistributedManager, ServerManager
@@ -18,8 +18,10 @@ from .tcp import TcpCommManager, build_native, native_available
 __all__ = [
     "BaseCommunicationManager",
     "ClientManager",
+    "CommCounters",
     "CrossSiloClient",
     "CrossSiloServer",
+    "RoundOutcome",
     "DistributedManager",
     "GrpcCommManager",
     "LocalCommManager",

@@ -27,30 +27,49 @@ class CommCounters:
     counts actually sent/received over the wire (the measured side of
     obs/comm.py's analytical wire-cost model). Updated by every backend
     at its send/receive sites; ``snapshot()`` is what a cross-silo
-    round loop folds into its telemetry."""
+    round loop folds into its telemetry.
+
+    Thread-safe: the receive pump runs on its own thread while round
+    loops send from the caller's thread, so the += pairs are guarded —
+    an unsynchronized bytes+=/messages+= pair can tear (lost updates,
+    or a snapshot observing bytes from a send whose message count
+    hasn't landed)."""
 
     __slots__ = ("bytes_sent", "bytes_received", "messages_sent",
-                 "messages_received")
+                 "messages_received", "messages_retried", "_lock")
 
     def __init__(self):
         self.bytes_sent = 0
         self.bytes_received = 0
         self.messages_sent = 0
         self.messages_received = 0
+        # send attempts that failed transiently and were re-issued by
+        # fed.protocol.send_with_retry — the degradation signal the fed
+        # obs fold surfaces alongside the byte counters
+        self.messages_retried = 0
+        self._lock = threading.Lock()
 
     def note_sent(self, nbytes: int) -> None:
-        self.bytes_sent += int(nbytes)
-        self.messages_sent += 1
+        with self._lock:
+            self.bytes_sent += int(nbytes)
+            self.messages_sent += 1
 
     def note_received(self, nbytes: int) -> None:
-        self.bytes_received += int(nbytes)
-        self.messages_received += 1
+        with self._lock:
+            self.bytes_received += int(nbytes)
+            self.messages_received += 1
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.messages_retried += 1
 
     def snapshot(self) -> dict:
-        return {"comm_bytes_sent": self.bytes_sent,
-                "comm_bytes_received": self.bytes_received,
-                "comm_messages_sent": self.messages_sent,
-                "comm_messages_received": self.messages_received}
+        with self._lock:
+            return {"comm_bytes_sent": self.bytes_sent,
+                    "comm_bytes_received": self.bytes_received,
+                    "comm_messages_sent": self.messages_sent,
+                    "comm_messages_received": self.messages_received,
+                    "comm_messages_retried": self.messages_retried}
 
 
 class BaseCommunicationManager(abc.ABC):

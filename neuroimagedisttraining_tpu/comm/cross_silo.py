@@ -14,6 +14,7 @@ Protocol (star topology, server = rank 0):
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import queue
 import threading
@@ -29,6 +30,35 @@ logger = logging.getLogger(__name__)
 
 # local_train_fn(params, round_idx) -> (new_params, n_samples, train_loss)
 LocalTrainFn = Callable[[Any, int], Tuple[Any, int, float]]
+
+
+@dataclasses.dataclass
+class RoundOutcome:
+    """Typed result of one cross-silo round — the quorum shortfall that
+    used to surface only as an unhandled ``queue.Empty`` is now an
+    explicit verdict the caller can branch on.
+
+    ``status``:
+      * ``"completed"`` — every client reported; full aggregate applied;
+      * ``"quorum"`` — the collect window timed out but at least
+        ``quorum`` clients reported; their updates aggregated with
+        weights renormalized over the survivors (the guard machinery's
+        survivor-renormalization rule, applied at the transport layer);
+      * ``"timeout"`` — fewer than ``quorum`` clients reported; the
+        global model is left untouched (carry, like a zero-survivor
+        guarded round).
+    """
+
+    status: str                       # completed | quorum | timeout
+    round_idx: int
+    received: List[int]               # client ranks that reported in time
+    missing: List[int]                # client ranks that did not
+    record: Dict[str, float]          # the history record (round, loss, ...)
+
+    @property
+    def applied(self) -> bool:
+        """Whether this round changed the global model."""
+        return self.status in ("completed", "quorum")
 
 
 class CrossSiloServer(ServerManager):
@@ -50,7 +80,18 @@ class CrossSiloServer(ServerManager):
             Message.MSG_TYPE_LOCAL_UPDATE, self._updates.put)
         self.history: List[Dict[str, float]] = []
 
-    def run_round(self, round_idx: int, timeout_s: float = 120.0) -> Dict[str, float]:
+    def run_round(self, round_idx: int, timeout_s: float = 120.0,
+                  quorum: Optional[int] = None) -> RoundOutcome:
+        """Broadcast the global model, collect client updates, aggregate.
+
+        ``timeout_s`` bounds the wait for EACH update; ``quorum``
+        (default: all clients) is the minimum number of reporting clients
+        needed to apply an aggregate at all. See :class:`RoundOutcome`
+        for the completed/quorum/timeout semantics — a shortfall is a
+        typed verdict, never a silent return or an unhandled
+        ``queue.Empty``."""
+        n_clients = self.world_size - 1
+        quorum = n_clients if quorum is None else max(1, int(quorum))
         sparse_payload = None
         if self.mask is not None:
             # sparsify once; the identical payload goes to every client
@@ -69,8 +110,13 @@ class CrossSiloServer(ServerManager):
         updates: List[Tuple[Any, float]] = []
         losses: List[float] = []
         seen: set = set()
-        while len(updates) < self.world_size - 1:
-            msg = self._updates.get(timeout=timeout_s)
+        timed_out = False
+        while len(updates) < n_clients:
+            try:
+                msg = self._updates.get(timeout=timeout_s)
+            except queue.Empty:
+                timed_out = True
+                break
             # drop stragglers from earlier rounds and duplicate senders —
             # averaging a stale round-r update into round r+1 would silently
             # corrupt the global model (a stale ERROR reply must not abort
@@ -95,7 +141,24 @@ class CrossSiloServer(ServerManager):
             updates.append((msg.get_tensor("params"),
                             float(msg.get("n_samples"))))
             losses.append(float(msg.get("train_loss", float("nan"))))
+        received = sorted(seen)
+        missing = [r for r in range(1, self.world_size) if r not in seen]
+        if timed_out and len(updates) < quorum:
+            # below quorum: carry the previous global model untouched —
+            # the zero-survivor rule of robust/guard.guarded_aggregate,
+            # applied at the transport layer
+            logger.warning(
+                "cross-silo round %d TIMEOUT: %d/%d updates (< quorum %d);"
+                " global model carried", round_idx, len(updates),
+                n_clients, quorum)
+            rec = {"round": round_idx, "train_loss": float("nan"),
+                   "clients_reported": float(len(updates))}
+            self.history.append(rec)
+            return RoundOutcome("timeout", round_idx, received, missing,
+                                rec)
         total = sum(w for _, w in updates)
+        # survivor renormalization: weights sum to 1 over the clients
+        # that reported, whether that is all of them or a quorum
         weights = [w / total for _, w in updates]
         # sample-weighted FedAvg sum (fedavg_api.py:102-117)
         self.global_params = jax.tree_util.tree_map(
@@ -103,14 +166,21 @@ class CrossSiloServer(ServerManager):
                 np.asarray(l) * w for l, w in zip(leaves, weights)),
             *[u for u, _ in updates],
         )
-        rec = {"round": round_idx, "train_loss": float(np.nanmean(losses))}
+        status = "quorum" if timed_out else "completed"
+        if timed_out:
+            logger.warning(
+                "cross-silo round %d finished with QUORUM %d/%d "
+                "(missing ranks %s; weights renormalized)", round_idx,
+                len(updates), n_clients, missing)
+        rec = {"round": round_idx, "train_loss": float(np.nanmean(losses)),
+               "clients_reported": float(len(updates))}
         self.history.append(rec)
-        return rec
+        return RoundOutcome(status, round_idx, received, missing, rec)
 
     def train(self, comm_rounds: int) -> Any:
         for r in range(comm_rounds):
-            rec = self.run_round(r)
-            logger.info("cross-silo round %d: %s", r, rec)
+            outcome = self.run_round(r)
+            logger.info("cross-silo round %d: %s", r, outcome.record)
         for dest in range(1, self.world_size):
             self.send_message(Message(Message.MSG_TYPE_FINISH, 0, dest))
         return self.global_params

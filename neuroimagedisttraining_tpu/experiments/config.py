@@ -13,6 +13,7 @@ experiment-tracking key and the log filename (``main_sailentgrads.py:205-241``).
 from __future__ import annotations
 
 import argparse
+import os
 from typing import List, Optional, Sequence
 
 ALGO_NAMES = (
@@ -363,6 +364,68 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="shard each volume's depth over this many devices "
                         "(hybrid clients x space mesh — the context-parallel "
                         "axis; volumes are zero-padded to divide it)")
+    # -- distributed federation (fed/): one aggregator process + N site
+    # processes over a real wire (scripts/run_federation.py launcher)
+    p.add_argument("--fed_role", type=str, default="",
+                   choices=["", "aggregator", "site"],
+                   help="federated deployment role: 'aggregator' runs "
+                        "rank 0 (and, on --fed_backend local, the whole "
+                        "loopback federation in-process); 'site' runs "
+                        "one site process (needs --fed_site_rank). "
+                        "Empty = the classic in-process simulation")
+    p.add_argument("--fed_mode", type=str, default="",
+                   choices=["", "sync", "buffered"],
+                   help="aggregation policy: 'sync' barriers per round "
+                        "(bit-identical to the in-process simulation on "
+                        "loopback); 'buffered' is FedBuff-style async — "
+                        "first K arriving deltas, staleness-discounted. "
+                        "Defaults to 'sync' when --fed_role is set")
+    p.add_argument("--fed_backend", type=str, default="local",
+                   choices=["local", "tcp"],
+                   help="transport: 'local' = in-process loopback "
+                        "threads (tests/CI), 'tcp' = the native C++ "
+                        "transport across real processes")
+    p.add_argument("--fed_sites", type=int, default=0,
+                   help="number of site processes (>= 1 for fed runs)")
+    p.add_argument("--fed_site_rank", type=int, default=0,
+                   help="this site process's rank in [1, fed_sites] "
+                        "(--fed_role site only)")
+    p.add_argument("--fed_endpoints", type=str, default="",
+                   help="rank-ordered 'host:port,...' including the "
+                        "aggregator at rank 0 (--fed_backend tcp)")
+    p.add_argument("--fed_buffer_k", type=int, default=0,
+                   help="buffered mode: apply a flush after this many "
+                        "deltas arrive (0 = max(1, fed_sites - 1), the "
+                        "leave-one-straggler default)")
+    p.add_argument("--fed_staleness_bound", type=int, default=2,
+                   help="buffered mode: drop deltas computed more than "
+                        "this many versions behind the current global "
+                        "model (FedBuff's staleness cap)")
+    p.add_argument("--fed_timeout_s", type=float, default=60.0,
+                   help="aggregator wait budget: sync collect window / "
+                        "buffered arrival gap before quorum degradation")
+    p.add_argument("--fed_retries", type=int, default=2,
+                   help="send_message retry budget (fed.protocol."
+                        "send_with_retry; exponential backoff)")
+    p.add_argument("--fed_backoff_s", type=float, default=0.05,
+                   help="base backoff between send retries")
+    p.add_argument("--fed_trace", type=str, default="",
+                   help="write the buffered arrival trace here (default: "
+                        "<fed_out>/trace.json)")
+    p.add_argument("--fed_replay", type=str, default="",
+                   help="replay a recorded arrival trace: the buffered "
+                        "run re-applies the same deltas in the same "
+                        "order — bit-for-bit deterministic")
+    p.add_argument("--fed_site_faults", type=str, default="",
+                   help="per-site process faults "
+                        "'rank:fault_spec[:delay_s];...' (robust/faults "
+                        "grammar), e.g. '3:straggle=1.0:6.0' — site 3 "
+                        "REALLY sleeps 6s before replying each round")
+    p.add_argument("--fed_out", type=str, default="",
+                   help="federation output dir (default: "
+                        "<results_dir>/fed/<identity>): per-process "
+                        "JSONL streams, the folded federation.jsonl, "
+                        "trace.json, summary.json")
     p.add_argument("--checkpoint_dir", type=str, default="",
                    help="enable round-granular orbax checkpointing here")
     p.add_argument("--resume", action="store_true",
@@ -622,6 +685,32 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         # refusal
         args.watchdog = 1 if (
             fault_spec and getattr(args, "fuse_rounds", 1) <= 1) else 0
+    # federated deployment (fed/): resolve the mode sentinel and validate
+    # the per-site fault grammar at parse time (the fault_spec rule).
+    # fed_mode, not fed_role, is the identity gate: the role names WHICH
+    # process this is (inert), the mode names WHAT model gets trained.
+    fed_role = getattr(args, "fed_role", "")
+    fed_mode = getattr(args, "fed_mode", "")
+    if fed_mode and not fed_role:
+        raise ValueError("--fed_mode requires --fed_role")
+    if fed_role:
+        if not fed_mode:
+            args.fed_mode = fed_mode = "sync"
+        if getattr(args, "fed_sites", 0) < 1:
+            raise ValueError("--fed_role requires --fed_sites >= 1")
+        if fed_mode == "buffered" and \
+                getattr(args, "fed_buffer_k", 0) <= 0:
+            # leave-one-straggler default: a flush never waits for the
+            # slowest site
+            args.fed_buffer_k = max(1, args.fed_sites - 1)
+        if getattr(args, "fed_site_faults", ""):
+            from ..fed.runtime import parse_site_faults
+
+            parse_site_faults(args.fed_site_faults)  # raises ValueError
+        if getattr(args, "fed_replay", "") and \
+                not os.path.isfile(args.fed_replay):
+            raise ValueError(
+                f"--fed_replay trace {args.fed_replay!r} does not exist")
     return args
 
 
@@ -763,6 +852,29 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         parts.append("nopers")
     if getattr(args, "global_test", False):
         parts.append("g")  # main_dispfl.py:198-199
+    fed_mode = getattr(args, "fed_mode", "")
+    if fed_mode:
+        # federated deployment changes the trained model: sync splits
+        # from the in-process lineage by protocol only (bit-identical on
+        # loopback, but eval/finetune/personal coverage differ), and the
+        # buffered policy's K / staleness bound / site partition shape
+        # the aggregate itself. Role/backend/addresses/timeouts stay out
+        # — they name WHERE the same computation runs.
+        parts.append(f"fed{fed_mode}")
+        parts.append(f"fs{getattr(args, 'fed_sites', 0)}")
+        if fed_mode == "buffered":
+            parts.append(f"fk{getattr(args, 'fed_buffer_k', 0)}")
+            parts.append(f"fst{getattr(args, 'fed_staleness_bound', 0)}")
+            if getattr(args, "fed_replay", ""):
+                # a replayed run pins arrival order — a different
+                # trajectory universe than free-running async
+                parts.append("fedreplay")
+        if getattr(args, "fed_site_faults", ""):
+            # real-process faults change which deltas exist (drops) and
+            # when they land (straggles) — trajectory, like fault_spec
+            parts.append("fflt" + args.fed_site_faults.replace("=", "")
+                         .replace(",", "-").replace(":", "x")
+                         .replace(";", "_").replace(".", "p"))
     if args.tag:
         parts.append(args.tag)
     return "-".join(str(x) for x in parts)

@@ -720,6 +720,16 @@ def run_experiment(args: argparse.Namespace,
     import jax
 
     algo_name = algo_name or getattr(args, "algo", "fedavg")
+    if getattr(args, "fed_role", ""):
+        # distributed federation (fed/): a genuinely multi-process
+        # deployment — its own round loop, obs streams, and lifecycle.
+        # Dispatched before checkpoint/obs setup: the fed runtime owns
+        # all of it (and refuses the in-process features it can't honor)
+        from ..fed.runtime import run_federated
+
+        configure_console()
+        seed_everything(args.seed)
+        return run_federated(args, algo_name)
     ckpt_mgr = None
     log_handler = None
     obs_session = None

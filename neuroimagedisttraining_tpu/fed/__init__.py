@@ -1,0 +1,61 @@
+"""Distributed federation runtime — the first genuinely multi-process
+deployment mode in the repo's life.
+
+Every "federated" run before this package was one Python process
+simulating sites sequentially (the in-mesh SPMD simulation of
+``algorithms/``). ``fed/`` wires the until-now orphaned comm stack
+(``comm/tcp.py``, ``comm/local.py``, ``comm/message.py``) into a real
+deployment: one **aggregator process** and N **site processes**
+exchanging model deltas over a wire, driven by
+``scripts/run_federation.py`` or a ``--fed_role aggregator|site``
+runner entry.
+
+Two aggregation policies behind one surface:
+
+* ``sync`` — barrier per round. On the loopback backend this is
+  bit-for-bit the in-process simulation (the correctness anchor:
+  ``scripts/fed_smoke.py`` pins params equality via
+  ``obs/diff.py params_diff``).
+* ``buffered`` — FedBuff-style async (Nguyen et al., AISTATS 2022):
+  apply the first K arriving deltas with staleness-discounted weights
+  ``n_i / sqrt(1 + tau_i)`` under ``--fed_staleness_bound``; stragglers
+  stop gating the round clock. Arrival order is recorded to a trace so
+  any buffered run replays bit-for-bit (``--fed_replay``).
+
+Module map: ``wire`` (delta codecs riding the ``agg_impl`` formats),
+``protocol`` (message types + send retry/backoff), ``trainer`` (the
+local-training split of the fused round body), ``site`` (site-process
+worker), ``aggregator`` (both policies + trace record/replay),
+``runtime`` (role dispatch, loopback harness, refusals, obs fold).
+"""
+from .aggregator import FedAggregator
+from .protocol import (
+    FED_SALT,
+    MSG_FED_FINISH,
+    MSG_FED_TRAIN,
+    MSG_FED_UPDATE,
+    partition_slots,
+    send_with_retry,
+    site_round_key,
+)
+from .runtime import run_federated
+from .site import SiteWorker
+from .trainer import SiteTrainer
+from .wire import WIRE_IMPLS, decode_update, encode_update
+
+__all__ = [
+    "FED_SALT",
+    "FedAggregator",
+    "MSG_FED_FINISH",
+    "MSG_FED_TRAIN",
+    "MSG_FED_UPDATE",
+    "SiteTrainer",
+    "SiteWorker",
+    "WIRE_IMPLS",
+    "decode_update",
+    "encode_update",
+    "partition_slots",
+    "run_federated",
+    "send_with_retry",
+    "site_round_key",
+]

@@ -1,0 +1,376 @@
+"""FedAggregator: rank-0 of a federation — two aggregation policies
+behind one surface.
+
+**sync** — barrier per round. The aggregator owns the host-side RNG
+chain (``split(state.rng)`` per round, exactly the in-process round
+body's consumption), ships the global model + round key + slot
+assignments, and reassembles the sites' locally-trained rows in slot
+order into the SAME [S]-stacked weighted mean the fused program
+computes. On the loopback backend this is bit-for-bit the in-process
+simulation (``scripts/fed_smoke.py`` pins it via ``params_diff``);
+missing sites degrade the round to a survivor-renormalized quorum
+aggregate (the ``RoundOutcome`` semantics of ``comm/cross_silo.py``,
+here at federation scale), and zero arrivals carry the global model.
+
+**buffered** — FedBuff (Nguyen et al., AISTATS 2022): deltas are
+applied in arrival order, K per flush, each weighted
+``n_i / sqrt(1 + tau_i)`` (staleness-discounted, normalized over the
+buffer) — a straggling site stops gating the round clock. Updates
+staler than ``staleness_bound`` are dropped and the site re-dispatched
+at the current version. Every flush's ``(site, base_version)`` members
+are recorded to an **arrival trace**; replaying the trace re-applies
+the same deltas in the same order — and because a site's delta is a
+pure function of ``(seed, version, site)`` (``protocol.site_round_key``)
+the replayed run is bit-for-bit identical (the async twin of the
+repo's determinism contract).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..comm.manager import ServerManager
+from ..comm.message import Message
+from ..core.state import weighted_tree_sum
+from ..obs.export import RoundLogWriter
+from . import protocol, wire
+
+logger = logging.getLogger(__name__)
+
+
+class FedAggregator(ServerManager):
+    def __init__(self, comm, world_size: int, algo: Any, *, mode: str,
+                 rounds: int, seed: int, buffer_k: int = 1,
+                 staleness_bound: int = 2, timeout_s: float = 60.0,
+                 retries: int = 2, backoff_s: float = 0.05,
+                 wire_impl: str = "dense", wire_density: float = 0.1,
+                 replay_trace: Optional[Dict[str, Any]] = None,
+                 log_path: str = "", events_path: str = ""):
+        super().__init__(comm, rank=0, world_size=world_size)
+        import jax
+
+        self.algo = algo
+        self.mode = mode
+        self.rounds = int(rounds)
+        self.seed = int(seed)
+        self.n_sites = world_size - 1
+        self.buffer_k = max(1, int(buffer_k))
+        self.staleness_bound = int(staleness_bound)
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.wire_impl = wire_impl
+        self.wire_density = wire_density
+        self.replay_trace = replay_trace
+        # buffered sites own fixed client blocks; sync re-partitions the
+        # sampled cohort per round
+        self.partition = protocol.partition_slots(
+            algo.num_clients, self.n_sites)
+        # the aggregator owns exactly the in-process state: params from
+        # the same init split, the same host-side rng chain
+        state0 = algo.init_state(jax.random.PRNGKey(self.seed))
+        self.global_params = state0.global_params
+        self.rng = state0.rng
+        self.version = 0
+        self.history: List[Dict[str, Any]] = []
+        self.staleness_hist: Dict[int, int] = {}
+        self.stale_drops = 0
+        self.trace: Dict[str, Any] = {
+            "mode": mode, "seed": self.seed, "sites": self.n_sites,
+            "buffer_k": self.buffer_k,
+            "staleness_bound": self.staleness_bound, "flushes": []}
+        self.writer = RoundLogWriter(log_path, force=True) \
+            if log_path else None
+        self.events = RoundLogWriter(events_path, force=True) \
+            if events_path else None
+        self._updates: "queue.Queue[Message]" = queue.Queue()
+        self.register_message_receive_handler(
+            protocol.MSG_FED_UPDATE, self._updates.put)
+
+    # -- shared plumbing --------------------------------------------------
+    def _send(self, msg: Message) -> None:
+        protocol.send_with_retry(self, msg, retries=self.retries,
+                                 backoff_s=self.backoff_s)
+
+    def _event(self, round_idx: int, event_type: str, **extra) -> None:
+        if self.events is not None:
+            self.events.write({"round": int(round_idx),
+                               "event_type": event_type, **extra})
+
+    def _record(self, rec: Dict[str, Any]) -> None:
+        self.history.append(rec)
+        if self.writer is not None:
+            self.writer.write(rec)
+
+    def execute(self) -> None:
+        """Run the configured number of rounds (sync) or flushes
+        (buffered), then tell every site to finish."""
+        if self.mode == "sync":
+            for r in range(self.rounds):
+                self.run_sync_round(r)
+        elif self.replay_trace is not None:
+            self.run_buffered_replay()
+        else:
+            self.run_buffered()
+        for dest in range(1, self.world_size):
+            try:
+                self._send(Message(protocol.MSG_FED_FINISH, 0, dest))
+            except OSError:
+                logger.warning("site %d unreachable at finish", dest)
+        if self.writer is not None:
+            self._record({"round": -1, "fed_mode": self.mode,
+                          "fed_version": self.version,
+                          "fed_stale_drops": self.stale_drops,
+                          "fed_staleness_hist": {
+                              str(k): v for k, v
+                              in sorted(self.staleness_hist.items())},
+                          **self.comm.counters.snapshot()})
+            self.writer.close()
+        if self.events is not None:
+            self.events.close()
+
+    # -- synchronous barrier ---------------------------------------------
+    def run_sync_round(self, round_idx: int) -> str:
+        """One barrier round; returns completed|quorum|timeout."""
+        import jax
+        import jax.numpy as jnp
+
+        algo = self.algo
+        sel = algo._selected_client_indexes(round_idx)
+        s_total = int(sel.shape[0])
+        self.rng, round_key = jax.random.split(self.rng)
+        parts = protocol.partition_slots(s_total, self.n_sites)
+        for k in range(1, self.n_sites + 1):
+            pos = parts[k - 1]
+            msg = Message(protocol.MSG_FED_TRAIN, 0, k)
+            msg.add("version", round_idx)
+            msg.add("mode", "sync")
+            msg.add("cohort_size", s_total)
+            msg.add_tensor("params", self.global_params)
+            msg.add_tensor("round_key", np.asarray(round_key))
+            msg.add_tensor("client_ids", sel[pos].astype(np.int32))
+            msg.add_tensor("slot_pos", pos.astype(np.int32))
+            self._send(msg)
+        rows_by_site: Dict[int, Any] = {}
+        losses_by_site: Dict[int, np.ndarray] = {}
+        deadline = time.monotonic() + self.timeout_s
+        while len(rows_by_site) < self.n_sites:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                msg = self._updates.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if msg.get("mode") != "sync" or \
+                    int(msg.get("version")) != round_idx:
+                logger.warning(
+                    "dropping stale fed update (site %s, version %s != "
+                    "round %d)", msg.get("site"), msg.get("version"),
+                    round_idx)
+                continue
+            site = int(msg.get("site"))
+            if site in rows_by_site:
+                logger.warning("duplicate update from site %d dropped",
+                               site)
+                continue
+            rows_by_site[site] = msg.get_tensor("rows")
+            losses_by_site[site] = np.asarray(msg.get_tensor("losses"))
+        received = sorted(rows_by_site)
+        missing = [k for k in range(1, self.n_sites + 1)
+                   if k not in rows_by_site]
+        if not received:
+            logger.warning(
+                "sync round %d TIMEOUT: no site reported; global carried",
+                round_idx)
+            self._event(round_idx, "fed_timeout", sites_missing=missing)
+            self._record({"round": round_idx,
+                          "train_loss": float("nan"),
+                          "sites_reported": 0, "fed_status": "timeout"})
+            self.version = round_idx + 1
+            return "timeout"
+        # reassemble the cohort in slot order: partitions are contiguous
+        # blocks, so concatenating the received sites' rows in rank
+        # order restores ascending slot positions
+        slot_pos = np.concatenate([parts[k - 1] for k in received])
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.asarray(np.concatenate(xs, axis=0)),
+            *[rows_by_site[k] for k in received])
+        losses = jnp.asarray(np.concatenate(
+            [losses_by_site[k] for k in received]))
+        n_all = np.asarray(algo.data.n_train)[sel]
+        n_sel = jnp.asarray(n_all[slot_pos])
+        # the in-process aggregation, verbatim (base.py round body):
+        # f32 sample weights normalized over whoever reported — all
+        # sites is the bit-parity path, a subset is the survivor-
+        # renormalization degradation
+        weights = n_sel.astype(jnp.float32)
+        weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
+        self.global_params = weighted_tree_sum(stacked, weights)
+        loss = float(jnp.mean(losses))
+        self.version = round_idx + 1
+        status = "completed" if not missing else "quorum"
+        if missing:
+            logger.warning(
+                "sync round %d QUORUM %d/%d (missing sites %s; weights "
+                "renormalized)", round_idx, len(received), self.n_sites,
+                missing)
+            self._event(round_idx, "fed_quorum", sites_missing=missing)
+        self._record({"round": round_idx, "train_loss": loss,
+                      "sites_reported": len(received),
+                      "fed_status": status})
+        return status
+
+    # -- buffered async (FedBuff) ----------------------------------------
+    def _np_global(self) -> Any:
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32), self.global_params)
+
+    def _dispatch_train(self, site: int, version: int) -> None:
+        msg = Message(protocol.MSG_FED_TRAIN, 0, site)
+        msg.add("version", int(version))
+        msg.add("mode", "buffered")
+        msg.add_tensor("params", self.global_params)
+        msg.add_tensor(
+            "client_ids", self.partition[site - 1].astype(np.int32))
+        self._send(msg)
+
+    def _entry(self, msg: Message) -> Tuple[int, int, Any, float, float]:
+        return (int(msg.get("site")), int(msg.get("version")),
+                wire.decode_update(msg), float(msg.get("n_sum")),
+                float(msg.get("train_loss")))
+
+    def _flush(self, members: List[Tuple[int, int, Any, float, float]],
+               flush_idx: int, depth: int, quorum: bool = False) -> None:
+        """Apply one buffer of deltas: staleness-discounted weights
+        ``n_i / sqrt(1 + tau_i)`` normalized over the members, summed in
+        member (arrival) order — all float32 numpy, so a replayed flush
+        with the same members in the same order is bit-identical."""
+        import jax
+        import jax.numpy as jnp
+
+        taus = [self.version - base for _, base, _, _, _ in members]
+        for t in taus:
+            self.staleness_hist[t] = self.staleness_hist.get(t, 0) + 1
+        raw = []
+        for (_, _, _, n_sum, _), tau in zip(members, taus):
+            raw.append(np.float32(n_sum) /
+                       np.float32(np.sqrt(np.float32(1.0 + tau))))
+        wsum = np.float32(0.0)
+        for w in raw:
+            wsum = np.float32(wsum + w)
+        wnorm = [np.float32(w / wsum) for w in raw]
+        g = self._np_global()
+        leaves, treedef = jax.tree_util.tree_flatten(g)
+        deltas = [jax.tree_util.tree_flatten(d)[0]
+                  for _, _, d, _, _ in members]
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            out = leaf.copy()
+            for w, dl in zip(wnorm, deltas):
+                out += w * np.asarray(dl[i], np.float32)
+            new_leaves.append(out)
+        self.global_params = jax.tree_util.tree_map(
+            jnp.asarray, jax.tree_util.tree_unflatten(treedef, new_leaves))
+        self.version += 1
+        losses = [loss for _, _, _, _, loss in members]
+        mean_loss = float(np.mean(np.asarray(losses, np.float32)))
+        member_ids = [[site, base] for site, base, _, _, _ in members]
+        self.trace["flushes"].append(
+            {"version": self.version, "members": member_ids})
+        self._event(flush_idx, "fed_flush", members=member_ids,
+                    buffer_depth=depth, quorum=quorum)
+        self._record({"round": flush_idx, "train_loss": mean_loss,
+                      "fed_version": self.version,
+                      "fed_buffer_depth": depth,
+                      "fed_staleness_max": int(max(taus)),
+                      "fed_staleness_mean": float(np.mean(taus)),
+                      "fed_quorum_flush": bool(quorum),
+                      "fed_stale_drops": self.stale_drops})
+
+    def run_buffered(self) -> None:
+        for k in range(1, self.n_sites + 1):
+            self._dispatch_train(k, 0)
+        buffer: List[Tuple[int, int, Any, float, float]] = []
+        flushes = 0
+        while flushes < self.rounds:
+            try:
+                msg = self._updates.get(timeout=self.timeout_s)
+            except queue.Empty:
+                if buffer:
+                    # degrade: flush what arrived rather than stall the
+                    # federation on a dead/straggling site
+                    members, buffer = buffer, []
+                    self._flush(members, flushes, len(members),
+                                quorum=True)
+                    flushes += 1
+                    for site, _, _, _, _ in members:
+                        self._dispatch_train(site, self.version)
+                    continue
+                raise RuntimeError(
+                    f"buffered federation stalled: no update within "
+                    f"{self.timeout_s}s and the buffer is empty")
+            site, base, delta, n_sum, loss = self._entry(msg)
+            tau = self.version - base
+            if tau > self.staleness_bound:
+                self.stale_drops += 1
+                self._event(flushes, "fed_stale_drop", site=site,
+                            base_version=base, staleness=tau)
+                self._dispatch_train(site, self.version)
+                continue
+            buffer.append((site, base, delta, n_sum, loss))
+            if len(buffer) >= self.buffer_k:
+                members, buffer = buffer[:self.buffer_k], \
+                    buffer[self.buffer_k:]
+                self._flush(members, flushes,
+                            len(members) + len(buffer))
+                flushes += 1
+                for site, _, _, _, _ in members:
+                    self._dispatch_train(site, self.version)
+
+    # -- deterministic replay --------------------------------------------
+    def _replay_dispatch(self, version: int,
+                         remaining: List[List[List[int]]]) -> None:
+        """Dispatch TRAIN@version to every site the trace says will
+        contribute a delta with this base version — the only dispatches
+        whose results the replay will consume."""
+        sites = sorted({s for flush in remaining for s, b in flush
+                        if b == version})
+        for s in sites:
+            self._dispatch_train(s, version)
+
+    def run_buffered_replay(self) -> None:
+        trace = self.replay_trace
+        flushes = trace.get("flushes", [])
+        if int(trace.get("sites", self.n_sites)) != self.n_sites:
+            raise ValueError(
+                f"trace was recorded with {trace.get('sites')} sites, "
+                f"this federation has {self.n_sites}")
+        # record mode dispatches TRAIN@0 to every site at start; the
+        # deltas a replay consumes are the traced subset
+        for k in range(1, self.n_sites + 1):
+            self._dispatch_train(k, 0)
+        pool: Dict[Tuple[int, int], Tuple[int, int, Any, float, float]] \
+            = {}
+        for flush_idx, flush in enumerate(flushes):
+            need = [(int(s), int(b)) for s, b in flush["members"]]
+            while not all(k in pool for k in need):
+                try:
+                    msg = self._updates.get(timeout=self.timeout_s)
+                except queue.Empty:
+                    waiting = [k for k in need if k not in pool]
+                    raise RuntimeError(
+                        f"trace replay stalled waiting for deltas "
+                        f"{waiting} (flush {flush_idx})") from None
+                entry = self._entry(msg)
+                pool.setdefault((entry[0], entry[1]), entry)
+            members = [pool[k] for k in need]
+            self._flush(members, flush_idx, len(members))
+            rest = [f["members"] for f in flushes[flush_idx + 1:]]
+            self._replay_dispatch(self.version, rest)

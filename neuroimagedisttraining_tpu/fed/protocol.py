@@ -1,0 +1,101 @@
+"""Federation wire protocol: message types, retry/backoff on send, and
+the deterministic key/partition derivations both ends must agree on.
+
+Star topology, aggregator = rank 0, sites = ranks 1..N (the cross-silo
+scheme of ``comm/cross_silo.py``, extended with versioned dispatch so
+the buffered-async policy can tag every delta with the global-model
+version it was computed against).
+
+Messages (all via ``comm/message.py``'s binary pytree framing):
+
+* ``fed_train`` (aggregator -> site): global params + ``version`` +
+  ``mode``; sync rounds add the round key, the site's client ids, their
+  slot positions and the cohort size so the site reproduces exactly its
+  slice of the in-process round program.
+* ``fed_update`` (site -> aggregator): sync — the trained local models
+  (dense rows, the bit-parity path); buffered — the site's weighted
+  local delta in a ``fed/wire.py`` format, tagged with the base
+  ``version`` it trained from.
+* ``fed_finish`` (aggregator -> site): drain and exit.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List
+
+import numpy as np
+
+from ..comm.message import Message
+
+logger = logging.getLogger(__name__)
+
+MSG_FED_TRAIN = "fed_train"
+MSG_FED_UPDATE = "fed_update"
+MSG_FED_FINISH = "fed_finish"
+
+#: PRNG domain separator for the buffered policy's per-site key chain
+#: ("fed" in ascii) — the same fold-in idiom as robust.faults.FAULT_SALT,
+#: a different constant so fault draws and training keys never collide.
+FED_SALT = 0x666564
+
+
+def site_round_key(seed: int, version: int, site_rank: int):
+    """Buffered-async training key for (site, global-model version).
+
+    A pure function of ``(run seed, version, site rank)`` — nothing
+    about arrival order, wall clock, or process identity — so a site's
+    delta is reproducible from its TRAIN message alone and a recorded
+    arrival trace replays bit-for-bit (``fed/aggregator.py``).
+    """
+    import jax
+
+    k = jax.random.fold_in(jax.random.PRNGKey(int(seed)), FED_SALT)
+    k = jax.random.fold_in(k, int(version))
+    return jax.random.fold_in(k, int(site_rank))
+
+
+def partition_slots(n_items: int, n_sites: int) -> List[np.ndarray]:
+    """Contiguous order-preserving split of ``arange(n_items)`` into
+    ``n_sites`` blocks (site k, 1-based, owns block k-1).
+
+    Contiguity is load-bearing for the sync barrier: concatenating the
+    sites' reply rows in rank order reassembles the cohort in exact
+    slot order, so the aggregate runs over the same [S] stacking as the
+    in-process round body.
+    """
+    if n_sites < 1:
+        raise ValueError(f"n_sites must be >= 1, got {n_sites}")
+    return np.array_split(np.arange(int(n_items)), int(n_sites))
+
+
+def send_with_retry(manager: Any, msg: Message, retries: int = 2,
+                    backoff_s: float = 0.05) -> None:
+    """``send_message`` with bounded retry + exponential backoff.
+
+    Transient transport failures (``OSError`` from the native TCP
+    backend, ``ConnectionError`` from a draining inbox) are retried up
+    to ``retries`` times with ``backoff_s * 2**attempt`` sleeps; each
+    re-issue bumps the manager's ``CommCounters.messages_retried`` so
+    degradation is visible in the obs fold. Anything still failing
+    after the budget propagates — a dead peer is the caller's quorum
+    logic's problem, not this function's.
+    """
+    comm = getattr(manager, "comm", manager)
+    attempt = 0
+    while True:
+        try:
+            manager.send_message(msg)
+            return
+        except OSError as e:  # ConnectionError is an OSError subclass
+            if attempt >= retries:
+                raise
+            counters = getattr(comm, "counters", None)
+            if counters is not None:
+                counters.note_retry()
+            delay = backoff_s * (2 ** attempt)
+            logger.warning(
+                "send %s -> rank %s failed (%s); retry %d/%d in %.3fs",
+                msg.type, msg.receiver_id, e, attempt + 1, retries, delay)
+            time.sleep(delay)
+            attempt += 1

@@ -1,0 +1,398 @@
+"""Federation runtime: role dispatch, the loopback harness, refusals,
+and the per-site observability fold.
+
+``run_federated(args, algo_name)`` is the ``--fed_role`` entry the
+runner dispatches to (``experiments/runner.py run_experiment``). Three
+shapes of run:
+
+* ``--fed_backend local`` — the single-process loopback: one
+  ``LocalRouter``, sites on receive-pump threads sharing one built
+  algorithm, the aggregator in the calling thread. This is the test
+  and CI shape (``scripts/fed_smoke.py``) and the sync bit-parity
+  anchor.
+* ``--fed_backend tcp --fed_role aggregator`` — rank 0 of a real
+  multi-process federation over the native TCP transport.
+* ``--fed_backend tcp --fed_role site --fed_site_rank k`` — site
+  process k (forked by ``scripts/run_federation.py``).
+
+Every process writes its own JSONL round/event streams into the fed
+output directory; the aggregator folds them into ``federation.jsonl``
+/ ``federation.events.jsonl`` with ``obs.export.merge_host_jsonl`` /
+``merge_host_events`` — the multihost fold, reused verbatim (events
+fold with ``dedupe=False``: the same event type in the same round on
+two SITES is two events, not a rerun duplicate).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..robust.faults import FaultSpec, parse_fault_spec
+from . import wire
+from .aggregator import FedAggregator
+from .site import SiteWorker
+from .trainer import SiteTrainer
+
+logger = logging.getLogger(__name__)
+
+#: default real-process sleep for a site whose straggle fault fires
+DEFAULT_STRAGGLE_S = 2.0
+
+
+def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
+    """``"rank:fault_spec[:delay_s];..."`` -> {site_rank: (FaultSpec,
+    straggle_sleep_s)}.
+
+    The fault grammar is ``robust.faults.parse_fault_spec``'s
+    (``drop=p,straggle=p,...``); the optional trailing ``:delay_s``
+    sets how long a fired straggle sleeps the REAL site process
+    (default ``DEFAULT_STRAGGLE_S``). Example:
+    ``"3:straggle=1.0:6.0"`` — site 3 always straggles, 6s per round.
+    Raises ``ValueError`` on malformed entries (parse-time validation,
+    the derive() contract)."""
+    out: Dict[int, Tuple[FaultSpec, float]] = {}
+    if not spec:
+        return out
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        rank_s, sep, rest = entry.partition(":")
+        if not sep or not rest:
+            raise ValueError(
+                f"fed_site_faults entry {entry!r} is not "
+                "rank:fault_spec[:delay_s]")
+        try:
+            rank = int(rank_s)
+        except ValueError:
+            raise ValueError(
+                f"fed_site_faults rank {rank_s!r} is not an int") from None
+        if rank < 1:
+            raise ValueError(
+                f"fed_site_faults rank {rank} must be >= 1 (site ranks)")
+        delay = DEFAULT_STRAGGLE_S
+        head, sep2, tail = rest.rpartition(":")
+        if sep2 and "=" not in tail:
+            try:
+                delay = float(tail)
+            except ValueError:
+                raise ValueError(
+                    f"fed_site_faults trailing field {tail!r} is neither "
+                    "a fault clause nor a delay") from None
+            rest = head
+        fs = parse_fault_spec(rest)
+        if fs is None:
+            raise ValueError(
+                f"fed_site_faults entry {entry!r} has an empty fault spec")
+        if rank in out:
+            raise ValueError(f"duplicate fed_site_faults rank {rank}")
+        out[rank] = (fs, delay)
+    return out
+
+
+def parse_endpoints(spec: str, world_size: int
+                    ) -> List[Tuple[str, int]]:
+    """``"host:port,host:port,..."`` rank-ordered (rank 0 = aggregator)."""
+    eps = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep:
+            raise ValueError(
+                f"fed_endpoints entry {part!r} is not host:port")
+        eps.append((host, int(port)))
+    if len(eps) != world_size:
+        raise ValueError(
+            f"fed_endpoints has {len(eps)} entries, need "
+            f"{world_size} (aggregator + {world_size - 1} sites)")
+    return eps
+
+
+def _refuse(why: str) -> None:
+    raise SystemExit(f"federated deployment: {why}")
+
+
+def validate_fed_args(args, algo_name: str) -> None:
+    """The fed-mode refusal cluster (the runner's SystemExit idiom):
+    every in-process feature whose semantics a multi-process federation
+    does not (yet) reproduce refuses loudly instead of silently
+    diverging from the simulation."""
+    if algo_name != "fedavg":
+        _refuse(f"algo {algo_name!r} unsupported — the federation "
+                "ships FedAvg's round body; run --algo fedavg")
+    n_sites = int(getattr(args, "fed_sites", 0))
+    if n_sites < 1:
+        _refuse("--fed_sites must be >= 1")
+    mode = getattr(args, "fed_mode", "")
+    if mode not in ("sync", "buffered"):
+        _refuse(f"unknown --fed_mode {mode!r}")
+    if getattr(args, "fuse_rounds", 1) > 1:
+        _refuse("--fuse_rounds > 1 fuses rounds into one device program;"
+                " a federation advances the model over a wire per round")
+    if getattr(args, "watchdog", None):
+        _refuse("--watchdog rollback-retry drives the in-process round "
+                "loop; the federation's degradation is quorum/staleness")
+    if getattr(args, "client_store", "device") != "device":
+        _refuse("--client_store host/disk residency is an in-process "
+                "optimization; each site already holds only its clients")
+    if getattr(args, "multihost", False):
+        _refuse("--multihost (one model, many hosts, XLA collectives) "
+                "and --fed_role (many models, message passing) are "
+                "different distribution axes; pick one")
+    if getattr(args, "defense_type", "none") not in ("", "none"):
+        _refuse("robust defenses transform the [S]-stacked cohort "
+                "inside one program; the aggregator only sees deltas")
+    if getattr(args, "fault_spec", ""):
+        _refuse("--fault_spec injects simulated in-jit faults; use "
+                "--fed_site_faults to fault REAL site processes")
+    if getattr(args, "eval_cache", 0):
+        _refuse("--eval_cache rides in-process round state")
+    if getattr(args, "checkpoint_dir", ""):
+        _refuse("--checkpoint_dir round-granular checkpointing is not "
+                "wired into the federation lifecycle yet")
+    if getattr(args, "mesh_space", 1) > 1:
+        _refuse("--mesh_space > 1 shards one simulation over a mesh")
+    impl = getattr(args, "agg_impl", "dense")
+    if mode == "sync":
+        if impl != "dense":
+            _refuse("sync federation ships full params dense — the "
+                    "bit-parity anchor; compressed delta wires "
+                    f"(--agg_impl {impl}) ride --fed_mode buffered")
+        # the cohort-must-cover-sites check runs after build (needs C)
+    else:
+        if impl not in wire.WIRE_IMPLS:
+            _refuse(f"--agg_impl {impl!r} has no federation wire codec "
+                    f"(supported: {wire.WIRE_IMPLS})")
+        if abs(getattr(args, "frac", 1.0) - 1.0) > 1e-9:
+            _refuse("buffered federation trains each site's full client "
+                    "block every dispatch; --frac sampling is a sync-"
+                    "mode concept")
+        if not 1 <= int(getattr(args, "fed_buffer_k", 0)) <= n_sites:
+            _refuse(f"--fed_buffer_k must be in [1, fed_sites="
+                    f"{n_sites}]")
+        if int(getattr(args, "fed_staleness_bound", 0)) < 0:
+            _refuse("--fed_staleness_bound must be >= 0")
+    if getattr(args, "fed_replay", "") and mode != "buffered":
+        _refuse("--fed_replay replays a buffered arrival trace; sync "
+                "rounds are already deterministic")
+    faults = parse_site_faults(getattr(args, "fed_site_faults", ""))
+    for rank in faults:
+        if rank > n_sites:
+            _refuse(f"--fed_site_faults names site {rank} but there are "
+                    f"only {n_sites} sites")
+
+
+def _out_dir(args, identity: str) -> str:
+    d = getattr(args, "fed_out", "") or os.path.join(
+        getattr(args, "results_dir", "results"), "fed", identity)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _site_paths(out_dir: str, rank: int) -> Tuple[str, str]:
+    return (os.path.join(out_dir, f"site{rank}.jsonl"),
+            os.path.join(out_dir, f"site{rank}.events.jsonl"))
+
+
+def _make_worker(args, comm, rank: int, world: int,
+                 trainer: SiteTrainer, out_dir: str) -> SiteWorker:
+    faults = parse_site_faults(getattr(args, "fed_site_faults", ""))
+    fs, delay = faults.get(rank, (None, 0.0))
+    log_path, events_path = _site_paths(out_dir, rank)
+    return SiteWorker(
+        comm, rank, world, trainer, seed=args.seed,
+        wire_impl=getattr(args, "agg_impl", "dense"),
+        wire_density=getattr(args, "agg_topk_density", 0.1),
+        fault_spec=fs, straggle_s=delay,
+        retries=args.fed_retries, backoff_s=args.fed_backoff_s,
+        log_path=log_path, events_path=events_path)
+
+
+def _make_aggregator(args, comm, world: int, algo,
+                     out_dir: str) -> FedAggregator:
+    replay = None
+    if getattr(args, "fed_replay", ""):
+        with open(args.fed_replay) as f:
+            replay = json.load(f)
+    return FedAggregator(
+        comm, world, algo, mode=args.fed_mode, rounds=args.comm_round,
+        seed=args.seed, buffer_k=args.fed_buffer_k,
+        staleness_bound=args.fed_staleness_bound,
+        timeout_s=args.fed_timeout_s, retries=args.fed_retries,
+        backoff_s=args.fed_backoff_s,
+        wire_impl=getattr(args, "agg_impl", "dense"),
+        wire_density=getattr(args, "agg_topk_density", 0.1),
+        replay_trace=replay,
+        log_path=os.path.join(out_dir, "aggregator.jsonl"),
+        events_path=os.path.join(out_dir, "aggregator.events.jsonl"))
+
+
+def _fold_obs(out_dir: str, n_sites: int) -> Dict[str, str]:
+    """Fold the aggregator's + every site's streams into one timeline
+    (host 0 = aggregator, host k = site k — the merge functions' host
+    tagging is positional, which matches the rank numbering)."""
+    from ..obs.export import merge_host_events, merge_host_jsonl
+
+    paths = {"federation_jsonl": "", "federation_events": ""}
+    rounds = [os.path.join(out_dir, "aggregator.jsonl")] + \
+        [_site_paths(out_dir, k)[0] for k in range(1, n_sites + 1)]
+    rounds = [p for p in rounds if os.path.exists(p)]
+    if rounds:
+        merged = merge_host_jsonl(rounds)
+        dst = os.path.join(out_dir, "federation.jsonl")
+        with open(dst, "w") as f:
+            for rec in merged:
+                f.write(json.dumps(rec) + "\n")
+        paths["federation_jsonl"] = dst
+    events = [os.path.join(out_dir, "aggregator.events.jsonl")] + \
+        [_site_paths(out_dir, k)[1] for k in range(1, n_sites + 1)]
+    events = [p for p in events if os.path.exists(p)]
+    if events:
+        # dedupe=False: (round, event_type) collides across SITES by
+        # design — they are distinct events, not rerun duplicates
+        merged = merge_host_events(events, dedupe=False)
+        dst = os.path.join(out_dir, "federation.events.jsonl")
+        with open(dst, "w") as f:
+            for rec in merged:
+                f.write(json.dumps(rec) + "\n")
+        paths["federation_events"] = dst
+    return paths
+
+
+def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
+                       out_dir: str) -> Dict[str, Any]:
+    import jax
+
+    trace_path = ""
+    if agg.mode == "buffered" and agg.replay_trace is None:
+        trace_path = getattr(args, "fed_trace", "") or \
+            os.path.join(out_dir, "trace.json")
+        with open(trace_path, "w") as f:
+            json.dump(agg.trace, f, indent=1)
+    d = algo.data
+    ev = algo._eval_global(agg.global_params, d.x_test, d.y_test,
+                           d.n_test)
+    final_eval = {"global_acc": float(ev["acc"]),
+                  "global_loss": float(ev["loss"])}
+    fold = _fold_obs(out_dir, agg.n_sites)
+    fed = {
+        "mode": agg.mode, "sites": agg.n_sites,
+        "version": agg.version, "stale_drops": agg.stale_drops,
+        "staleness_hist": {str(k): v for k, v in
+                           sorted(agg.staleness_hist.items())},
+        "trace_path": trace_path, "out_dir": out_dir,
+        "replayed": agg.replay_trace is not None,
+        **fold, **agg.comm.counters.snapshot(),
+    }
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump({"identity": identity, "final_eval": final_eval,
+                   "rounds": len([r for r in agg.history
+                                  if r.get("round", -1) >= 0]),
+                   "fed": fed}, f, indent=1)
+    return {
+        "identity": identity, "history": agg.history,
+        "final_eval": final_eval, "stat_path": out_dir, "state": None,
+        "global_params": jax.tree_util.tree_map(
+            np.asarray, agg.global_params),
+        "fed": fed,
+    }
+
+
+def _run_loopback(args, algo_name: str, identity: str,
+                  out_dir: str) -> Dict[str, Any]:
+    from ..comm.local import LocalRouter
+    from ..experiments.runner import build_algorithm
+
+    algo, _ = build_algorithm(args, algo_name)
+    if args.fed_mode == "sync" and \
+            algo.clients_per_round < args.fed_sites:
+        _refuse(f"sync cohort of {algo.clients_per_round} clients "
+                f"cannot cover {args.fed_sites} sites")
+    world = args.fed_sites + 1
+    router = LocalRouter(world)
+    trainer = SiteTrainer(algo)
+    workers = []
+    for k in range(1, world):
+        w = _make_worker(args, router.manager(k), k, world, trainer,
+                         out_dir)
+        w.run(background=True)
+        workers.append(w)
+    agg = _make_aggregator(args, router.manager(0), world, algo,
+                           out_dir)
+    agg.run(background=True)
+    try:
+        agg.execute()
+    finally:
+        for w in workers:
+            # a deliberately-straggling site may still be asleep in its
+            # handler; bounded wait, daemon pumps die with the process
+            w.done.wait(timeout=2.0)
+            w.finish()
+        agg.finish()
+    return _finish_aggregator(args, agg, algo, identity, out_dir)
+
+
+def _run_tcp(args, algo_name: str, identity: str,
+             out_dir: str) -> Dict[str, Any]:
+    from ..comm.tcp import TcpCommManager
+    from ..experiments.runner import build_algorithm
+
+    world = args.fed_sites + 1
+    endpoints = parse_endpoints(args.fed_endpoints, world)
+    algo, _ = build_algorithm(args, algo_name)
+    if args.fed_role == "aggregator":
+        if args.fed_mode == "sync" and \
+                algo.clients_per_round < args.fed_sites:
+            _refuse(f"sync cohort of {algo.clients_per_round} clients "
+                    f"cannot cover {args.fed_sites} sites")
+        agg = _make_aggregator(
+            args, TcpCommManager(0, endpoints), world, algo, out_dir)
+        agg.run(background=True)
+        try:
+            agg.execute()
+        finally:
+            agg.finish()
+        return _finish_aggregator(args, agg, algo, identity, out_dir)
+    rank = int(getattr(args, "fed_site_rank", 0))
+    if not 1 <= rank <= args.fed_sites:
+        _refuse(f"--fed_site_rank {rank} outside [1, fed_sites="
+                f"{args.fed_sites}]")
+    trainer = SiteTrainer(algo)
+    worker = _make_worker(args, TcpCommManager(rank, endpoints), rank,
+                          world, trainer, out_dir)
+    worker.run(background=True)
+    worker.done.wait()
+    worker.finish()
+    return {"identity": identity, "history": [], "final_eval": {},
+            "stat_path": out_dir, "state": None,
+            "fed": {"role": "site", "rank": rank,
+                    "rounds_trained": worker.rounds_trained,
+                    **worker.comm.counters.snapshot()}}
+
+
+def run_federated(args, algo_name: str) -> Dict[str, Any]:
+    """The ``--fed_role`` entry point: validate, build, run the role."""
+    validate_fed_args(args, algo_name)
+    from ..experiments.config import run_identity
+
+    identity = run_identity(args, algo_name)
+    out_dir = _out_dir(args, identity)
+    backend = getattr(args, "fed_backend", "local")
+    logger.info("federation: role=%s backend=%s mode=%s sites=%d -> %s",
+                args.fed_role, backend, args.fed_mode, args.fed_sites,
+                out_dir)
+    if backend == "local":
+        if args.fed_role == "site":
+            _refuse("--fed_backend local runs sites as in-process "
+                    "threads; --fed_role site needs a real transport "
+                    "(tcp)")
+        return _run_loopback(args, algo_name, identity, out_dir)
+    if backend == "tcp":
+        return _run_tcp(args, algo_name, identity, out_dir)
+    _refuse(f"unknown --fed_backend {backend!r} (local|tcp)")

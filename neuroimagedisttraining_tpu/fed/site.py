@@ -1,0 +1,154 @@
+"""SiteWorker: one federation site process (or loopback thread).
+
+Reacts to the aggregator's ``fed_train`` dispatches — sync rounds train
+the slice of the cohort named in the message, buffered rounds train all
+of the site's own clients from the shipped base model — and replies
+with ``fed_update`` via ``send_with_retry``. Per-site fault specs
+(``--fed_site_faults``) turn the chaos harness end-to-end: a
+``straggle`` draw here sleeps a REAL process before replying and a
+``drop`` draw withholds the reply entirely, exercising the
+aggregator's staleness/quorum machinery over an actual wire instead of
+a simulated slot. Draws reuse ``robust.faults.fault_trace_round`` keyed
+by ``(seed, version, site_rank)`` — deterministic, analyzable offline.
+
+Each site writes its own JSONL round + event streams; the runtime
+folds them with the aggregator's via ``obs.export.merge_host_jsonl`` /
+``merge_host_events`` (the multihost fold, reused verbatim).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..comm.manager import ClientManager
+from ..comm.message import Message
+from ..obs.export import RoundLogWriter
+from ..robust.faults import FaultSpec, fault_trace_round
+from . import protocol, wire
+from .trainer import SiteTrainer
+
+logger = logging.getLogger(__name__)
+
+
+class SiteWorker(ClientManager):
+    """Rank >= 1 site manager.
+
+    ``fault_spec``/``straggle_s``: this site's process-level fault
+    model (None = healthy). ``wire_impl``/``wire_density``: the delta
+    codec for buffered replies (``fed/wire.py``; sync replies are
+    always dense rows — the bit-parity contract).
+    """
+
+    def __init__(self, comm, rank: int, world_size: int,
+                 trainer: SiteTrainer, seed: int,
+                 wire_impl: str = "dense", wire_density: float = 0.1,
+                 fault_spec: Optional[FaultSpec] = None,
+                 straggle_s: float = 0.0, retries: int = 2,
+                 backoff_s: float = 0.05, log_path: str = "",
+                 events_path: str = ""):
+        super().__init__(comm, rank=rank, world_size=world_size)
+        self.trainer = trainer
+        self.seed = int(seed)
+        self.wire_impl = wire_impl
+        self.wire_density = wire_density
+        self.fault_spec = fault_spec
+        self.straggle_s = float(straggle_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.writer = RoundLogWriter(log_path, force=True) \
+            if log_path else None
+        self.events = RoundLogWriter(events_path, force=True) \
+            if events_path else None
+        self.done = threading.Event()
+        self.rounds_trained = 0
+        self.register_message_receive_handler(
+            protocol.MSG_FED_TRAIN, self._on_train)
+        self.register_message_receive_handler(
+            protocol.MSG_FED_FINISH, self._on_finish)
+
+    # -- fault model ------------------------------------------------------
+    def _draw_faults(self, version: int):
+        if self.fault_spec is None or not self.fault_spec.any_active:
+            return False, False
+        tr = fault_trace_round(self.fault_spec, self.seed, version,
+                               np.asarray([self.rank]))
+        return bool(tr["straggled"][0]), bool(tr["dropped"][0])
+
+    def _event(self, version: int, event_type: str, **extra) -> None:
+        if self.events is not None:
+            self.events.write({"round": int(version),
+                               "event_type": event_type,
+                               "site": self.rank, **extra})
+
+    # -- protocol ---------------------------------------------------------
+    def _on_train(self, msg: Message) -> None:
+        version = int(msg.get("version"))
+        mode = msg.get("mode")
+        t0 = time.perf_counter()
+        straggled, dropped = self._draw_faults(version)
+        if straggled and self.straggle_s > 0:
+            # a REAL straggling process: the aggregator's round clock
+            # (sync timeout / buffered staleness bound) sees this delay
+            self._event(version, "fed_site_straggle",
+                        sleep_s=self.straggle_s)
+            time.sleep(self.straggle_s)
+        if dropped:
+            # withhold the reply entirely — site death for this round;
+            # the aggregator degrades to quorum / flushes without us
+            self._event(version, "fed_site_drop")
+            return
+        import jax
+        import jax.numpy as jnp
+
+        params = jax.tree_util.tree_map(
+            jnp.asarray, msg.get_tensor("params"))
+        client_ids = np.asarray(msg.get_tensor("client_ids"))
+        reply = Message(protocol.MSG_FED_UPDATE, self.rank, 0)
+        reply.add("version", version)
+        reply.add("site", self.rank)
+        reply.add("mode", mode)
+        if mode == "sync":
+            slot_pos = np.asarray(msg.get_tensor("slot_pos"))
+            rows, losses = self.trainer.train_sync(
+                params, msg.get_tensor("round_key"), version,
+                client_ids, slot_pos, int(msg.get("cohort_size")))
+            reply.add_tensor("rows", rows)
+            reply.add_tensor("losses", losses)
+            loss = float(np.mean(losses)) if losses.size else float("nan")
+            n_sum = float(np.sum(
+                np.asarray(self.trainer.algo.data.n_train)[client_ids]))
+        else:  # buffered
+            base_key = protocol.site_round_key(
+                self.seed, version, self.rank)
+            delta, n_sum, loss = self.trainer.train_delta(
+                params, base_key, version, client_ids)
+            wire.encode_update(reply, delta, self.wire_impl,
+                               density=self.wire_density)
+            reply.add("n_sum", n_sum)
+            reply.add("train_loss", loss)
+        protocol.send_with_retry(self, reply, retries=self.retries,
+                                 backoff_s=self.backoff_s)
+        self.rounds_trained += 1
+        if self.writer is not None:
+            self.writer.write({
+                "round": version, "site": self.rank, "mode": mode,
+                "train_loss": loss, "n_sum": n_sum,
+                "clients": int(client_ids.size),
+                "wall_s": time.perf_counter() - t0,
+                "fed_straggled": straggled,
+            })
+
+    def _on_finish(self, msg: Message) -> None:
+        if self.writer is not None:
+            self.writer.write({"round": -1, "site": self.rank,
+                               "rounds_trained": self.rounds_trained,
+                               **self.comm.counters.snapshot()})
+            self.writer.close()
+        if self.events is not None:
+            self.events.close()
+        self.done.set()
+        self.comm.stop_receive_message()

@@ -1,0 +1,132 @@
+"""SiteTrainer: the local-training half of the fused round body, split
+out so a site process can train its own clients and ship results.
+
+The in-process simulation runs broadcast -> vmapped local SGD ->
+weighted aggregate as ONE jitted program
+(``algorithms/base.py _train_selected_weighted``). A federation cuts
+that program at the aggregation boundary: each site runs the broadcast
++ vmap half over ITS clients only, and the aggregator owns the
+weighted sum. Bit-parity with the fused program rests on two pinned
+invariants of this codebase:
+
+* width polymorphism — the vmapped ``client_update`` produces
+  bit-identical rows at any batch width (the ``client_chunk`` /
+  client-store parity tests), so a site vmapping s rows matches the
+  corresponding rows of the S-wide in-process vmap;
+* key slotting — sync sites compute the FULL ``split(round_key, S+1)``
+  and take their slot positions, so every client consumes exactly the
+  key it would have in-process (``keys[S]`` stays the aggregator-side
+  defense key, unused here).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.state import broadcast_tree, weighted_tree_sum, zeros_like_tree
+
+
+class SiteTrainer:
+    """Jitted site-local round programs over an algorithm's
+    ``client_update`` and data shards. One instance per site process
+    (shared across site threads on the loopback backend — jit execution
+    is thread-safe and the programs are cached per cohort width)."""
+
+    def __init__(self, algo: Any):
+        self.algo = algo
+        self._sync_cache: Dict[int, Any] = {}
+        self._delta_jit = jax.jit(self._delta_body)
+
+    # -- sync: the bit-parity path ---------------------------------------
+    def _sync_fn(self, cohort_size: int):
+        """Per-cohort-size jitted body (S is static: it sizes the key
+        split exactly as the in-process round body does)."""
+        fn = self._sync_cache.get(cohort_size)
+        if fn is None:
+            algo = self.algo
+
+            def body(global_params, round_key, client_ids, slot_pos,
+                     round_idx, x_train, y_train, n_train):
+                s = client_ids.shape[0]
+                x_sel = jnp.take(x_train, client_ids, axis=0)
+                y_sel = jnp.take(y_train, client_ids, axis=0)
+                n_sel = jnp.take(n_train, client_ids)
+                params0 = broadcast_tree(global_params, s)
+                mask_b = broadcast_tree(global_params, s)
+                mom0 = zeros_like_tree(params0)
+                # the FULL in-process key fan-out, then this site's slots
+                keys = jnp.take(
+                    jax.random.split(round_key, cohort_size + 1)[
+                        :cohort_size],
+                    slot_pos, axis=0)
+                params_out, _, losses = algo._vmap_clients(
+                    algo.client_update,
+                    in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0),
+                )(params0, mom0, mask_b, keys, x_sel, y_sel, n_sel,
+                  round_idx, params0)
+                return params_out, losses
+
+            fn = jax.jit(body)
+            self._sync_cache[cohort_size] = fn
+        return fn
+
+    def train_sync(self, global_params: Any, round_key: Any,
+                   round_idx: int, client_ids: np.ndarray,
+                   slot_pos: np.ndarray, cohort_size: int
+                   ) -> Tuple[Any, np.ndarray]:
+        """Train this site's slice of a synchronous round: returns the
+        [s]-stacked locally-trained models and their [s] losses, as
+        host numpy (bit-preserving device -> host copy)."""
+        d = self.algo.data
+        rows, losses = self._sync_fn(int(cohort_size))(
+            global_params, jnp.asarray(round_key),
+            jnp.asarray(client_ids, jnp.int32),
+            jnp.asarray(slot_pos, jnp.int32),
+            jnp.asarray(round_idx, jnp.float32),
+            d.x_train, d.y_train, d.n_train)
+        return (jax.tree_util.tree_map(np.asarray, rows),
+                np.asarray(losses))
+
+    # -- buffered: delta extraction --------------------------------------
+    def _delta_body(self, global_params, base_key, client_ids, round_idx,
+                    x_train, y_train, n_train):
+        s = client_ids.shape[0]
+        x_sel = jnp.take(x_train, client_ids, axis=0)
+        y_sel = jnp.take(y_train, client_ids, axis=0)
+        n_sel = jnp.take(n_train, client_ids)
+        params0 = broadcast_tree(global_params, s)
+        mask_b = broadcast_tree(global_params, s)
+        mom0 = zeros_like_tree(params0)
+        keys = jax.random.split(base_key, s)
+        params_out, _, losses = self.algo._vmap_clients(
+            self.algo.client_update,
+            in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0),
+        )(params0, mom0, mask_b, keys, x_sel, y_sel, n_sel,
+          round_idx, params0)
+        # the site's shipped update: sample-weighted mean of its
+        # clients' deltas (FedBuff's per-worker update), plus the
+        # weight mass it represents
+        w = n_sel.astype(jnp.float32)
+        w = w / jnp.maximum(jnp.sum(w), 1.0)
+        delta = weighted_tree_sum(
+            jax.tree_util.tree_map(
+                lambda po, p0: po - p0, params_out, params0), w)
+        return delta, jnp.sum(n_sel.astype(jnp.float32)), jnp.mean(losses)
+
+    def train_delta(self, global_params: Any, base_key: Any,
+                    version: int, client_ids: np.ndarray
+                    ) -> Tuple[Any, float, float]:
+        """Train ALL of this site's clients from ``global_params``
+        (the model at ``version``) and return
+        ``(delta_tree, n_sum, mean_loss)`` as host numpy."""
+        d = self.algo.data
+        delta, n_sum, loss = self._delta_jit(
+            global_params, jnp.asarray(base_key),
+            jnp.asarray(client_ids, jnp.int32),
+            jnp.asarray(version, jnp.float32),
+            d.x_train, d.y_train, d.n_train)
+        return (jax.tree_util.tree_map(np.asarray, delta),
+                float(n_sum), float(loss))

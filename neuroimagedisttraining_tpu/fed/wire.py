@@ -1,0 +1,140 @@
+"""Delta wire codecs: pytree -> Message tensors in the ``agg_impl``
+formats (dense / bf16 / int8 / topk), host-side and deterministic.
+
+The in-mesh aggregation wires (``parallel/collectives.py``) compress
+cross-chip transfers inside one XLA program; a federation ships the
+same formats over a REAL wire between processes. The codecs here are
+their host-side numpy twins — pure functions of the input tree, no
+RNG, no device state — so an encoded payload is reproducible and a
+recorded buffered-async run replays bit-for-bit.
+
+Contract (pinned by ``tests/test_fed_wire.py``): transport is
+bit-transparent — ``decode(wire(encode(tree)))`` equals
+``decode(encode(tree))`` exactly, over the local and tcp backends.
+The lossy impls (bf16/int8/topk) lose precision at ENCODE time, once;
+the wire never adds more.
+
+Top-k selection note: per-leaf magnitude selection with a stable
+argsort and ascending-index canonical order, sized by the shared
+``parallel.collectives.topk_count`` rounding rule — the same count the
+wire-cost model (``obs/comm.py``) prices.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..comm.message import Message
+from ..parallel.collectives import topk_count
+
+try:  # jax's own dtype-extension dependency; present wherever jax is
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - jax guarantees ml_dtypes
+    _BF16 = None
+
+WIRE_IMPLS = ("dense", "bf16", "int8", "topk")
+
+
+def _np_tree(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _q_int8(a: np.ndarray):
+    """Per-leaf symmetric int8 quantization: scale = max|a|/127 (1.0 for
+    an all-zero leaf so decode is exact zeros), round-half-even like the
+    in-mesh int8 wire's deterministic mode."""
+    a = np.asarray(a, np.float32)
+    m = np.float32(np.max(np.abs(a))) if a.size else np.float32(0.0)
+    scale = np.float32(m / np.float32(127.0)) if m > 0 else np.float32(1.0)
+    q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+    return q, np.asarray(scale, np.float32)
+
+
+def _topk_leaf(a: np.ndarray, density: float):
+    a = np.asarray(a, np.float32)
+    flat = a.ravel()
+    k = topk_count(flat.size, density)
+    # stable argsort on negated magnitude: deterministic tie-break by
+    # position; ascending-index canonical order for the shipped pairs
+    order = np.argsort(-np.abs(flat), kind="stable")[:k]
+    idx = np.sort(order).astype(np.int32)
+    return idx, flat[idx], np.asarray(a.shape, np.int64)
+
+
+def encode_update(msg: Message, tree: Any, impl: str, *,
+                  key: str = "delta", density: float = 0.1) -> None:
+    """Attach ``tree`` to ``msg`` under ``key`` in wire format ``impl``.
+
+    ``dense`` ships raw leaves (dtype-preserving — the sync barrier's
+    bit-parity path); the compressed impls cast/quantize/sparsify to
+    f32-decodable payloads. ``density`` is the topk fraction
+    (``--agg_topk_density``).
+    """
+    if impl not in WIRE_IMPLS:
+        raise ValueError(
+            f"unknown wire impl {impl!r} (one of {WIRE_IMPLS})")
+    msg.add(key + "_wire", impl)
+    tree = _np_tree(tree)
+    import jax
+
+    if impl == "dense":
+        msg.add_tensor(key, tree)
+    elif impl == "bf16":
+        if _BF16 is None:  # pragma: no cover
+            raise RuntimeError("bf16 wire needs ml_dtypes")
+        # ships as a uint16 view: the Message codec frames dtypes by
+        # numpy dtype string, and ml_dtypes' bfloat16 serializes as an
+        # opaque void type ('<V2') that would not survive decode
+        msg.add_tensor(key, jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32).astype(_BF16).view(
+                np.uint16), tree))
+    elif impl == "int8":
+        q = jax.tree_util.tree_map(lambda x: _q_int8(x)[0], tree)
+        s = jax.tree_util.tree_map(lambda x: _q_int8(x)[1], tree)
+        msg.add_tensor(key, {"q": q, "scale": s})
+    else:  # topk
+        idx = jax.tree_util.tree_map(
+            lambda x: _topk_leaf(x, density)[0], tree)
+        val = jax.tree_util.tree_map(
+            lambda x: _topk_leaf(x, density)[1], tree)
+        shp = jax.tree_util.tree_map(
+            lambda x: _topk_leaf(x, density)[2], tree)
+        msg.add_tensor(key, {"idx": idx, "val": val, "shape": shp})
+
+
+def _scatter_leaf(idx: np.ndarray, val: np.ndarray,
+                  shape: np.ndarray) -> np.ndarray:
+    shape = tuple(int(d) for d in np.asarray(shape).ravel())
+    size = int(np.prod(shape)) if shape else 1
+    out = np.zeros(size, np.float32)
+    out[np.asarray(idx)] = np.asarray(val, np.float32)
+    return out.reshape(shape)
+
+
+def decode_update(msg: Message, *, key: str = "delta") -> Any:
+    """Recover the (post-compression) tree shipped by ``encode_update``
+    as float32 numpy leaves (``dense`` keeps the encoder's dtypes)."""
+    import jax
+
+    impl = msg.get(key + "_wire")
+    payload = msg.get_tensor(key)
+    if impl == "dense":
+        return jax.tree_util.tree_map(np.asarray, payload)
+    if impl == "bf16":
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x).view(_BF16).astype(np.float32),
+            payload)
+    if impl == "int8":
+        return jax.tree_util.tree_map(
+            lambda q, s: q.astype(np.float32) * np.float32(s),
+            payload["q"], payload["scale"])
+    if impl == "topk":
+        return jax.tree_util.tree_map(
+            _scatter_leaf, payload["idx"], payload["val"],
+            payload["shape"])
+    raise ValueError(f"message carries unknown wire impl {impl!r}")

@@ -1,0 +1,184 @@
+"""Federation smoke: the distributed-runtime subsystem's CI gate.
+
+Runs the loopback federation (1 aggregator + 3 sites on a
+``LocalRouter``, real wire messages, real handler threads) twice and
+asserts the two contracts the subsystem stands on:
+
+  1. SYNC BIT-PARITY — a synchronous federated run produces global
+     params bit-identical to the single-process simulation with the
+     same argv (compared through ``obs/diff.py params_diff``, which
+     names the diverging leaves). This pins that splitting the round
+     body across site processes changed NOTHING numerically.
+  2. BUFFERED DEGRADATION + REPLAY — with site 3 deliberately
+     straggling (asleep longer than the whole run), the buffered-async
+     run still completes every flush from the surviving sites, records
+     an arrival trace, and replaying that trace reproduces the global
+     params bit-for-bit.
+
+    python scripts/fed_smoke.py              # CI gate
+    python scripts/fed_smoke.py --rounds 3 --clients 9
+
+Prints ONE JSON line; exits nonzero on any assertion failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+STRAGGLER_FAULTS = "3:straggle=1.0:{sleep}"
+
+
+def _argv(clients, rounds, tmp, sub):
+    return [
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", str(clients), "--frac", "1.0",
+        "--batch_size", "8", "--epochs", "1",
+        "--comm_round", str(rounds), "--lr", "0.05",
+        "--final_finetune", "0",
+        "--log_dir", os.path.join(tmp, sub, "LOG"),
+        "--results_dir", os.path.join(tmp, sub, "results"),
+    ]
+
+
+def _run(argv):
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    return run_experiment(parse_args(argv, algo="fedavg"), "fedavg")
+
+
+def _assert_identical(a, b, what):
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
+
+    pd = obs_diff.params_diff(a, b)
+    if not pd["identical"]:
+        raise SystemExit(
+            f"{what} diverged: {len(pd['diverged'])} leaves, first "
+            f"{pd['diverged'][:3]}")
+
+
+def run_sync_parity(clients: int, rounds: int, sites: int,
+                    tmp: str) -> dict:
+    """Contract 1: loopback sync federation == in-process simulation."""
+    import jax
+    import numpy as np
+
+    base = _argv(clients, rounds, tmp, "sync")
+    fed = base + ["--fed_role", "aggregator", "--fed_mode", "sync",
+                  "--fed_sites", str(sites), "--fed_backend", "local"]
+    out_fed = _run(fed)
+    # --mesh_devices 1: the anchor is the UNSHARDED simulation — sites
+    # compute on a single device, and a clients-mesh twin (multi-device
+    # hosts) reduces in a different order (~1e-7 float drift, not parity)
+    out_twin = _run(_argv(clients, rounds, tmp, "twin")
+                    + ["--mesh_devices", "1"])
+    twin_params = jax.tree_util.tree_map(
+        np.asarray, out_twin["state"].global_params)
+    _assert_identical(out_fed["global_params"], twin_params,
+                      "sync federation vs in-process simulation")
+    fed_hist = {h["round"]: h["train_loss"] for h in out_fed["history"]
+                if h.get("round", -1) >= 0}
+    twin_hist = {h["round"]: h["train_loss"] for h in out_twin["history"]
+                 if "train_loss" in h}
+    if fed_hist != twin_hist:
+        raise SystemExit(
+            f"sync round losses diverged: fed={fed_hist} "
+            f"twin={twin_hist}")
+    statuses = [h.get("fed_status") for h in out_fed["history"]
+                if h.get("round", -1) >= 0]
+    if statuses != ["completed"] * rounds:
+        raise SystemExit(f"sync rounds not all completed: {statuses}")
+    if not out_fed["fed"]["federation_jsonl"]:
+        raise SystemExit("aggregator produced no folded federation.jsonl")
+    return {"sync_bit_identical": True, "sync_rounds": rounds}
+
+
+def run_buffered_replay(clients: int, rounds: int, sites: int,
+                        tmp: str, straggle_s: float) -> dict:
+    """Contract 2: buffered async completes without the straggler and
+    the recorded arrival trace replays bit-for-bit."""
+    base = _argv(clients, rounds, tmp, "buf")
+    buf = base + [
+        "--fed_role", "aggregator", "--fed_mode", "buffered",
+        "--fed_sites", str(sites), "--fed_buffer_k", str(sites - 1),
+        "--fed_backend", "local",
+        "--fed_site_faults",
+        STRAGGLER_FAULTS.format(sleep=straggle_s),
+        "--fed_timeout_s", "60",
+    ]
+    out_buf = _run(buf)
+    flushes = [h for h in out_buf["history"] if h.get("round", -1) >= 0]
+    if len(flushes) != rounds:
+        raise SystemExit(
+            f"buffered run flushed {len(flushes)} times, expected "
+            f"{rounds} — the straggler stalled the federation")
+    trace_path = out_buf["fed"]["trace_path"]
+    with open(trace_path) as f:
+        trace = json.load(f)
+    members = [tuple(m) for fl in trace["flushes"] for m in fl["members"]]
+    if any(site == sites for site, _base in members):
+        raise SystemExit(
+            f"straggling site {sites} appears in the flush trace "
+            f"{members} — the fault never fired")
+    if not members:
+        raise SystemExit("empty arrival trace — nothing was aggregated")
+    replay = _argv(clients, rounds, tmp, "replay") + [
+        "--fed_role", "aggregator", "--fed_mode", "buffered",
+        "--fed_sites", str(sites), "--fed_buffer_k", str(sites - 1),
+        "--fed_backend", "local",
+        "--fed_site_faults",
+        STRAGGLER_FAULTS.format(sleep=straggle_s),
+        "--fed_timeout_s", "60",
+        "--fed_replay", trace_path,
+    ]
+    out_rep = _run(replay)
+    if not out_rep["fed"]["replayed"]:
+        raise SystemExit("replay run did not take the replay path")
+    _assert_identical(out_buf["global_params"], out_rep["global_params"],
+                      "buffered run vs its own trace replay")
+    hist = out_buf["fed"]["staleness_hist"]
+    return {
+        "buffered_flushes": len(flushes),
+        "replay_bit_identical": True,
+        "survivors_only": True,
+        "staleness_hist": hist,
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--clients", type=int, default=6)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--sites", type=int, default=3)
+    p.add_argument("--straggle_s", type=float, default=30.0,
+                   help="straggler sleep; must exceed the whole "
+                        "buffered run so the site never reports")
+    p.add_argument("--tmp", type=str, default="",
+                   help="scratch dir (default: a fresh tempdir)")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import logging
+    import tempfile
+
+    logging.getLogger().setLevel(logging.WARNING)
+    tmp = args.tmp or tempfile.mkdtemp(prefix="fed_smoke_")
+    t0 = time.perf_counter()
+    result = {"fed_smoke_ok": True, "clients": args.clients,
+              "sites": args.sites}
+    result.update(run_sync_parity(args.clients, args.rounds, args.sites,
+                                  tmp))
+    result.update(run_buffered_replay(args.clients, args.rounds,
+                                      args.sites, tmp, args.straggle_s))
+    result["wall_s"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,361 @@
+"""Federation runtime units: protocol determinism, flag validation,
+typed round outcomes, counter thread-safety, and the (slow) loopback
+end-to-end parity anchors.
+
+The fast tests here exercise everything that does NOT need a built
+model: ``fed.protocol`` key/partition determinism, the
+``parse_site_faults``/``parse_endpoints`` grammars, the
+``validate_fed_args`` refusal cluster, ``send_with_retry``'s
+retry/backoff accounting, ``CrossSiloServer.run_round``'s
+completed/quorum/timeout verdicts, and the ``CommCounters`` lock. The
+``slow``-marked e2e twins mirror ``scripts/fed_smoke.py`` (the CI
+gate) for ``-m slow`` sweeps.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.comm.base import CommCounters
+from neuroimagedisttraining_tpu.comm.cross_silo import (CrossSiloClient,
+                                                        CrossSiloServer)
+from neuroimagedisttraining_tpu.comm.local import LocalRouter
+from neuroimagedisttraining_tpu.comm.message import Message
+from neuroimagedisttraining_tpu.fed.protocol import (partition_slots,
+                                                     send_with_retry,
+                                                     site_round_key)
+from neuroimagedisttraining_tpu.fed.runtime import (parse_endpoints,
+                                                    parse_site_faults,
+                                                    validate_fed_args)
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_partition_slots_contiguous_cover():
+    for n_items in (1, 5, 6, 7):
+        parts = partition_slots(n_items, 3)
+        assert len(parts) == 3
+        flat = np.concatenate(parts)
+        # contiguity is load-bearing: the sync aggregator reassembles
+        # the [S] cohort stack by concatenating site blocks in rank
+        # order, which is slot order only because blocks are contiguous
+        np.testing.assert_array_equal(flat, np.arange(n_items))
+        sizes = [len(p) for p in parts]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_site_round_key_deterministic_and_distinct():
+    k = site_round_key(0, 3, 1)
+    np.testing.assert_array_equal(np.asarray(k),
+                                  np.asarray(site_round_key(0, 3, 1)))
+    seen = {tuple(np.asarray(site_round_key(s, v, r)).tolist())
+            for s in (0, 1) for v in (0, 1, 2) for r in (1, 2, 3)}
+    assert len(seen) == 2 * 3 * 3  # no collisions across (seed, v, rank)
+
+
+def test_send_with_retry_counts_and_reraises():
+    class Flaky:
+        def __init__(self, fail_n):
+            self.fail_n = fail_n
+            self.sent = 0
+            self.counters = CommCounters()
+
+        def send_message(self, msg):
+            if self.fail_n > 0:
+                self.fail_n -= 1
+                raise ConnectionRefusedError("not yet bound")
+            self.sent += 1
+
+    m = Flaky(fail_n=2)
+    send_with_retry(m, Message("x", 1, 0), retries=2, backoff_s=0.0)
+    assert m.sent == 1
+    assert m.counters.snapshot()["comm_messages_retried"] == 2
+
+    m2 = Flaky(fail_n=3)
+    with pytest.raises(OSError):
+        send_with_retry(m2, Message("x", 1, 0), retries=2, backoff_s=0.0)
+    assert m2.counters.snapshot()["comm_messages_retried"] == 2
+
+
+# ------------------------------------------------------------- flag parsing
+
+
+def test_parse_site_faults_grammar():
+    out = parse_site_faults("3:straggle=1.0:6.0;1:drop=0.5")
+    assert set(out) == {1, 3}
+    fs3, delay3 = out[3]
+    assert delay3 == 6.0
+    _fs1, delay1 = out[1]
+    assert delay1 == 2.0  # DEFAULT_STRAGGLE_S when no trailing delay
+    assert parse_site_faults("") == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "3",                      # no fault spec
+    "x:drop=1.0",             # non-int rank
+    "0:drop=1.0",             # rank < 1 (site ranks start at 1)
+    "2:drop=0.1;2:drop=0.2",  # duplicate rank
+    "2:drop=0.1:oops",        # trailing field neither clause nor delay
+])
+def test_parse_site_faults_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_site_faults(bad)
+
+
+def test_parse_endpoints():
+    eps = parse_endpoints("127.0.0.1:9000, 10.0.0.2:9001", 2)
+    assert eps == [("127.0.0.1", 9000), ("10.0.0.2", 9001)]
+    with pytest.raises(ValueError):
+        parse_endpoints("127.0.0.1:9000", 2)  # count mismatch
+    with pytest.raises(ValueError):
+        parse_endpoints("nocolon, 1.2.3.4:5", 2)
+
+
+# -------------------------------------------------------- refusal cluster
+
+
+def _fed_args(tmp_path, *extra):
+    from neuroimagedisttraining_tpu.experiments import parse_args
+
+    return parse_args([
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "6", "--frac", "1.0",
+        "--batch_size", "8", "--epochs", "1", "--comm_round", "2",
+        "--final_finetune", "0",
+        "--results_dir", str(tmp_path / "results"),
+        "--fed_role", "aggregator", "--fed_mode", "sync",
+        "--fed_sites", "3",
+    ] + list(extra), algo="fedavg")
+
+
+def test_validate_accepts_the_baseline(tmp_path):
+    validate_fed_args(_fed_args(tmp_path), "fedavg")
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (dict(fuse_rounds=4), "fuse_rounds"),
+    (dict(watchdog=2), "watchdog"),
+    (dict(client_store="host"), "client_store"),
+    (dict(multihost=True), "multihost"),
+    (dict(defense_type="krum"), "defenses"),
+    (dict(fault_spec="drop=0.2"), "fed_site_faults"),
+    (dict(eval_cache=1), "eval_cache"),
+    (dict(checkpoint_dir="/tmp/ck"), "checkpoint"),
+    (dict(mesh_space=2), "mesh_space"),
+    (dict(agg_impl="int8"), "bit-parity"),            # sync + compressed
+    (dict(fed_mode="buffered", agg_impl="zfp"), "wire codec"),
+    (dict(fed_mode="buffered", frac=0.5), "frac"),
+    (dict(fed_mode="buffered", fed_buffer_k=9), "fed_buffer_k"),
+    (dict(fed_mode="buffered", fed_buffer_k=2, fed_staleness_bound=-1),
+     "staleness"),
+    (dict(fed_replay="/tmp/trace.json"), "replay"),   # replay + sync
+    (dict(fed_site_faults="9:drop=1.0"), "only 3 sites"),
+    (dict(fed_sites=0), "fed_sites"),
+])
+def test_validate_refuses(tmp_path, mutate, fragment):
+    args = _fed_args(tmp_path)
+    for k, v in mutate.items():
+        setattr(args, k, v)
+    with pytest.raises(SystemExit, match=fragment):
+        validate_fed_args(args, "fedavg")
+
+
+def test_validate_refuses_non_fedavg(tmp_path):
+    with pytest.raises(SystemExit, match="fedavg"):
+        validate_fed_args(_fed_args(tmp_path), "salientgrads")
+
+
+def test_derive_rejects_mode_without_role(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import parse_args
+
+    with pytest.raises(ValueError, match="fed_role"):
+        parse_args([
+            "--model", "small3dcnn", "--dataset", "synthetic",
+            "--results_dir", str(tmp_path / "results"),
+            "--fed_mode", "buffered",
+        ], algo="fedavg")
+
+
+def test_derive_resolves_buffer_k_sentinel(tmp_path):
+    args = _fed_args(tmp_path, "--fed_mode", "buffered")
+    assert args.fed_buffer_k == 2  # max(1, sites - 1) from the 0 sentinel
+    assert _fed_args(tmp_path).fed_mode == "sync"  # role defaults the mode
+
+
+def test_fed_identity_classification(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import run_identity
+
+    sync_id = run_identity(_fed_args(tmp_path), "fedavg")
+    assert "fedsync" in sync_id and "fs3" in sync_id
+    plain = run_identity(_fed_args(tmp_path), "fedavg").replace(
+        "-fedsync-fs3", "")
+    # inert deployment knobs must NOT move the identity
+    moved = _fed_args(tmp_path, "--fed_timeout_s", "5",
+                      "--fed_retries", "7", "--fed_backoff_s", "0.5")
+    assert run_identity(moved, "fedavg") == sync_id
+    assert plain  # sanity: stripping the fed tags leaves the base identity
+
+
+# ------------------------------------------------------------ CommCounters
+
+
+def test_comm_counters_threaded_consistency():
+    """The regression the lock exists for: concurrent note_* from a
+    receive pump and a sending round loop must not tear or lose
+    updates. Pre-lock, the += pairs raced (bytes landed, count did
+    not)."""
+    c = CommCounters()
+    n_threads, per_thread = 8, 500
+
+    def worker():
+        for _ in range(per_thread):
+            c.note_sent(10)
+            c.note_received(3)
+            c.note_retry()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = c.snapshot()
+    total = n_threads * per_thread
+    assert snap == {
+        "comm_bytes_sent": 10 * total,
+        "comm_bytes_received": 3 * total,
+        "comm_messages_sent": total,
+        "comm_messages_received": total,
+        "comm_messages_retried": total,
+    }
+
+
+# ------------------------------------------------------------ RoundOutcome
+
+
+def _params(v):
+    return {"w": np.full((3,), float(v), np.float32)}
+
+
+def _train_fn(rank):
+    def fn(params, round_idx):
+        out = {"w": np.asarray(params["w"]) + rank}
+        return out, 10 * rank, float(rank)
+    return fn
+
+
+def test_run_round_completed():
+    router = LocalRouter(3)
+    server = CrossSiloServer(router.manager(0), 3, _params(0.0))
+    clients = [CrossSiloClient(router.manager(r), r, 3, _train_fn(r))
+               for r in (1, 2)]
+    for c in clients:
+        c.run(background=True)
+    server.run(background=True)
+    try:
+        outcome = server.run_round(0, timeout_s=30.0)
+        assert outcome.status == "completed" and outcome.applied
+        assert outcome.received == [1, 2] and outcome.missing == []
+        # weighted mean of (0+1)*10/30 + (0+2)*20/30
+        np.testing.assert_allclose(server.global_params["w"],
+                                   np.full((3,), 5.0 / 3.0), rtol=1e-6)
+        assert outcome.record["clients_reported"] == 2.0
+    finally:
+        server.comm.stop_receive_message()
+        for c in clients:
+            c.comm.stop_receive_message()
+
+
+def test_run_round_quorum_renormalizes_over_survivors():
+    router = LocalRouter(3)
+    server = CrossSiloServer(router.manager(0), 3, _params(0.0))
+    # rank 2 exists on the router but never reads its queue: a dead site
+    client = CrossSiloClient(router.manager(1), 1, 3, _train_fn(1))
+    client.run(background=True)
+    server.run(background=True)
+    try:
+        outcome = server.run_round(0, timeout_s=1.0, quorum=1)
+        assert outcome.status == "quorum" and outcome.applied
+        assert outcome.received == [1] and outcome.missing == [2]
+        # survivor renormalization: rank 1's update at weight 1.0
+        np.testing.assert_array_equal(server.global_params["w"],
+                                      np.full((3,), 1.0, np.float32))
+    finally:
+        server.comm.stop_receive_message()
+        client.comm.stop_receive_message()
+
+
+def test_run_round_timeout_carries_global_model():
+    router = LocalRouter(3)
+    init = _params(7.0)
+    server = CrossSiloServer(router.manager(0), 3, init)
+    server.run(background=True)
+    try:
+        outcome = server.run_round(0, timeout_s=0.3)
+        assert outcome.status == "timeout" and not outcome.applied
+        assert outcome.received == [] and outcome.missing == [1, 2]
+        assert np.isnan(outcome.record["train_loss"])
+        # untouched, not re-aggregated: the exact same object carries
+        assert server.global_params is init
+    finally:
+        server.comm.stop_receive_message()
+
+
+# ------------------------------------------------------- e2e (slow twins)
+
+
+def _smoke_argv(tmp_path, sub, *extra):
+    return [
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "6", "--frac", "1.0",
+        "--batch_size", "8", "--epochs", "1", "--comm_round", "2",
+        "--lr", "0.05", "--final_finetune", "0",
+        "--log_dir", str(tmp_path / sub / "LOG"),
+        "--results_dir", str(tmp_path / sub / "results"),
+    ] + list(extra)
+
+
+@pytest.mark.slow
+def test_loopback_sync_bit_parity(tmp_path):
+    import jax
+
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    from neuroimagedisttraining_tpu.obs.diff import params_diff
+
+    fed = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "fed", "--fed_role", "aggregator", "--fed_mode",
+        "sync", "--fed_sites", "3"), algo="fedavg"), "fedavg")
+    # --mesh_devices 1: the parity anchor is the UNSHARDED simulation —
+    # sites compute on one device, and a clients-mesh twin reduces in a
+    # different order (~1e-7 drift under the conftest's 8 virtual devices)
+    twin = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "twin", "--mesh_devices", "1"), algo="fedavg"),
+        "fedavg")
+    twin_params = jax.tree_util.tree_map(
+        np.asarray, twin["state"].global_params)
+    assert params_diff(fed["global_params"], twin_params)["identical"]
+
+
+@pytest.mark.slow
+def test_loopback_buffered_trace_replays(tmp_path):
+    import json
+
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    from neuroimagedisttraining_tpu.obs.diff import params_diff
+
+    buf_extra = ["--fed_role", "aggregator", "--fed_mode", "buffered",
+                 "--fed_sites", "3", "--fed_buffer_k", "2",
+                 "--fed_site_faults", "3:straggle=1.0:30.0"]
+    out = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "buf", *buf_extra), algo="fedavg"), "fedavg")
+    trace = json.load(open(out["fed"]["trace_path"]))
+    assert all(site != 3 for fl in trace["flushes"]
+               for site, _b in fl["members"])
+    rep = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "rep", *buf_extra, "--fed_replay",
+        out["fed"]["trace_path"]), algo="fedavg"), "fedavg")
+    assert rep["fed"]["replayed"]
+    assert params_diff(out["global_params"],
+                       rep["global_params"])["identical"]

@@ -1,0 +1,189 @@
+"""Property tests: federation delta wires are bit-transparent.
+
+The federated deployment ships model deltas between REAL processes in
+four formats (``dense``/``bf16``/``int8``/``topk``). The contract
+pinned here: the transport adds NOTHING — whatever precision the
+encoder kept, the decoder recovers bit-for-bit after the payload rides
+``Message.to_bytes`` through a backend (in-memory queue and native
+TCP). Lossy impls lose precision exactly once, at encode.
+"""
+import socket
+
+import numpy as np
+import pytest
+
+# hypothesis is an optional test extra (pyproject `test`); without it
+# the deterministic shim keeps the properties exercised (weaker — no
+# shrinking — but never a silent skip)
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    from _hypothesis_fallback import given, settings, strategies as st
+
+from neuroimagedisttraining_tpu.comm.local import LocalRouter
+from neuroimagedisttraining_tpu.comm.message import Message
+from neuroimagedisttraining_tpu.comm.tcp import (TcpCommManager,
+                                                 native_available)
+from neuroimagedisttraining_tpu.fed.wire import (WIRE_IMPLS,
+                                                 decode_update,
+                                                 encode_update)
+
+
+def _assert_tree_identical(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y)
+
+
+def _arrays(draw):
+    shape = tuple(draw(st.lists(st.integers(0, 4), min_size=0,
+                                max_size=3)))
+    n = int(np.prod(shape)) if shape else 1
+    vals = draw(st.lists(st.floats(-4.0, 4.0), min_size=n, max_size=n))
+    return np.asarray(vals, np.float32).reshape(shape)
+
+
+@st.composite
+def delta_trees(draw, depth=2):
+    """Model-delta-shaped pytrees: nested dicts/lists of f32 leaves
+    (what ``SiteTrainer.train_delta`` actually ships)."""
+    if depth == 0 or draw(st.booleans()):
+        return _arrays(draw)
+    kind = draw(st.sampled_from(["dict", "list"]))
+    if kind == "list":
+        return draw(st.lists(delta_trees(depth=depth - 1), min_size=1,
+                             max_size=3))
+    keys = st.text(st.characters(codec="ascii", min_codepoint=97,
+                                 max_codepoint=122), min_size=1,
+                   max_size=4)
+    return draw(st.dictionaries(keys, delta_trees(depth=depth - 1),
+                                max_size=3))
+
+
+def _encode(tree, impl):
+    msg = Message("fed_update", sender_id=1, receiver_id=0)
+    encode_update(msg, tree, impl, density=0.5)
+    msg.add("n_sum", 16.0)
+    return msg
+
+
+@settings(max_examples=20, deadline=None)
+@given(tree=delta_trees(), impl=st.sampled_from(WIRE_IMPLS))
+def test_wire_codec_bit_transparent(tree, impl):
+    """decode(from_bytes(to_bytes(encode(t)))) == decode(encode(t))."""
+    msg = _encode(tree, impl)
+    direct = decode_update(msg)
+    wired = decode_update(Message.from_bytes(msg.to_bytes()))
+    _assert_tree_identical(direct, wired)
+
+
+@settings(max_examples=10, deadline=None)
+@given(tree=delta_trees())
+def test_dense_wire_lossless(tree):
+    """The dense impl is fully lossless — decode returns the input."""
+    msg = _encode(tree, "dense")
+    out = decode_update(Message.from_bytes(msg.to_bytes()))
+    _assert_tree_identical(tree, out)
+
+
+@settings(max_examples=10, deadline=None)
+@given(tree=delta_trees(), impl=st.sampled_from(WIRE_IMPLS))
+def test_encode_is_deterministic(tree, impl):
+    """Same tree, same impl -> byte-identical payload (the property the
+    buffered-async replay stands on)."""
+    assert _encode(tree, impl).to_bytes() == _encode(tree, impl).to_bytes()
+
+
+@settings(max_examples=10, deadline=None)
+@given(tree=delta_trees(), impl=st.sampled_from(WIRE_IMPLS))
+def test_wire_over_local_backend(tree, impl):
+    """Through the loopback queue transport end-to-end."""
+    router = LocalRouter(2)
+    sender, receiver = router.manager(1), router.manager(0)
+    sender.send_message(_encode(tree, impl))
+    payload = router.queues[0].get(timeout=5.0)
+    got = Message.from_bytes(payload)
+    receiver.counters.note_received(len(payload))
+    assert got.type == "fed_update"
+    assert float(got.get("n_sum")) == 16.0
+    _assert_tree_identical(decode_update(_encode(tree, impl)),
+                           decode_update(got))
+
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++/native build unavailable")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@needs_native
+def test_wire_over_tcp_backend():
+    """Every impl through the REAL TCP transport, one connection pair
+    (the deployment path scripts/run_federation.py drives)."""
+    rng = np.random.default_rng(7)
+    tree = {"conv": {"w": rng.standard_normal((3, 4)).astype(np.float32),
+                     "b": np.zeros((4,), np.float32)},
+            "head": [rng.standard_normal((5,)).astype(np.float32),
+                     np.float32(0.25).reshape(())]}
+    eps = [("127.0.0.1", p) for p in _free_ports(2)]
+    site, agg = TcpCommManager(1, eps), TcpCommManager(0, eps)
+    try:
+        for impl in WIRE_IMPLS:
+            site.send_message(_encode(tree, impl))
+            got = agg.recv(timeout_s=10.0)
+            assert got is not None and got.type == "fed_update"
+            assert got.get("delta_wire") == impl
+            _assert_tree_identical(decode_update(_encode(tree, impl)),
+                                   decode_update(got))
+    finally:
+        site.finalize()
+        agg.finalize()
+
+
+def test_lossy_impls_bound_error():
+    """Sanity on the compression semantics: int8 error <= scale/2 + eps,
+    bf16 error <= 1 ulp at magnitude, topk keeps the largest entries."""
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal(64).astype(np.float32)
+    tree = {"w": a}
+
+    out8 = decode_update(_encode(tree, "int8"))["w"]
+    scale = np.max(np.abs(a)) / 127.0
+    assert np.max(np.abs(out8 - a)) <= scale * 0.5 + 1e-6
+
+    outb = decode_update(_encode(tree, "bf16"))["w"]
+    assert np.max(np.abs(outb - a)) <= np.max(np.abs(a)) / 128.0
+
+    outk = decode_update(_encode(tree, "topk"))["w"]
+    kept = np.flatnonzero(outk)
+    dropped = np.flatnonzero(outk == 0)
+    if kept.size and dropped.size:
+        assert np.min(np.abs(a[kept])) >= np.max(np.abs(a[dropped])) - 1e-7
+    np.testing.assert_array_equal(outk[kept], a[kept])
+
+
+def test_unknown_impl_refused():
+    msg = Message("fed_update")
+    with pytest.raises(ValueError):
+        encode_update(msg, {"w": np.zeros(3, np.float32)}, "zfp")
+    bad = Message("fed_update")
+    bad.add("delta_wire", "zfp")
+    bad.add_tensor("delta", {"w": np.zeros(3, np.float32)})
+    with pytest.raises(ValueError):
+        decode_update(Message.from_bytes(bad.to_bytes()))

@@ -270,7 +270,8 @@ def test_message_nbytes_hook_and_backend_counters():
         assert seen == [("probe", n)]
         assert m0.counters.snapshot() == {
             "comm_bytes_sent": n, "comm_bytes_received": 0,
-            "comm_messages_sent": 1, "comm_messages_received": 0}
+            "comm_messages_sent": 1, "comm_messages_received": 0,
+            "comm_messages_retried": 0}
         assert m1.counters.bytes_received == n
         assert m1.counters.messages_received == 1
     finally:
