@@ -161,6 +161,7 @@ class FedAlgorithm(abc.ABC):
         agg_hier_wire: str = "bf16",
         agg_hier_inner: int = 0,
         agg_overlap: bool = True,
+        agg_kernels: str = "xla",
         fault_spec: str = "",
         guard: Optional[bool] = None,
         obs_numerics: bool = False,
@@ -248,6 +249,15 @@ class FedAlgorithm(abc.ABC):
         # (bit-identical math; scheduling freedom only, so it never
         # enters run identity)
         self.agg_overlap = bool(agg_overlap)
+        # agg_kernels: XLA-vs-pallas backend for the wire's selection /
+        # quantize kernels (ops/topk_select.py, ops/pallas_kernels.py).
+        # Bit-identical by the tie-break contract, so it never enters
+        # run identity (census class: inert, like agg_overlap /
+        # donate_state); interpret mode keeps CPU runs on the same
+        # kernel code a TPU session compiles for real.
+        from ..ops.topk_select import check_kernels
+
+        self.agg_kernels = check_kernels(agg_kernels)
         self._agg_sparse_plan = None   # set by static-mask subclasses
         self._agg_mesh_known = False   # lazily discovered from the data
         self._agg_mesh_val = None
@@ -723,7 +733,8 @@ class FedAlgorithm(abc.ABC):
 
             kw = dict(mesh=self._agg_mesh(),
                       bucket_size=self.agg_bucket_size,
-                      overlap=self.agg_overlap)
+                      overlap=self.agg_overlap,
+                      kernels=self.agg_kernels)
             if self.agg_impl == "topk":
                 return collectives.topk_weighted_mean(
                     stacked, weights, self.agg_topk_density,

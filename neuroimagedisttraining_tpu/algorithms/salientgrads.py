@@ -174,7 +174,8 @@ class SalientGrads(FedAlgorithm):
             # params buffers alias to this pass-through output, so the
             # caller (init_state) keeps a valid handle while XLA reuses
             # the buffers for the scoring pass's scratch
-            return mask_from_scores(mean_scores, self.dense_ratio), params
+            return mask_from_scores(mean_scores, self.dense_ratio,
+                                    kernels=self.agg_kernels), params
 
         self._global_mask_jit = self._jit_entry(global_mask_fn)
 
@@ -193,9 +194,16 @@ class SalientGrads(FedAlgorithm):
                 # delta update leaves round 0's dense init on dead
                 # coordinates (g + update touches only live coords);
                 # re-mask so the global model keeps the SNIP sparsity
-                # invariant either way
-                new_global = jax.tree_util.tree_map(
-                    lambda p, m: p * m, new_global, state.mask)
+                # invariant either way (one fused pass per leaf under
+                # the pallas backend; p*m is elementwise, so the
+                # backends are trivially bit-identical)
+                if self.agg_kernels == "pallas":
+                    from ..ops.pallas_kernels import fused_mask_apply
+
+                    new_global = fused_mask_apply(new_global, state.mask)
+                else:
+                    new_global = jax.tree_util.tree_map(
+                        lambda p, m: p * m, new_global, state.mask)
             # w_per_mdls[cur_clnt] = the client's (pre-defense) locally
             # trained weights (sailentgrads_api.py:133), guard-aware
             new_personal = self._guarded_personal_update(

@@ -136,6 +136,14 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
                                  "association-only on-mesh (pinned)"),
     "agg_overlap": ("inert", "scheduling freedom only, bit-identical "
                              "per bucket (pinned)"),
+    "agg_kernels": ("inert", "xla-vs-pallas kernel backend — bit-exact "
+                             "by the tie-break contract (ops/"
+                             "topk_select.py: every backend converges "
+                             "to the same integer threshold fixed "
+                             "point; the fused quantize+reduce shares "
+                             "the XLA chain's rng/scale/dot spelling; "
+                             "tests/test_pallas_kernels.py pins "
+                             "pallas==xla bitwise)"),
     "retry_backoff_s": ("inert", "timing only, never state"),
     "multihost_timeout_s": ("inert", "init handshake timing"),
     "multihost_retries": ("inert", "init handshake retries"),

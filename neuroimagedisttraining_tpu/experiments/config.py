@@ -259,6 +259,16 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="--agg_impl hier: devices per intra-slice group "
                         "(must divide the clients mesh axis; 0 = the "
                         "balanced auto split, e.g. 8 devices -> 2x4)")
+    p.add_argument("--agg_kernels", type=str, default="xla",
+                   choices=["xla", "pallas"],
+                   help="kernel backend for the aggregation wire's "
+                        "selection/quantize hot paths (ops/"
+                        "topk_select.py, ops/pallas_kernels.py): xla = "
+                        "the pure-XLA bit-exact reference (default); "
+                        "pallas = the fused kernels (interpret mode off-"
+                        "TPU, so CPU runs exercise the identical kernel "
+                        "code). Bit-identical outputs by the tie-break "
+                        "contract — never enters run identity")
     p.add_argument("--agg_overlap", type=int, default=1,
                    help="group-ordered aggregation dispatch: emit each "
                         "leaf-group bucket's collective right after its "

@@ -296,6 +296,7 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         agg_hier_wire=getattr(args, "agg_hier_wire", "bf16"),
         agg_hier_inner=getattr(args, "agg_hier_inner", 0),
         agg_overlap=bool(getattr(args, "agg_overlap", 1)),
+        agg_kernels=getattr(args, "agg_kernels", "xla"),
         fault_spec=getattr(args, "fault_spec", ""),
         # None = let the algorithm auto-resolve (on iff faults injected);
         # parse_args always resolves the sentinel in derive()

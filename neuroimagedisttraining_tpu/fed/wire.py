@@ -14,8 +14,12 @@ bit-transparent — ``decode(wire(encode(tree)))`` equals
 The lossy impls (bf16/int8/topk) lose precision at ENCODE time, once;
 the wire never adds more.
 
-Top-k selection note: per-leaf magnitude selection with a stable
-argsort and ascending-index canonical order, sized by the shared
+Top-k selection note: per-leaf magnitude selection under the shared
+wire tie-break contract (``ops.topk_select.host_topk_indices``: every
+coordinate above the k-th-largest magnitude, then ties at it by
+ascending position, shipped in ascending-index canonical order) —
+byte-identical to the historical stable ``np.argsort(-|x|)`` spelling
+but O(n) via ``np.argpartition``, sized by the shared
 ``parallel.collectives.topk_count`` rounding rule — the same count the
 wire-cost model (``obs/comm.py``) prices.
 """
@@ -26,6 +30,7 @@ from typing import Any
 import numpy as np
 
 from ..comm.message import Message
+from ..ops.topk_select import host_topk_indices
 from ..parallel.collectives import topk_count
 
 try:  # jax's own dtype-extension dependency; present wherever jax is
@@ -59,10 +64,11 @@ def _topk_leaf(a: np.ndarray, density: float):
     a = np.asarray(a, np.float32)
     flat = a.ravel()
     k = topk_count(flat.size, density)
-    # stable argsort on negated magnitude: deterministic tie-break by
-    # position; ascending-index canonical order for the shipped pairs
-    order = np.argsort(-np.abs(flat), kind="stable")[:k]
-    idx = np.sort(order).astype(np.int32)
+    # exactly-k selection under the shared wire tie-break contract
+    # (ops/topk_select.host_topk_indices: all >threshold, ties at the
+    # threshold by ascending position) — byte-identical payloads to the
+    # historical stable np.argsort spelling, via O(n) argpartition
+    idx = host_topk_indices(np.abs(flat), k)
     return idx, flat[idx], np.asarray(a.shape, np.int64)
 
 

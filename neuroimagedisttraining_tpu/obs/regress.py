@@ -209,7 +209,10 @@ def metric_gate_defaults(metric: str) -> Dict[str, Any]:
     this for every flag the caller did not set explicitly.
 
     ``agg_ms_`` covers the scripts/bench_agg.py microbench timings
-    (incl. the topk/hier impls); ``agg_bytes_`` the modeled wire bytes
+    (incl. the topk/hier impls and their per-kernel-backend
+    ``agg_ms_<impl>-k<backend>_<tag>`` cells — prefix matching makes
+    every backend's trajectory lower-is-better from its first append);
+    ``agg_bytes_`` the modeled wire bytes
     recorded beside them — bytes are ANALYTIC (zero run-to-run noise),
     so any upward drift is a real model/impl change and the band is
     tight. ``cohort_mem_bytes_`` covers the BENCH_CONFIG=cohort sweep's

@@ -181,3 +181,252 @@ def fused_weighted_sum_leaf(stacked: jax.Array, weights: jax.Array):
 def fused_weighted_sum(stacked_tree: Any, weights: jax.Array) -> Any:
     return jax.tree_util.tree_map(
         lambda x: fused_weighted_sum_leaf(x, weights), stacked_tree)
+
+
+# -- threshold top-k selection (the --agg_kernels wire leg) -------------------
+#
+# ops/topk_select.py owns the algorithm and the tie-break contract; the
+# kernel below is its pallas backend: the magnitudes stay VMEM-resident
+# across all SEARCH_ITERS count passes of the bit-space binary search —
+# ONE read of the row from HBM, vs one sweep per pass for the XLA
+# spelling. Both converge to the same unique integer fixed point (the
+# largest bit pattern with count >= k), so the backends are bit-identical
+# by construction, not by tolerance.
+
+#: per-row element cap for the VMEM-resident search: the row (f32), its
+#: int32 bit view and one compare temp must share VMEM, so rows above
+#: this fall back to the XLA search (same bits, different residency)
+THRESHOLD_MAX_N = 1 << 20
+
+#: f32-block byte budget used to pick how many rows share one kernel
+#: instance (x + bits + temp keeps the total well under VMEM)
+_THRESH_BLOCK_BYTES = 1 << 22
+
+
+def threshold_supported(n: int) -> bool:
+    """Can the pallas threshold kernel hold an n-element row in VMEM?"""
+    return int(n) <= THRESHOLD_MAX_N
+
+
+def _threshold_kernel(k_ref, av_ref, out_ref, *, iters: int,
+                      bits_hi: int):
+    """Bit-space binary search over one (cb, rows, LANES) magnitude
+    block: lo converges to the k-th largest magnitude's bit pattern
+    (topk_select.exact_threshold, same invariant/fixed point)."""
+    bits = jax.lax.bitcast_convert_type(av_ref[:], jnp.int32)
+    k = k_ref[0]
+    cb = bits.shape[0]
+    lo0 = jnp.zeros((cb, 1, 1), jnp.int32)
+    hi0 = jnp.full((cb, 1, 1), bits_hi, jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo) // 2
+        cnt = jnp.sum((bits >= mid).astype(jnp.int32), axis=(1, 2),
+                      keepdims=True)
+        ok = cnt >= k
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    lo, _ = jax.lax.fori_loop(0, iters, body, (lo0, hi0))
+    thr = jax.lax.bitcast_convert_type(lo, jnp.float32)
+    out_ref[:] = jnp.broadcast_to(thr[:, 0], (cb, LANES))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def threshold_topk(av: jax.Array, k: int) -> jax.Array:
+    """Exact k-th largest magnitude per row of a [C, n] nonneg f32
+    matrix, VMEM-resident; returns [C, 1] f32 — bit-identical to
+    ``topk_select.exact_threshold(av, k)`` (and so to the sort
+    spelling) under the tie-break contract. Rows are zero-padded to the
+    (SUBLANES, LANES) tile; pad bits (0) never reach a count at any
+    positive cut, and a cut can only fall to 0 when the true threshold
+    IS 0.0, where counting pads is already harmless."""
+    from .topk_select import _BITS_HI, SEARCH_ITERS
+
+    c, n = av.shape
+    per_panel = LANES * SUBLANES
+    n_pad = ((n + per_panel - 1) // per_panel) * per_panel
+    rows = n_pad // LANES
+    cb = max(1, min(c, _THRESH_BLOCK_BYTES // (n_pad * 4)))
+    c_pad = ((c + cb - 1) // cb) * cb
+    av2 = jnp.pad(av.astype(jnp.float32),
+                  ((0, c_pad - c), (0, n_pad - n)))
+    panels = av2.reshape(c_pad, rows, LANES)
+
+    kernel = functools.partial(_threshold_kernel, iters=SEARCH_ITERS,
+                               bits_hi=int(_BITS_HI))
+    out = pl.pallas_call(
+        kernel,
+        grid=(c_pad // cb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # k scalar
+            pl.BlockSpec((cb, rows, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((cb, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((c_pad, LANES), jnp.float32),
+        interpret=_interpret(),
+    )(jnp.asarray([k], jnp.int32), panels)
+    return out[:c, :1]
+
+
+# -- fused int8 quantize + weighted bucketed reduce ---------------------------
+#
+# The off-mesh int8 wire (collectives._reduce_mat) is a chain of
+# materialized ops per [C, nb, b] bucket tensor: divide -> floor ->
+# uniform-compare -> clip -> int8 cast -> dequantize -> tensordot. The
+# kernel below fuses the whole quantize/dequantize chain AND the
+# weighted client contraction into one pass over the cohort matrix:
+# each grid step reads one bucket-aligned chunk of every client's row
+# once, stochastic-rounds it with a PRECOMPUTED uniform draw (the same
+# rng call and shape as the XLA chain, so the rounding bits are
+# identical by construction) and a precomputed per-(client, bucket)
+# scale, and contracts the dequantized chunk against the weights with
+# ``jnp.dot`` — the SAME dot primitive ``tensordot`` lowers to, and
+# per-output-column contractions are independent of how columns are
+# chunked, so the kernel's sums are bit-identical to the XLA
+# reference's ``tensordot(w, deq)`` (pinned by
+# tests/test_pallas_kernels.py). An explicit elementwise accumulate
+# spelling was measured to diverge by one ulp instead: XLA:CPU
+# contracts ``acc + w*deq`` into an FMA that no barrier/bitcast
+# spelling suppresses, while the shared-dot spelling keeps both
+# backends inside one primitive. Only the scale's amax reduce stays
+# outside the kernel (it must see the whole bucket before the first
+# quantized element; max is exact in any association, so it is
+# bit-stable and shared by both backends).
+
+#: per-chunk f32 byte budget of the fused kernel (x + u blocks each)
+_QR_CHUNK_BYTES = 1 << 21
+
+
+def quantize_reduce_supported(bucket: int) -> bool:
+    """Fused-kernel eligibility: chunks must tile (SUBLANES x LANES)
+    exactly and align to bucket boundaries (one scale per chunk), so
+    the bucket must be a multiple of the 1024-element panel; anything
+    else routes to the bit-identical XLA spelling."""
+    per_panel = LANES * SUBLANES
+    return int(bucket) % per_panel == 0
+
+
+def _qreduce_kernel(w_ref, x_ref, u_ref, s_ref, out_ref):
+    x = x_ref[:]                        # (C, chunk)
+    u = u_ref[:]
+    scale = s_ref[:]                    # (C, 1) — this chunk's bucket
+    y = x / scale
+    f = jnp.floor(y)
+    q = jnp.clip(f + (u < (y - f)).astype(jnp.float32), -127.0, 127.0)
+    out_ref[:] = jnp.dot(w_ref[:], q * scale)   # (1,C)@(C,chunk)
+
+
+@jax.jit
+def fused_quantize_reduce(buckets: jax.Array, weights: jax.Array,
+                          uniforms: jax.Array,
+                          scales: jax.Array) -> jax.Array:
+    """out[j] = sum_c w[c] * dequant(stochastic_int8(buckets[c, j]))
+    for a [C, nb, b] bucketed client matrix, quantize chain + weighted
+    contraction fused per chunk. ``uniforms`` is the [C, nb, b]
+    stochastic-rounding draw and ``scales`` the [C, nb] per-bucket
+    max-abs/127 scale — both computed by the caller with the exact
+    spelling of the XLA chain, so backend bit-identity needs only this
+    kernel's chunk math to match (it does: shared dot primitive, see
+    module comment). Returns [nb, b] f32. Caller guards with
+    :func:`quantize_reduce_supported`."""
+    c, nb, b = buckets.shape
+    n = nb * b
+    x = buckets.astype(jnp.float32).reshape(c, n)
+    u = uniforms.astype(jnp.float32).reshape(c, n)
+    per_panel = LANES * SUBLANES
+    budget = max(per_panel,
+                 (_QR_CHUNK_BYTES // (max(c, 1) * 4)) // per_panel
+                 * per_panel)
+    chunk = min(b, budget)
+    while b % chunk:                    # b % per_panel == 0 (guard), so
+        chunk -= per_panel              # this terminates at per_panel
+
+    block = pl.BlockSpec((c, chunk), lambda ci: (0, ci),
+                         memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _qreduce_kernel,
+        grid=(n // chunk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),      # (1, C) weights
+            block, block,
+            pl.BlockSpec((c, 1), lambda ci: (0, ci * chunk // b),
+                         memory_space=pltpu.VMEM),      # bucket scale
+        ],
+        out_specs=pl.BlockSpec((1, chunk), lambda ci: (0, ci),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        interpret=_interpret(),
+    )(weights.astype(jnp.float32).reshape(1, c), x, u,
+      scales.astype(jnp.float32))
+    return out.reshape(nb, b)
+
+
+# -- fused SNIP mask ops (SalientGrads selection path) ------------------------
+
+def _mask_apply_kernel(p_ref, m_ref, out_ref):
+    out_ref[:] = p_ref[:] * m_ref[:]
+
+
+@jax.jit
+def fused_mask_apply_leaf(p: jax.Array, m: jax.Array) -> jax.Array:
+    """One-pass ``p * m`` mask projection for one leaf (the SalientGrads
+    post-aggregate re-mask) — bit-identical to the jnp spelling (one
+    f32 multiply either way; masks are binary)."""
+    shape, dtype = p.shape, p.dtype
+    p2, n = _to_2d(p.astype(jnp.float32))
+    m2, _ = _to_2d(m.astype(jnp.float32))
+    rows = p2.shape[0]
+    block_rows = _pick_block_rows(rows)
+    vmem_spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _mask_apply_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[vmem_spec, vmem_spec],
+        out_specs=vmem_spec,
+        out_shape=jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+        interpret=_interpret(),
+    )(p2, m2)
+    return _from_2d(out, n, shape, dtype)
+
+
+def fused_mask_apply(tree: Any, mask: Any) -> Any:
+    """Pytree-level fused mask projection (drop-in for
+    ``tree_map(lambda p, m: p * m, tree, mask)``)."""
+    return jax.tree_util.tree_map(fused_mask_apply_leaf, tree, mask)
+
+
+def _score_mask_kernel(nt_ref, s_ref, out_ref):
+    norm = nt_ref[0]
+    thr = nt_ref[1]
+    out_ref[:] = (s_ref[:] / norm >= thr).astype(jnp.float32)
+
+
+@jax.jit
+def fused_score_mask_leaf(s: jax.Array, norm: jax.Array,
+                          thr: jax.Array) -> jax.Array:
+    """One-pass magnitude-score mask build for one leaf:
+    ``(s / norm >= thr) -> {0, 1}`` fused (normalize + compare + cast),
+    bit-identical to the jnp spelling in ``sparsity.mask_from_scores``.
+    Zero-pad is harmless: pad lanes are sliced away before the
+    compare's result leaves the kernel wrapper."""
+    shape, dtype = s.shape, s.dtype
+    s2, n = _to_2d(s.astype(jnp.float32))
+    rows = s2.shape[0]
+    block_rows = _pick_block_rows(rows)
+    vmem_spec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    nt = jnp.stack([jnp.asarray(norm, jnp.float32).reshape(()),
+                    jnp.asarray(thr, jnp.float32).reshape(())])
+    out = pl.pallas_call(
+        _score_mask_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), vmem_spec],
+        out_specs=vmem_spec,
+        out_shape=jax.ShapeDtypeStruct(s2.shape, jnp.float32),
+        interpret=_interpret(),
+    )(nt, s2)
+    return _from_2d(out, n, shape, dtype)

@@ -167,11 +167,21 @@ def _stochastic_round(x: jax.Array, rng: jax.Array) -> jax.Array:
     return f + (jax.random.uniform(rng, x.shape) < (x - f)).astype(x.dtype)
 
 
+def _int8_scale(x: jax.Array) -> jax.Array:
+    """Per-bucket (last-axis) max-abs/127 scale, keepdims. ONE spelling
+    shared by the XLA chain and the fused-kernel routing: XLA's
+    algebraic simplifier rewrites the constant divide differently under
+    jit than eagerly (measured one-ulp scale drift), so backend
+    bit-identity requires both backends to trace the IDENTICAL scale
+    subgraph, not merely equivalent math."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    return jnp.where(amax > 0, amax / 127.0, 1.0)
+
+
 def _quantize_int8(x: jax.Array, rng: jax.Array):
     """Per-bucket (last-axis) max-abs scaling + stochastic rounding.
     Returns (int8 payload, f32 scale broadcastable against it)."""
-    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    scale = _int8_scale(x)
     q = jnp.clip(_stochastic_round(x / scale, rng), -127.0, 127.0)
     return q.astype(jnp.int8), scale
 
@@ -506,7 +516,7 @@ def topk_count(n: int, k_frac: float) -> int:
 def topk_sparsify(stacked: Any, k_frac: float, *,
                   plan: Optional[SparsePlan] = None,
                   bucket_size: int = DEFAULT_BUCKET_SIZE,
-                  sample: int = 0) -> Any:
+                  sample: int = 0, kernels: str = "xla") -> Any:
     """Per-leaf-group top-k magnitude selection over a [C, ...]-stacked
     pytree: within each leaf-group bucket (the same
     :func:`_leaf_groups` partition every collective uses), each client
@@ -522,15 +532,28 @@ def topk_sparsify(stacked: Any, k_frac: float, *,
     all-zero-row edge keeps the row unchanged — sparsifying an exact
     zero contributes exactly zero to wire and residual alike).
 
+    The per-group threshold comes from ``ops.topk_select``'s
+    threshold-refinement search (``kernels='xla'`` default / the pallas
+    VMEM-resident kernel / the legacy ``'sort'`` ``lax.top_k``
+    spelling) — every backend yields the SAME float, so they select
+    bit-identical coordinate sets under the module's tie-break contract.
+    The sort spelling was the wire's scaling wall (26.7 s/agg exact at
+    scale-32, RESULTS Round-12; XLA:CPU ``top_k`` is sort-bound in n at
+    any k); the bit-space search replaced it at ~O(31 n) compares with
+    no trajectory change.
+
     ``sample > 0`` estimates each group's threshold from a strided
-    ~``sample``-element subsample instead of the full row — the Deep
-    Gradient Compression hierarchical-sampling trick: ``top_k`` is
-    sort-bound in n (measured 2.1 s per 32x262k group on XLA:CPU at ANY
-    k vs 0.11 s on a 16k subsample), the estimate is deterministic
-    (fixed stride, no RNG), and the shipped count is only
-    approximately k — which error feedback absorbs by construction
-    (over- or under-selection just shifts coordinates between wire and
-    residual). 0 (the default) keeps the exact selection."""
+    ~``sample``-element subsample instead of the full row
+    (``topk_select.sampled_threshold`` — the Deep Gradient Compression
+    hierarchical-sampling trick): deterministic (fixed stride, no RNG),
+    and the shipped count is only approximately k — which error
+    feedback absorbs by construction (over- or under-selection just
+    shifts coordinates between wire and residual). 0 (the default)
+    keeps the exact selection, which the threshold backends price at a
+    flat ~31 passes — sampling is an optimization now, not a
+    necessity."""
+    from ..ops.topk_select import select_threshold
+
     if plan is not None:
         _plan_check(stacked, plan)
     leaves = jax.tree_util.tree_leaves(stacked)
@@ -550,13 +573,7 @@ def topk_sparsify(stacked: Any, k_frac: float, *,
         n = int(end - start)
         k = topk_count(n, k_frac)
         av = jnp.abs(seg)
-        if sample and n > sample:
-            stride = max(1, n // int(sample))
-            cand = av[:, ::stride]
-            ks = min(cand.shape[1], max(1, int(round(k / stride))))
-            thr = jax.lax.top_k(cand, ks)[0][:, -1:]
-        else:
-            thr = jax.lax.top_k(av, k)[0][:, -1:]
+        thr = select_threshold(av, k, kernels=kernels, sample=sample)
         cols.append(jnp.where(av >= thr, seg, jnp.zeros_like(seg)))
     sp_mat = jnp.concatenate(cols, axis=1)
     # rebuild the stacked tree layout (dense leaves reshape; compressed
@@ -580,7 +597,8 @@ def topk_weighted_mean(stacked: Any, weights: jax.Array, k_frac: float,
                        axis_name: str = "clients",
                        bucket_size: int = DEFAULT_BUCKET_SIZE,
                        overlap: bool = True,
-                       sample: int = 0) -> Tuple[Any, Any]:
+                       sample: int = 0,
+                       kernels: str = "xla") -> Tuple[Any, Any]:
     """The ``agg_impl='topk'`` aggregate: sparsify each client's row
     (:func:`topk_sparsify`), then the weighted mean of the sparsified
     rows through the bucketed (plan-compressed when given) reduce.
@@ -596,9 +614,10 @@ def topk_weighted_mean(stacked: Any, weights: jax.Array, k_frac: float,
     ``obs.comm.WireCostModel`` prices and a cross-silo transport ships
     (``obs.comm.topk_payload``)."""
     sp = topk_sparsify(stacked, k_frac, plan=plan,
-                       bucket_size=bucket_size, sample=sample)
+                       bucket_size=bucket_size, sample=sample,
+                       kernels=kernels)
     kw = dict(mesh=mesh, axis_name=axis_name, bucket_size=bucket_size,
-              overlap=overlap)
+              overlap=overlap, kernels=kernels)
     if plan is not None:
         agg = sparse_weighted_mean(sp, weights, plan, **kw)
     else:
@@ -612,12 +631,21 @@ def topk_weighted_mean(stacked: Any, weights: jax.Array, k_frac: float,
 
 def _reduce_mat(mat: jax.Array, weights: jax.Array, *,
                 bucket_size: int = DEFAULT_BUCKET_SIZE,
-                wire: str = "f32", rng: Optional[jax.Array] = None
-                ) -> jax.Array:
+                wire: str = "f32", rng: Optional[jax.Array] = None,
+                kernels: str = "xla") -> jax.Array:
     """Off-mesh reduce: out[j] = sum_c weights[c] * mat[c, j] in bucket
     layout — element-for-element the dense reduction (bit-equal for
     ``wire='f32'``; the wire casts apply per client since there is no
-    per-device partial to cast)."""
+    per-device partial to cast).
+
+    ``kernels='pallas'`` routes the int8 wire through the fused
+    quantize+reduce pallas kernel (ops/pallas_kernels.py): the
+    stochastic-rounding uniforms and per-bucket scale are computed here
+    with the exact rng call and spelling of the XLA chain, so the
+    backends are bit-identical (pinned by tests/test_pallas_kernels.py);
+    buckets that do not tile the kernel's panel fall back to the XLA
+    chain unchanged. The f32/bf16 wires have no quantize chain to fuse
+    and always use the tensordot spelling."""
     _check_wire(wire, rng)
     c, n = mat.shape
     w = weights.astype(jnp.float32)
@@ -630,6 +658,15 @@ def _reduce_mat(mat: jax.Array, weights: jax.Array, *,
     if wire == "bf16":
         buckets = buckets.astype(jnp.bfloat16).astype(jnp.float32)
     elif wire == "int8":
+        from ..ops import pallas_kernels as _pk
+
+        if kernels == "pallas" and \
+                _pk.quantize_reduce_supported(bucket_size):
+            u = jax.random.uniform(rng, buckets.shape)
+            scale = _int8_scale(buckets)
+            out = _pk.fused_quantize_reduce(buckets, w, u,
+                                            scale[..., 0])
+            return out.reshape(-1)[:n]
         q, scale = _quantize_int8(buckets, rng)
         buckets = q.astype(jnp.float32) * scale
     out = jnp.tensordot(w, buckets, axes=1)
@@ -740,7 +777,8 @@ def weighted_mean(stacked: Any, weights: jax.Array, *, mesh=None,
                   axis_name: str = "clients",
                   bucket_size: int = DEFAULT_BUCKET_SIZE,
                   wire: str = "f32", rng: Optional[jax.Array] = None,
-                  hier_inner: int = 0, overlap: bool = True) -> Any:
+                  hier_inner: int = 0, overlap: bool = True,
+                  kernels: str = "xla") -> Any:
     """Weighted mean over the leading client axis, via the bucketed
     (optionally low-precision-wire) reduce. Drop-in for
     ``core.state.weighted_tree_sum`` (callers pass already-normalized
@@ -754,7 +792,13 @@ def weighted_mean(stacked: Any, weights: jax.Array, *, mesh=None,
     ``wire`` across slices; 0 = auto-split via
     :func:`resolve_hier_inner`). Off-mesh there are no slices and the
     fallback is the EXACT f32 bucketed contraction — the one-slice
-    degeneration, in which the cross-slice wire never fires."""
+    degeneration, in which the cross-slice wire never fires.
+
+    ``kernels='pallas'`` fuses the off-mesh int8 wire's quantize+reduce
+    into one pallas pass (see :func:`_reduce_mat`; bit-identical by
+    contract). The on-mesh shard_map path keeps its per-device op chain
+    unchanged — its wire quantize runs per DEVICE inside the collective,
+    a different (and already collective-fused) dataflow."""
     _check_wire(wire, rng)
     leaves = jax.tree_util.tree_leaves(stacked)
     c = leaves[0].shape[0]
@@ -770,7 +814,8 @@ def weighted_mean(stacked: Any, weights: jax.Array, *, mesh=None,
     spec = flat_spec(stacked, stacked=True)
     vec = _reduce_mat(stacked_to_mat(stacked), weights,
                       bucket_size=bucket_size,
-                      wire="f32" if hier_inner else wire, rng=rng)
+                      wire="f32" if hier_inner else wire, rng=rng,
+                      kernels=kernels)
     return vec_to_tree(vec, spec)
 
 
@@ -781,7 +826,8 @@ def sparse_weighted_mean(stacked: Any, weights: jax.Array, plan: SparsePlan,
                          wire: str = "f32",
                          rng: Optional[jax.Array] = None,
                          hier_inner: int = 0,
-                         overlap: bool = True) -> Any:
+                         overlap: bool = True,
+                         kernels: str = "xla") -> Any:
     """Mask-aware sparse weighted mean: reduce only the plan's live
     coordinates — local compute and the cross-chip transfer scale with
     ~density — then rebuild the dense layout with one static inverse-
@@ -813,7 +859,8 @@ def sparse_weighted_mean(stacked: Any, weights: jax.Array, plan: SparsePlan,
             _expand_leaf(r, ix, x.shape[1:], x.dtype)
             for r, ix, x in zip(red, plan.idx, leaves)])
     kw = dict(bucket_size=bucket_size,
-              wire="f32" if hier_inner else wire, rng=rng)
+              wire="f32" if hier_inner else wire, rng=rng,
+              kernels=kernels)
     if masks is None:
         vec = _reduce_mat(_compress(stacked, plan), weights, **kw)
         return _expand_vec(vec, stacked, plan)
@@ -889,7 +936,7 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
                    impls: Tuple[str, ...] = AGG_IMPLS,
                    topk_density: float = 0.1, topk_sample: int = 0,
                    hier_inner: int = 0, hier_wire: str = "bf16",
-                   overlap: bool = True) -> dict:
+                   overlap: bool = True, kernels: str = "xla") -> dict:
     """Time one weighted-mean aggregation per ``agg_impl`` on the flagship
     parameter tree stacked over ``n_clients`` (honored-mask locals at
     ``dense_ratio``), sharded over ``mesh`` when given. Methodology
@@ -899,10 +946,18 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
     compile+warmup run. Returns ``{"agg_ms_<impl>": ms, ...}`` plus, per
     timed impl, the ``obs.comm.WireCostModel``'s modeled per-device wire
     bytes as ``wire_bytes_<impl>`` (so the gated bench history tracks
-    time AND bytes together) and the workload descriptors."""
+    time AND bytes together) and the workload descriptors.
+
+    ``kernels`` picks the selection/quantize backend for the impls that
+    have one (int8, topk, hier) — the flag surface plus the internal
+    ``'sort'`` legacy spelling, so the bench can still price the
+    pre-threshold sort baseline the kernel leg replaced."""
     from ..core.state import weighted_tree_sum
     from ..models import create_model, init_params
     from ..ops.sparsity import kernel_flags
+    from ..ops.topk_select import check_kernels
+
+    check_kernels(kernels)
 
     model = create_model(model_key, num_classes=1)
     shapes = jax.eval_shape(
@@ -948,12 +1003,13 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
                                                     **kw),
         "bf16": lambda st, wv, i: weighted_mean(st, wv, wire="bf16", **kw),
         "int8": lambda st, wv, i: weighted_mean(
-            st, wv, wire="int8", rng=jax.random.fold_in(key, i), **kw),
+            st, wv, wire="int8", rng=jax.random.fold_in(key, i),
+            kernels=kernels, **kw),
         "sparse": lambda st, wv, i: sparse_weighted_mean(st, wv, plan,
                                                          wire="f32", **kw),
         "topk": lambda st, wv, i: topk_weighted_mean(
             st, wv, topk_density, plan=plan, sample=topk_sample,
-            **kw)[0],
+            kernels=kernels, **kw)[0],
         # hier: auto slice split unless requested; int8 cross-slice wire
         # draws its stochastic-rounding key like the int8 impl
         "hier": lambda st, wv, i: (
@@ -962,7 +1018,7 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
             if hier_wire == "sparse" else weighted_mean(
                 st, wv, wire=hw, hier_inner=hier_inner or -1,
                 rng=(jax.random.fold_in(key, i) if hw == "int8"
-                     else None), **kw)),
+                     else None), kernels=kernels, **kw)),
     }
 
     def time_agg(agg_fn):
@@ -1000,5 +1056,6 @@ def agg_microbench(mesh=None, n_clients: int = 32, iters: int = 8,
         bucket_size=bucket_size, sparse_density=plan.density,
         topk_density=topk_density, topk_sample=topk_sample,
         hier_wire=hier_wire, hier_inner=hier_inner,
-        overlap=int(overlap), model_key=model_key, iters=iters)
+        overlap=int(overlap), model_key=model_key, iters=iters,
+        kernels=kernels)
     return result

@@ -50,6 +50,14 @@ def main(argv=None) -> dict:
     p.add_argument("--hier_wire", type=str, default="bf16",
                    choices=["f32", "bf16", "int8", "sparse"],
                    help="hier's cross-slice wire")
+    p.add_argument("--kernels", type=str, default="xla",
+                   choices=["xla", "pallas", "sort"],
+                   help="selection/quantize kernel backend for the "
+                        "int8/topk/hier impls (--agg_kernels surface "
+                        "plus the internal 'sort' legacy spelling, so "
+                        "the pre-threshold lax.top_k baseline stays "
+                        "priceable); non-default backends get their own "
+                        "-k<backend> history cells")
     p.add_argument("--overlap", type=int, default=1,
                    help="group-ordered dispatch (collective emitted "
                         "right after its group's contraction); 0 = the "
@@ -99,7 +107,7 @@ def main(argv=None) -> dict:
         model_key=args.model, sample_shape=sample_shape, impls=impls,
         topk_density=args.topk_density, topk_sample=args.topk_sample,
         hier_inner=args.hier_inner, hier_wire=args.hier_wire,
-        overlap=bool(args.overlap))
+        overlap=bool(args.overlap), kernels=args.kernels)
     out = {k: (round(v, 3) if isinstance(v, float) else v)
            for k, v in out.items()}
     print(json.dumps(out))
@@ -111,12 +119,13 @@ def _impl_qual(impl: str, out: dict, unit: str) -> str:
     """Non-default config knobs folded into the metric NAME (not just
     ``extra``): identical metric name = identical workload is the gated
     history's contract, so a ``--topk_density`` / ``--topk_sample`` /
-    ``--hier_inner`` / ``--hier_wire`` / ``--overlap 0`` sweep must
-    gate against its own trajectory, not get compared to (or pollute
-    the baseline of) the default config under the same name. Defaults
-    stay unqualified so the already-seeded history keeps matching.
-    Byte metrics skip the timing-only knobs (sample / overlap do not
-    change what the wire ships)."""
+    ``--hier_inner`` / ``--hier_wire`` / ``--overlap 0`` /
+    ``--kernels`` sweep must gate against its own trajectory, not get
+    compared to (or pollute the baseline of) the default config under
+    the same name. Defaults stay unqualified so the already-seeded
+    history keeps matching. Byte metrics skip the timing-only knobs
+    (sample / overlap / kernels do not change what the wire ships —
+    kernel backends are bit-identical by contract)."""
     q = ""
     if impl == "topk":
         if out.get("topk_density", 0.1) != 0.1:
@@ -128,6 +137,9 @@ def _impl_qual(impl: str, out: dict, unit: str) -> str:
             q += f"-hw{out['hier_wire']}"
         if out.get("hier_inner", 0):
             q += f"-hi{out['hier_inner']}"
+    if unit == "ms" and impl in ("int8", "topk", "hier") \
+            and out.get("kernels", "xla") != "xla":
+        q += f"-k{out['kernels']}"
     if unit == "ms" and impl != "dense" and not out.get("overlap", 1):
         q += "-ov0"
     return q
@@ -159,7 +171,8 @@ def _append_history(out: dict, history: str) -> int:
         extra = {k: out[k] for k in ("n_params", "bucket_size",
                                      "sparse_density", "topk_density",
                                      "topk_sample", "hier_wire",
-                                     "hier_inner", "overlap", "iters")
+                                     "hier_inner", "overlap", "iters",
+                                     "kernels")
                  if k in out}
         for prefix, metric_prefix, unit in (
                 ("agg_ms_", "agg_ms_", "ms"),

@@ -187,3 +187,55 @@ def test_unknown_impl_refused():
     bad.add_tensor("delta", {"w": np.zeros(3, np.float32)})
     with pytest.raises(ValueError):
         decode_update(Message.from_bytes(bad.to_bytes()))
+
+
+# ---------------------------------------------------------------------------
+# top-k encode: the argpartition selection is byte-identical to the
+# historical stable-argsort spelling (the wire tie-break contract)
+# ---------------------------------------------------------------------------
+
+def _legacy_topk_indices(flat: np.ndarray, k: int) -> np.ndarray:
+    """The pre-kernel-leg spelling of fed/wire._topk_leaf's selection."""
+    order = np.argsort(-np.abs(flat), kind="stable")[:k]
+    return np.sort(order).astype(np.int32)
+
+
+@st.composite
+def tie_heavy_arrays(draw):
+    """Flat f32 vectors with deliberate magnitude ties (quantized
+    values, sign flips, zero runs) — the hard case for any tie-break."""
+    n = draw(st.integers(1, 64))
+    vals = draw(st.lists(st.integers(-3, 3), min_size=n, max_size=n))
+    a = np.asarray(vals, np.float32)
+    if draw(st.booleans()):
+        a = a * np.float32(0.25)
+    return a
+
+
+@settings(max_examples=60, deadline=None)
+@given(a=tie_heavy_arrays(), density=st.sampled_from([0.1, 0.5, 0.9]))
+def test_topk_indices_match_legacy_argsort(a, density):
+    from neuroimagedisttraining_tpu.fed.wire import _topk_leaf
+    from neuroimagedisttraining_tpu.parallel.collectives import topk_count
+
+    idx, vals, shape = _topk_leaf(a, density)
+    ref = _legacy_topk_indices(a, topk_count(a.size, density))
+    assert idx.tobytes() == ref.tobytes(), (a.tolist(), density)
+    np.testing.assert_array_equal(vals, a[ref])
+
+
+@settings(max_examples=25, deadline=None)
+@given(tree=delta_trees())
+def test_topk_payload_bytes_match_legacy(tree):
+    """End-to-end: the encoded topk Message payload is byte-identical
+    to one built with the legacy argsort selection."""
+    from neuroimagedisttraining_tpu.fed import wire as fw
+
+    msg = _encode(tree, "topk")
+    orig = fw.host_topk_indices
+    try:
+        fw.host_topk_indices = _legacy_topk_indices
+        ref = _encode(tree, "topk")
+    finally:
+        fw.host_topk_indices = orig
+    assert msg.to_bytes() == ref.to_bytes()

@@ -126,3 +126,332 @@ def test_fused_kernels_round_matches_xla_round():
                       jax.tree_util.tree_leaves(sb.global_params)):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                    rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# kernel leg (--agg_kernels): threshold selection / fused quantize+reduce /
+# SNIP mask ops — pallas-interpret == XLA == reference, bitwise where the
+# tie-break contract promises it (ops/topk_select.py module docstring)
+# ---------------------------------------------------------------------------
+
+def _sort_threshold(av, k):
+    """The legacy sort spelling the threshold search replaced."""
+    return jax.lax.top_k(av, k)[0][..., -1:]
+
+
+def _threshold_cases():
+    rng = np.random.RandomState(7)
+    cont = rng.randn(4, 1000).astype(np.float32) * 0.01
+    ties = rng.randint(0, 5, (3, 640)).astype(np.float32)  # tie-heavy
+    ties[0, :17] = 0.0
+    zeros = np.zeros((2, 256), np.float32)  # all-zero rows
+    single = np.abs(rng.randn(1, 128)).astype(np.float32)
+    return [(np.abs(cont), 100), (np.abs(cont), 1), (np.abs(cont), 1000),
+            (ties, 64), (zeros, 8), (single, 128)]
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_threshold_backends_bit_identical(case):
+    """exact_threshold (XLA) == threshold_topk (pallas interpret) ==
+    lax.top_k (sort) == the f64 sorted reference, BITWISE — including
+    tie-heavy and all-zero rows (the k-th largest of f32 values is one
+    of them; every backend converges to the same integer bit pattern)."""
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import threshold_topk
+    from neuroimagedisttraining_tpu.ops.topk_select import exact_threshold
+
+    av, k = _threshold_cases()[case]
+    ref = np.sort(av.astype(np.float64), axis=-1)[:, ::-1][:, k - 1:k]
+    srt = np.asarray(_sort_threshold(jnp.asarray(av), k))
+    xla = np.asarray(exact_threshold(jnp.asarray(av), k))
+    pls = np.asarray(threshold_topk(jnp.asarray(av), k))
+    assert srt.tobytes() == xla.tobytes()
+    assert srt.tobytes() == pls.tobytes()
+    np.testing.assert_array_equal(xla.astype(np.float64), ref)
+
+
+def test_select_threshold_routing_and_validation():
+    from neuroimagedisttraining_tpu.ops import topk_select as ts
+
+    av = jnp.abs(jnp.asarray(
+        np.random.RandomState(0).randn(2, 512).astype(np.float32)))
+    outs = [np.asarray(ts.select_threshold(av, 50, kernels=kb))
+            for kb in ("sort", "xla", "pallas")]
+    assert outs[0].tobytes() == outs[1].tobytes() == outs[2].tobytes()
+    with pytest.raises(ValueError, match="agg_kernels"):
+        ts.check_kernels("cuda")
+    # VMEM-oversized rows fall back to the XLA search (same bits)
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import (
+        threshold_supported,
+    )
+
+    assert not threshold_supported(1 << 21)
+
+
+def test_topk_sparsify_backends_select_identical_sets():
+    """The acceptance contract: threshold selection (xla and pallas)
+    picks a BIT-IDENTICAL coordinate set to the legacy sort path."""
+    from neuroimagedisttraining_tpu.parallel import collectives as C
+
+    key = jax.random.PRNGKey(3)
+    tree = {"k": jax.random.normal(key, (5, 33, 9)) * 0.01,
+            "b": jax.random.normal(jax.random.fold_in(key, 1),
+                                   (5, 270)) * 0.01}
+    ref = C.topk_sparsify(tree, 0.1, bucket_size=128, kernels="sort")
+    for kb in ("xla", "pallas"):
+        got = C.topk_sparsify(tree, 0.1, bucket_size=128, kernels=kb)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(got)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), kb
+
+
+def test_sampled_threshold_calibration_band():
+    """The strided estimator (hoisted into ops/topk_select) stays the
+    DGC calibration the sampled path always had: same spelling as the
+    old inline block, and the selected count lands within a 2x band of
+    exact k on smooth magnitudes (drift the EF residual absorbs)."""
+    from neuroimagedisttraining_tpu.ops import topk_select as ts
+
+    av = jnp.abs(jnp.asarray(
+        np.random.RandomState(1).randn(2, 8192).astype(np.float32)))
+    k, sample = 819, 1024
+    thr = ts.sampled_threshold(av, k, sample)
+    # the pre-dedupe inline spelling, verbatim
+    stride = max(1, av.shape[-1] // sample)
+    cand = av[:, ::stride]
+    ks = min(cand.shape[1], max(1, int(round(k / stride))))
+    legacy = jax.lax.top_k(cand, ks)[0][:, -1:]
+    assert np.asarray(thr).tobytes() == np.asarray(legacy).tobytes()
+    # routed through select_threshold on EVERY backend (sampling is
+    # backend-independent: the subsample's top_k is already tiny)
+    for kb in ("sort", "xla", "pallas"):
+        got = ts.select_threshold(av, k, kernels=kb, sample=sample)
+        assert np.asarray(got).tobytes() == np.asarray(thr).tobytes()
+    counts = np.sum(np.asarray(av) >= np.asarray(thr), axis=1)
+    assert ((counts >= k / 2) & (counts <= 2 * k)).all(), counts
+
+
+def test_fused_quantize_reduce_bitwise_vs_xla_chain():
+    """weighted_mean(wire='int8', kernels='pallas') is BIT-identical to
+    the untouched XLA chain (same rng draw, same _int8_scale spelling,
+    same dot-contraction primitive), and within quantization tolerance
+    of the f64 accumulation of the same dequantized values."""
+    from neuroimagedisttraining_tpu.parallel import collectives as C
+
+    key = jax.random.PRNGKey(11)
+    tree = {"a": jax.random.normal(key, (6, 3, 3, 4, 8)) * 0.01,
+            "b": jax.random.normal(jax.random.fold_in(key, 1),
+                                   (6, 2048)) * 0.01,
+            "c": jax.random.normal(jax.random.fold_in(key, 2),
+                                   (6, 17)) * 0.01}
+    w = jnp.asarray(np.random.RandomState(2).rand(6).astype(np.float32))
+    w = w / w.sum()
+    rng = jax.random.PRNGKey(5)
+    run = {kb: jax.jit(lambda st, wv, _kb=kb: C.weighted_mean(
+        st, wv, wire="int8", rng=rng, bucket_size=1024,
+        kernels=_kb))(tree, w) for kb in ("xla", "pallas")}
+    for k in tree:
+        a = np.asarray(run["xla"][k])
+        b = np.asarray(run["pallas"][k])
+        assert a.tobytes() == b.tobytes(), k
+    # f64 reference of the reduce over the SAME dequantized f32 values
+    mat = np.asarray(C.stacked_to_mat(tree))
+    pad = (-mat.shape[1]) % 1024
+    mb = np.pad(mat, ((0, 0), (0, pad))).reshape(6, -1, 1024)
+    q, s = C._quantize_int8(jnp.asarray(mb), rng)
+    deq = np.asarray(q).astype(np.float64) * np.asarray(s).astype(
+        np.float64)
+    ref = np.tensordot(np.asarray(w).astype(np.float64), deq, axes=1)
+    got = np.concatenate([np.asarray(run["pallas"][k]).ravel()
+                          for k in tree])
+    np.testing.assert_allclose(
+        got, ref.reshape(-1)[:mat.shape[1]], rtol=1e-5, atol=1e-7)
+
+
+def test_quantize_reduce_unsupported_bucket_falls_back():
+    """Buckets that don't tile the kernel's 1024-element panel keep the
+    XLA chain (same results as kernels='xla' trivially)."""
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import (
+        quantize_reduce_supported,
+    )
+    from neuroimagedisttraining_tpu.parallel import collectives as C
+
+    assert quantize_reduce_supported(1024)
+    assert quantize_reduce_supported(1 << 18)
+    assert not quantize_reduce_supported(16)
+    tree = {"x": jax.random.normal(jax.random.PRNGKey(0), (3, 40))}
+    w = jnp.asarray([0.5, 0.25, 0.25], jnp.float32)
+    rng = jax.random.PRNGKey(1)
+    a = C.weighted_mean(tree, w, wire="int8", rng=rng, bucket_size=16,
+                        kernels="pallas")
+    b = C.weighted_mean(tree, w, wire="int8", rng=rng, bucket_size=16,
+                        kernels="xla")
+    assert np.asarray(a["x"]).tobytes() == np.asarray(b["x"]).tobytes()
+
+
+def test_fused_mask_ops_bitwise():
+    """fused_mask_apply == p*m and fused_score_mask == (s/norm >= thr),
+    bitwise (pure elementwise ops — IEEE-exact per op in interpret
+    mode), across leaf shapes that exercise the panel padding."""
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import (
+        fused_mask_apply,
+        fused_score_mask_leaf,
+    )
+
+    rng = np.random.RandomState(4)
+    for shape in [(7,), (33, 9), (3, 3, 4, 8), (1030,)]:
+        p = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        m = jnp.asarray((rng.rand(*shape) > 0.5).astype(np.float32))
+        got = fused_mask_apply({"l": p}, {"l": m})["l"]
+        assert np.asarray(got).tobytes() == np.asarray(p * m).tobytes()
+        s = jnp.abs(jnp.asarray(rng.randn(*shape).astype(np.float32)))
+        norm = jnp.sum(s)
+        thr = jnp.float32(0.3) / jnp.maximum(norm, 1e-9)
+        got = fused_score_mask_leaf(s, norm, thr)
+        ref = (s / norm >= thr).astype(jnp.float32)
+        assert np.asarray(got).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_mask_from_scores_backends_bit_identical():
+    """SNIP mask construction: sort == xla == pallas bitwise, including
+    a tie-heavy score tree (integer-valued scores)."""
+    from neuroimagedisttraining_tpu.ops.sparsity import mask_from_scores
+
+    rng = np.random.RandomState(5)
+    smooth = {"conv": {"kernel": jnp.asarray(
+        np.abs(rng.randn(3, 3, 4, 8)).astype(np.float32)),
+        "bias": jnp.asarray(np.abs(rng.randn(8)).astype(np.float32))}}
+    ties = {"conv": {"kernel": jnp.asarray(
+        rng.randint(0, 4, (8, 8, 2, 2)).astype(np.float32))}}
+    for scores, ratio in [(smooth, 0.3), (ties, 0.5)]:
+        ref = mask_from_scores(scores, ratio, kernels="sort")
+        for kb in ("xla", "pallas"):
+            got = mask_from_scores(scores, ratio, kernels=kb)
+            for a, b in zip(jax.tree_util.tree_leaves(ref),
+                            jax.tree_util.tree_leaves(got)):
+                assert np.asarray(a).tobytes() == \
+                    np.asarray(b).tobytes(), kb
+
+
+def test_salientgrads_agg_kernels_round_bit_identical():
+    """A full SalientGrads topk round under agg_kernels='pallas' equals
+    the 'xla' round BITWISE — mask build, selection, and re-mask all
+    route through the kernel leg and the tie-break contract holds
+    end-to-end."""
+    from neuroimagedisttraining_tpu.algorithms import SalientGrads
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = make_synthetic_federated(
+        n_clients=4, samples_per_client=16, test_per_client=4,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9,
+                     weight_decay=5e-4, grad_clip=10.0, local_epochs=1,
+                     steps_per_epoch=2, batch_size=8)
+    states = {}
+    for kb in ("xla", "pallas"):
+        a = SalientGrads(model, data, hp, loss_type="bce", frac=1.0,
+                         seed=0, dense_ratio=0.5, agg_impl="topk",
+                         agg_kernels=kb)
+        s = a.init_state(jax.random.PRNGKey(0))
+        s, _ = a.run_round(s, 0)
+        states[kb] = s
+    for la, lb in zip(
+            jax.tree_util.tree_leaves(states["xla"].global_params),
+            jax.tree_util.tree_leaves(states["pallas"].global_params)):
+        assert np.asarray(la).tobytes() == np.asarray(lb).tobytes()
+
+
+def test_base_rejects_unknown_agg_kernels():
+    from neuroimagedisttraining_tpu.algorithms import FedAvg
+    from neuroimagedisttraining_tpu.core.state import HyperParams
+    from neuroimagedisttraining_tpu.data import make_synthetic_federated
+    from neuroimagedisttraining_tpu.models import create_model
+
+    data = make_synthetic_federated(
+        n_clients=2, samples_per_client=8, test_per_client=4,
+        sample_shape=(8, 8, 8, 1), loss_type="bce", class_num=2)
+    model = create_model("small3dcnn", num_classes=1)
+    hp = HyperParams(lr=0.05, lr_decay=1.0, momentum=0.9,
+                     weight_decay=5e-4, grad_clip=10.0, local_epochs=1,
+                     steps_per_epoch=1, batch_size=8)
+    with pytest.raises(ValueError, match="agg_kernels"):
+        FedAvg(model, data, hp, loss_type="bce", agg_kernels="cuda")
+
+
+def test_runner_agg_kernels_twin_identical(tmp_path):
+    """Acceptance gate: agg_kernels=pallas vs =xla twin runs diff
+    `identical` through obs/diff.py on the int8 AND topk wires, with
+    the varied flag landing in the census's INERT bucket (it never
+    enters run identity)."""
+    from neuroimagedisttraining_tpu.experiments import (
+        parse_args,
+        run_experiment,
+    )
+    from neuroimagedisttraining_tpu.experiments.config import run_identity
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
+
+    def argv(tag, impl, kernels):
+        return ["--model", "small3dcnn", "--dataset", "synthetic",
+                "--client_num_in_total", "4", "--batch_size", "8",
+                "--epochs", "1", "--comm_round", "2", "--lr", "0.05",
+                "--frac", "1.0", "--frequency_of_the_test", "1",
+                "--agg_impl", impl, "--agg_bucket_size", "1024",
+                "--agg_kernels", kernels, "--obs", "1",
+                "--results_dir", str(tmp_path / tag / "results"),
+                "--log_dir", str(tmp_path / f"LOG{tag}")]
+
+    for impl in ("int8", "topk"):
+        outs = {}
+        for kb in ("xla", "pallas"):
+            tag = f"{impl}-{kb}"
+            outs[kb] = run_experiment(
+                parse_args(argv(tag, impl, kb), algo="fedavg"), "fedavg")
+        assert outs["xla"]["identity"] == outs["pallas"]["identity"]
+        assert "kernel" not in run_identity(
+            parse_args(argv("i", impl, "pallas"), algo="fedavg"),
+            "fedavg")
+        doc = obs_diff.diff_runs(
+            obs_diff.load_run(str(tmp_path / f"{impl}-xla" / "results" /
+                                  "synthetic")),
+            obs_diff.load_run(str(tmp_path / f"{impl}-pallas" /
+                                  "results" / "synthetic")))
+        assert obs_diff.expect_exit_code(doc, "identical") == 0, \
+            (impl, obs_diff.render_diff(doc))
+        assert "agg_kernels" in doc["planes"]["config"]["inert"]
+        pd = obs_diff.params_diff(outs["xla"]["state"].global_params,
+                                  outs["pallas"]["state"].global_params)
+        assert pd["identical"], (impl, pd["diverged"][:3])
+
+
+@pytest.mark.tpu
+def test_kernel_leg_compiles_non_interpret():
+    """Real-TPU tier (pytest -m tpu on a TPU host): the three kernel
+    families compile NON-interpret and keep the bit contracts the CPU
+    interpret tier pins."""
+    if jax.default_backend() != "tpu":  # pragma: no cover - TPU only
+        pytest.skip("requires a real TPU backend")
+    from neuroimagedisttraining_tpu.ops.pallas_kernels import (
+        fused_mask_apply,
+        threshold_topk,
+    )
+    from neuroimagedisttraining_tpu.ops.topk_select import exact_threshold
+    from neuroimagedisttraining_tpu.parallel import collectives as C
+
+    av = jnp.abs(jax.random.normal(jax.random.PRNGKey(0), (4, 4096)))
+    assert np.asarray(threshold_topk(av, 50)).tobytes() == \
+        np.asarray(exact_threshold(av, 50)).tobytes()
+    tree = {"x": jax.random.normal(jax.random.PRNGKey(1), (4, 4096))}
+    w = jnp.asarray([0.25] * 4, jnp.float32)
+    rng = jax.random.PRNGKey(2)
+    a = C.weighted_mean(tree, w, wire="int8", rng=rng, bucket_size=1024,
+                        kernels="pallas")
+    b = C.weighted_mean(tree, w, wire="int8", rng=rng, bucket_size=1024,
+                        kernels="xla")
+    np.testing.assert_allclose(np.asarray(a["x"]), np.asarray(b["x"]),
+                               rtol=1e-5, atol=1e-7)
+    m = {"x": jnp.ones((4, 4096), jnp.float32)}
+    got = fused_mask_apply(tree, m)
+    assert np.asarray(got["x"]).tobytes() == \
+        np.asarray(tree["x"]).tobytes()
