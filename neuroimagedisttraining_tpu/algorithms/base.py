@@ -164,6 +164,10 @@ class FedAlgorithm(abc.ABC):
         agg_kernels: str = "xla",
         fault_spec: str = "",
         guard: Optional[bool] = None,
+        robust_agg: str = "none",
+        robust_trim: float = 0.2,
+        robust_krum_f: int = 0,
+        robust_norm_bound: float = 5.0,
         obs_numerics: bool = False,
         donate_state: bool = False,
         client_store: str = "device",
@@ -270,12 +274,19 @@ class FedAlgorithm(abc.ABC):
         # injected). Both live in the shared central-aggregate round body
         # (_train_selected_weighted) — algorithms without one ignore them
         # (and the CLI runner refuses the flags for those).
-        from ..robust.faults import make_fault_fn, parse_fault_spec
+        from ..robust.faults import (make_fault_fn, make_labelflip_fn,
+                                     parse_fault_spec)
 
         self.fault_spec = parse_fault_spec(fault_spec)
         self.fault_fn = (make_fault_fn(self.fault_spec, seed)
                          if self.fault_spec is not None
                          and self.fault_spec.any_active else None)
+        # labelflip rides the DATA path (poisoned labels corrupt what the
+        # client learns from, before training) — a separate hook from the
+        # post-training delta injector, same key derivation
+        self.labelflip_fn = make_labelflip_fn(
+            self.fault_spec, seed,
+            num_classes=int(getattr(model, "num_classes", 2) or 2))
         self.guard_enabled = (bool(guard) if guard is not None
                               else self.fault_fn is not None)
         if self.fault_fn is not None and not self.guard_enabled \
@@ -294,6 +305,38 @@ class FedAlgorithm(abc.ABC):
             # metric contract)
             self._round_metric_names = tuple(self._round_metric_names) + (
                 "clients_dropped", "clients_quarantined")
+        # robust_agg: Byzantine-robust replacement for the central
+        # weighted mean (robust/aggregation.py — median / trimmed_mean /
+        # krum / multikrum / norm_krum over the stacked client deltas).
+        # Composes with every agg_impl: on a compressed wire the
+        # statistic runs on the wire-DECODED rows
+        # (collectives.wire_roundtrip_mat — ranking what the server
+        # receives, not what the sender held), and under agg_impl='topk'
+        # on the sparsified error-feedback rows. Orthogonal to the
+        # transform defenses (defense clips/noises the stacked locals
+        # first; the robust statistic then consumes the defended rows)
+        # and to the guard (the estimators read the quarantine's
+        # renormalized weights as their survivor mask).
+        from ..robust.aggregation import ROBUST_AGGS
+
+        if robust_agg not in ROBUST_AGGS:
+            raise ValueError(
+                f"robust_agg {robust_agg!r} not in {ROBUST_AGGS}")
+        self.robust_agg = robust_agg
+        if not 0.0 <= float(robust_trim) < 0.5:
+            raise ValueError(
+                f"robust_trim {robust_trim} must be in [0, 0.5) — "
+                "trimming half or more per side leaves no survivors")
+        self.robust_trim = float(robust_trim)
+        if int(robust_krum_f) < 0:
+            raise ValueError(
+                f"robust_krum_f {robust_krum_f} must be >= 0 "
+                "(0 = auto ceil(0.2 * cohort))")
+        self.robust_krum_f = int(robust_krum_f)
+        if float(robust_norm_bound) <= 0:
+            raise ValueError(
+                f"robust_norm_bound {robust_norm_bound} must be > 0")
+        self.robust_norm_bound = float(robust_norm_bound)
         self._retry_nonce = 0  # watchdog rollback-retry cohort re-draw
         # eval_clients: sampled-eval mode (SURVEY §7's O(N^2)-eval
         # hard-part): evaluate a fixed seeded subset of clients instead of
@@ -760,6 +803,57 @@ class FedAlgorithm(abc.ABC):
             return collectives.weighted_mean(
                 stacked, weights, wire=wire, **kw)
 
+    def _robust_wire(self) -> str:
+        """The wire format whose decode the robust statistic must rank:
+        the agg_impl's cross-chip payload format. f32 for the exact
+        impls (dense/bucketed/sparse are bit-equal contractions; topk
+        has its own sparsified-row path in :meth:`_topk_aggregate`)."""
+        if self.agg_impl in ("bf16", "int8"):
+            return self.agg_impl
+        if self.agg_impl == "hier" and \
+                self.agg_hier_wire in ("bf16", "int8"):
+            return self.agg_hier_wire
+        return "f32"
+
+    def _robust_aggregate(self, stacked, weights, global_params,
+                          rng=None):
+        """The ``--robust_agg`` central aggregate: replace the weighted
+        mean with a Byzantine-robust statistic over the stacked client
+        DELTAS (local − global; the estimators are shift-equivariant, so
+        working in delta space changes nothing for median/trimmed-mean/
+        Krum selection — but it is what norm_krum's clip stage and the
+        wire roundtrip are defined on).
+
+        On a compressed wire (bf16/int8, or hier's cross-slice wire)
+        each delta row is first pushed through the wire's encode/decode
+        (``collectives.wire_roundtrip_mat``): order statistics do not
+        commute with quantization, so the statistic must rank the values
+        the server would decode — int8 uses the round's ``agg_rng``
+        stochastic-rounding draw, keeping the round bit-deterministic.
+
+        ``lax.cond``-traceable with the same (stacked, weights)
+        signature as :meth:`_aggregate`, so ``guard.guarded_aggregate``
+        threads it unchanged: quarantine renormalizes the weights
+        (quarantined rows exactly 0 — the estimators' survivor mask) and
+        ``carry_if_empty`` covers the zero-survivor round."""
+        from ..parallel import collectives
+        from ..robust.aggregation import robust_combine_mat
+
+        with jax.named_scope("robust_aggregate"):
+            spec = collectives.flat_spec(stacked, stacked=True)
+            mat = collectives.stacked_to_mat(stacked)
+            gvec = collectives.tree_to_vec(global_params).astype(
+                jnp.float32)
+            deltas = mat - gvec[None]
+            deltas = collectives.wire_roundtrip_mat(
+                deltas, self._robust_wire(),
+                bucket_size=self.agg_bucket_size, rng=rng)
+            combined = robust_combine_mat(
+                deltas, weights, self.robust_agg,
+                trim_frac=self.robust_trim, krum_f=self.robust_krum_f,
+                norm_bound=self.robust_norm_bound)
+            return collectives.vec_to_tree(gvec + combined, spec)
+
     def _full_batches(self, hp: Optional[HyperParams] = None) -> bool:
         """Static guarantee for core.trainer's epoch fast path: every
         client's shard covers steps_per_epoch*batch_size samples, so all
@@ -906,6 +1000,14 @@ class FedAlgorithm(abc.ABC):
             n_sel = jnp.take(n_train, sel_idx)
             x_sel = jnp.take(x_train, sel_idx, axis=0)
             y_sel = jnp.take(y_train, sel_idx, axis=0)
+        if self.labelflip_fn is not None:
+            # label-flip poisons the DATA PATH (before training — the
+            # other fault kinds corrupt what leaves the client, this one
+            # corrupts what the client learns from). Keys off the
+            # population client id like the injector.
+            lf_idx = sel_idx if self._trace_pop_idx is None \
+                else self._trace_pop_idx
+            y_sel = self.labelflip_fn(y_sel, lf_idx, round_idx)
         s = sel_idx.shape[0]
         params0 = broadcast_tree(global_params, s)
         mask_b = broadcast_tree(mask, s)
@@ -969,6 +1071,16 @@ class FedAlgorithm(abc.ABC):
                         jnp.logical_not(finite).astype(jnp.float32))
             fstats = {"ok": ok, "clients_dropped": n_dropped,
                       "clients_quarantined": n_quar}
+        if self.robust_agg != "none" and self.agg_impl != "topk":
+            # the robust statistic REPLACES the weighted mean; same
+            # (stacked, weights) signature, so the guard threads it
+            # through guarded_aggregate unchanged
+            def agg_fn(st, wv):
+                return self._robust_aggregate(
+                    st, wv, global_params, agg_rng)
+        else:
+            def agg_fn(st, wv):
+                return self._aggregate(st, wv, agg_rng)
         if self.agg_impl == "topk":
             new_global, new_residual = self._topk_aggregate(
                 defended, global_params, residual, sel_idx, weights, ok)
@@ -976,12 +1088,10 @@ class FedAlgorithm(abc.ABC):
             from ..robust import guard as _guard
 
             new_global = _guard.guarded_aggregate(
-                defended, weights, ok,
-                lambda st, wv: self._aggregate(st, wv, agg_rng),
-                global_params)
+                defended, weights, ok, agg_fn, global_params)
             new_residual = residual
         else:
-            new_global = self._aggregate(defended, weights, agg_rng)
+            new_global = agg_fn(defended, weights)
             new_residual = residual
         return (new_global, params_out, jnp.mean(losses), fstats,
                 new_residual)
@@ -1038,12 +1148,35 @@ class FedAlgorithm(abc.ABC):
             comp = collectives.plan_dead_select(
                 comp, self._agg_sparse_plan)
         def run_topk(comp_in, w):
-            agg_update, sp = collectives.topk_weighted_mean(
-                comp_in, w, self.agg_topk_density,
-                plan=self._agg_sparse_plan, mesh=self._agg_mesh(),
-                bucket_size=self.agg_bucket_size,
-                overlap=self.agg_overlap,
-                sample=self.agg_topk_sample)
+            if self.robust_agg != "none":
+                # robust statistic under error feedback: sparsify each
+                # client's compensated delta as usual (the wire), then
+                # combine the SPARSIFIED rows robustly instead of
+                # weighted-mean — a rejected client's shipped
+                # coordinates still leave its residual (EF subtracts
+                # what was SENT, not what the server accepted; the
+                # rejected mass is simply gone, which is the point)
+                from ..robust.aggregation import robust_combine_mat
+
+                sp = collectives.topk_sparsify(
+                    comp_in, self.agg_topk_density,
+                    plan=self._agg_sparse_plan,
+                    bucket_size=self.agg_bucket_size,
+                    sample=self.agg_topk_sample)
+                agg_update = collectives.vec_to_tree(
+                    robust_combine_mat(
+                        collectives.stacked_to_mat(sp), w,
+                        self.robust_agg, trim_frac=self.robust_trim,
+                        krum_f=self.robust_krum_f,
+                        norm_bound=self.robust_norm_bound),
+                    collectives.flat_spec(sp, stacked=True))
+            else:
+                agg_update, sp = collectives.topk_weighted_mean(
+                    comp_in, w, self.agg_topk_density,
+                    plan=self._agg_sparse_plan, mesh=self._agg_mesh(),
+                    bucket_size=self.agg_bucket_size,
+                    overlap=self.agg_overlap,
+                    sample=self.agg_topk_sample)
             new_global = jax.tree_util.tree_map(
                 lambda g, u: (g + u).astype(g.dtype), global_params,
                 agg_update)

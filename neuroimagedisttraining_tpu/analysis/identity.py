@@ -75,6 +75,11 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "defense_type": ("identity", "def<type> lineage split"),
     "norm_bound": ("identity", "defense nb<f> component"),
     "stddev": ("identity", "weak-DP sd<f> component"),
+    "robust_agg": ("identity", "ragg<kind> — the robust statistic "
+                               "replaces the weighted mean, splits "
+                               "both lineages"),
+    "robust_trim": ("identity", "rtrim<f> trimmed_mean component"),
+    "robust_krum_f": ("identity", "rkf<n> krum-family component"),
     "fault_spec": ("identity", "flt... — injection changes the state "
                                "trajectory, splits both lineages"),
     "watchdog": ("identity", "wd... — retries change the trajectory"),

@@ -111,6 +111,23 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--stddev", type=float, default=0.025,
                    help="weak-DP Gaussian noise stddev "
                         "(robust_aggregation.py:52-55)")
+    p.add_argument("--robust_agg", type=str, default="none",
+                   choices=["none", "median", "trimmed_mean", "krum",
+                            "multikrum", "norm_krum"],
+                   help="Byzantine-robust aggregation statistic replacing "
+                        "the weighted mean over the stacked client updates "
+                        "(robust/aggregation.py). Composes with --agg_impl "
+                        "(the robust statistic ranks the wire-decoded rows "
+                        "for bf16/int8, the sparsified rows for topk), "
+                        "--guard quarantine (quarantined clients are masked "
+                        "rows), error feedback, and both fed modes")
+    p.add_argument("--robust_trim", type=float, default=0.2,
+                   help="per-side trim fraction for "
+                        "--robust_agg trimmed_mean (0 <= f < 0.5; the trim "
+                        "count clamps so at least one survivor row remains)")
+    p.add_argument("--robust_krum_f", type=int, default=0,
+                   help="assumed Byzantine count f for krum/multikrum/"
+                        "norm_krum (0 = auto: max(1, ceil(0.2*cohort)))")
 
     # -- fault tolerance (new: no reference equivalent — the reference has
     # no fault path at all; see README "Fault tolerance")
@@ -670,6 +687,17 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         from ..robust.faults import parse_fault_spec
 
         parse_fault_spec(fault_spec)  # raises ValueError on bad specs
+    # robust aggregation: range-check the estimator knobs at parse time
+    # (base.py re-validates for programmatic construction, but a typo'd
+    # CLI run must die before it builds a model)
+    if not 0.0 <= getattr(args, "robust_trim", 0.2) < 0.5:
+        raise ValueError(
+            f"--robust_trim {args.robust_trim} out of range [0, 0.5): "
+            "trimming half or more per side leaves no survivor rows")
+    if getattr(args, "robust_krum_f", 0) < 0:
+        raise ValueError(
+            f"--robust_krum_f {args.robust_krum_f} must be >= 0 "
+            "(0 = auto-resolve to max(1, ceil(0.2*cohort)))")
     # same rule for the flight-recorder trigger spec: a typo'd trigger
     # must die at parse time, not silently at the fault it was meant
     # to capture
@@ -776,6 +804,20 @@ def run_identity(args: argparse.Namespace, algo: Optional[str] = None,
         parts.append(f"nb{args.norm_bound:g}")
         if args.defense_type == "weak_dp":
             parts.append(f"sd{args.stddev:g}")
+    robust_agg = getattr(args, "robust_agg", "none")
+    if robust_agg != "none":
+        # the robust statistic replaces the weighted mean, changing the
+        # global trajectory on every round — splits BOTH lineages (same
+        # rule as defense_type). Only the knobs the chosen estimator
+        # actually reads enter the identity: trim_frac for trimmed_mean,
+        # krum_f for the krum family, norm_bound for norm_krum's clip.
+        parts.append(f"ragg{robust_agg}")
+        if robust_agg == "trimmed_mean":
+            parts.append(f"rtrim{getattr(args, 'robust_trim', 0.2):g}")
+        elif robust_agg in ("krum", "multikrum", "norm_krum"):
+            parts.append(f"rkf{getattr(args, 'robust_krum_f', 0)}")
+            if robust_agg == "norm_krum":
+                parts.append(f"rnb{getattr(args, 'norm_bound', 5.0):g}")
     if getattr(args, "fault_spec", ""):
         # fault injection changes the state trajectory, so it splits BOTH
         # log/stat_info and checkpoint lineages (unlike the guard alone,

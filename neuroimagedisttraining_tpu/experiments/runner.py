@@ -311,6 +311,12 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
         # Bit-identical to device residency — never enters identity.
         client_store=getattr(args, "client_store", "device"),
         store_hot_clients=getattr(args, "store_hot_clients", 64),
+        robust_agg=getattr(args, "robust_agg", "none"),
+        robust_trim=getattr(args, "robust_trim", 0.2),
+        robust_krum_f=getattr(args, "robust_krum_f", 0),
+        # norm_krum's clip bound rides the existing --norm_bound flag
+        # (it IS the norm_diff_clipping bound, applied per-row in-jit)
+        robust_norm_bound=getattr(args, "norm_bound", 5.0),
     )
     store_mode = getattr(args, "client_store", "device")
     if store_mode != "device":
@@ -355,6 +361,12 @@ def build_algorithm(args: argparse.Namespace, algo_name: str, data=None):
             "--fault_spec/--guard protect the CENTRAL aggregation round "
             f"(fedavg/salientgrads/ditto); {algo_name} has no central "
             "aggregate to guard")
+    if getattr(args, "robust_agg", "none") != "none" and \
+            algo_name not in ("fedavg", "salientgrads", "ditto"):
+        raise SystemExit(
+            f"--robust_agg {args.robust_agg} replaces the CENTRAL "
+            f"weighted mean (fedavg/salientgrads/ditto); {algo_name} "
+            "has no central aggregate to robustify")
     if getattr(args, "eval_cache", 0):
         if algo_name not in ("fedavg", "salientgrads"):
             raise SystemExit(

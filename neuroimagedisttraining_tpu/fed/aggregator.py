@@ -41,6 +41,13 @@ from . import protocol, wire
 
 logger = logging.getLogger(__name__)
 
+#: Byzantine norm screen: a member whose delta norm exceeds this factor
+#: times the median member norm is flagged (typed BYZANTINE event +
+#: fault-attribution naming the site). Detection only — survival comes
+#: from ``robust_agg``; an attacker below the screen still gets voted
+#: out by the robust statistic, it just isn't NAMED by the screen.
+BYZ_NORM_FACTOR = 10.0
+
 
 class FedAggregator(ServerManager):
     def __init__(self, comm, world_size: int, algo: Any, *, mode: str,
@@ -49,6 +56,8 @@ class FedAggregator(ServerManager):
                  retries: int = 2, backoff_s: float = 0.05,
                  wire_impl: str = "dense", wire_density: float = 0.1,
                  replay_trace: Optional[Dict[str, Any]] = None,
+                 robust_agg: str = "none", robust_trim: float = 0.2,
+                 robust_krum_f: int = 0, robust_norm_bound: float = 5.0,
                  log_path: str = "", events_path: str = ""):
         super().__init__(comm, rank=0, world_size=world_size)
         import jax
@@ -66,6 +75,20 @@ class FedAggregator(ServerManager):
         self.wire_impl = wire_impl
         self.wire_density = wire_density
         self.replay_trace = replay_trace
+        # robust_agg: Byzantine-robust statistic replacing the weighted
+        # sum (sync) / discounted delta sum (buffered) — the same
+        # robust/aggregation.py estimators the in-process round runs,
+        # here over SITE rows/deltas on the aggregator host
+        from ..robust.aggregation import ROBUST_AGGS
+
+        if robust_agg not in ROBUST_AGGS:
+            raise ValueError(
+                f"robust_agg {robust_agg!r} not in {ROBUST_AGGS}")
+        self.robust_agg = robust_agg
+        self.robust_trim = float(robust_trim)
+        self.robust_krum_f = int(robust_krum_f)
+        self.robust_norm_bound = float(robust_norm_bound)
+        self.byzantine_flags: Dict[int, int] = {}  # site -> flag count
         # buffered sites own fixed client blocks; sync re-partitions the
         # sampled cohort per round
         self.partition = protocol.partition_slots(
@@ -87,9 +110,56 @@ class FedAggregator(ServerManager):
             if log_path else None
         self.events = RoundLogWriter(events_path, force=True) \
             if events_path else None
+        self._norm_history: List[float] = []
         self._updates: "queue.Queue[Message]" = queue.Queue()
         self.register_message_receive_handler(
             protocol.MSG_FED_UPDATE, self._updates.put)
+
+    # -- Byzantine screen / robust combine --------------------------------
+    def _byzantine_screen(self, round_idx: int, sites: List[int],
+                          norms: List[float]) -> List[int]:
+        """Flag members whose delta norm exceeds ``BYZ_NORM_FACTOR`` x
+        the running median member norm (history + this round — the
+        history keeps the baseline honest-dominated even when one flush
+        holds too few members for a meaningful within-flush median).
+        Emits ONE typed BYZANTINE event naming the flagged sites.
+        Norms append in member order at aggregate time, so a trace
+        replay reproduces the identical screen decisions."""
+        self._norm_history.extend(float(x) for x in norms)
+        self._norm_history = self._norm_history[-256:]
+        med = float(np.median(np.asarray(self._norm_history,
+                                         np.float32)))
+        flagged = [int(s) for s, nm in zip(sites, norms)
+                   if nm > BYZ_NORM_FACTOR * max(med, 1e-12)]
+        if flagged:
+            for s in flagged:
+                self.byzantine_flags[s] = \
+                    self.byzantine_flags.get(s, 0) + 1
+            logger.warning(
+                "round %d BYZANTINE screen: sites %s ship deltas > "
+                "%gx the median member norm (%.3g)", round_idx,
+                flagged, BYZ_NORM_FACTOR, med)
+            self._event(round_idx, "BYZANTINE", sites=flagged,
+                        norm_median=med,
+                        norms={str(int(s)): float(n)
+                               for s, n in zip(sites, norms)})
+        return flagged
+
+    def _robust_combine(self, delta_mat: np.ndarray,
+                        weights: np.ndarray) -> np.ndarray:
+        """One robust [N] delta from the [M, N] member-delta matrix —
+        the same ``robust_combine_mat`` estimator the in-jit round body
+        runs, evaluated on the aggregator host (same function, same
+        inputs: deterministic for record AND replay)."""
+        import jax.numpy as jnp
+
+        from ..robust.aggregation import robust_combine_mat
+
+        return np.asarray(robust_combine_mat(
+            jnp.asarray(delta_mat), jnp.asarray(weights),
+            self.robust_agg, trim_frac=self.robust_trim,
+            krum_f=self.robust_krum_f,
+            norm_bound=self.robust_norm_bound), np.float32)
 
     # -- shared plumbing --------------------------------------------------
     def _send(self, msg: Message) -> None:
@@ -210,7 +280,37 @@ class FedAggregator(ServerManager):
         # renormalization degradation
         weights = n_sel.astype(jnp.float32)
         weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
-        self.global_params = weighted_tree_sum(stacked, weights)
+        # Byzantine norm screen: per-SITE delta norm of the shipped rows
+        # against the running median (detection; typed event)
+        gl = [np.asarray(x, np.float32)
+              for x in jax.tree_util.tree_leaves(self.global_params)]
+        site_norms = []
+        for k in received:
+            d2 = 0.0
+            for rl, g in zip(
+                    jax.tree_util.tree_leaves(rows_by_site[k]), gl):
+                d = np.asarray(rl, np.float32) - g[None]
+                d2 += float(np.sum(d * d))
+            site_norms.append(float(np.sqrt(d2)))
+        flagged = self._byzantine_screen(round_idx, received, site_norms)
+        if self.robust_agg != "none":
+            # the in-process _robust_aggregate, verbatim over the same
+            # [S]-stacked client rows: robust statistic on the deltas,
+            # survivor mask from the (renormalized) weights — loopback
+            # sync stays the bit-parity anchor under attack too
+            from ..parallel import collectives
+
+            spec = collectives.flat_spec(stacked, stacked=True)
+            gvec = collectives.tree_to_vec(self.global_params).astype(
+                jnp.float32)
+            combined = self._robust_combine(
+                np.asarray(collectives.stacked_to_mat(stacked)
+                           - gvec[None]),
+                np.asarray(weights, np.float32))
+            self.global_params = collectives.vec_to_tree(
+                jnp.asarray(np.asarray(gvec) + combined), spec)
+        else:
+            self.global_params = weighted_tree_sum(stacked, weights)
         loss = float(jnp.mean(losses))
         self.version = round_idx + 1
         status = "completed" if not missing else "quorum"
@@ -222,7 +322,8 @@ class FedAggregator(ServerManager):
             self._event(round_idx, "fed_quorum", sites_missing=missing)
         self._record({"round": round_idx, "train_loss": loss,
                       "sites_reported": len(received),
-                      "fed_status": status})
+                      "fed_status": status,
+                      "fed_byzantine_flagged": len(flagged)})
         return status
 
     # -- buffered async (FedBuff) ----------------------------------------
@@ -270,12 +371,37 @@ class FedAggregator(ServerManager):
         leaves, treedef = jax.tree_util.tree_flatten(g)
         deltas = [jax.tree_util.tree_flatten(d)[0]
                   for _, _, d, _, _ in members]
-        new_leaves = []
-        for i, leaf in enumerate(leaves):
-            out = leaf.copy()
-            for w, dl in zip(wnorm, deltas):
-                out += w * np.asarray(dl[i], np.float32)
-            new_leaves.append(out)
+        # Byzantine norm screen over the flush members (typed event)
+        member_sites = [site for site, _, _, _, _ in members]
+        norms = [float(np.sqrt(sum(
+            float(np.sum(np.square(np.asarray(dl_i, np.float32))))
+            for dl_i in dl))) for dl in deltas]
+        flagged = self._byzantine_screen(flush_idx, member_sites, norms)
+        if self.robust_agg != "none":
+            # robust statistic over the member deltas: the staleness-
+            # discounted weights keep gating MEMBERSHIP (a zero weight
+            # is a masked row) while influence is the estimator's —
+            # FedBuff's n/sqrt(1+tau) discount no longer scales a
+            # colluding stale attacker's pull, it only ranks it
+            mat = np.stack([np.concatenate(
+                [np.asarray(x, np.float32).ravel() for x in dl])
+                for dl in deltas])
+            combined = self._robust_combine(
+                mat, np.asarray(wnorm, np.float32))
+            new_leaves = []
+            off = 0
+            for leaf in leaves:
+                n = int(leaf.size)
+                new_leaves.append(
+                    leaf + combined[off:off + n].reshape(leaf.shape))
+                off += n
+        else:
+            new_leaves = []
+            for i, leaf in enumerate(leaves):
+                out = leaf.copy()
+                for w, dl in zip(wnorm, deltas):
+                    out += w * np.asarray(dl[i], np.float32)
+                new_leaves.append(out)
         self.global_params = jax.tree_util.tree_map(
             jnp.asarray, jax.tree_util.tree_unflatten(treedef, new_leaves))
         self.version += 1
@@ -292,7 +418,8 @@ class FedAggregator(ServerManager):
                       "fed_staleness_max": int(max(taus)),
                       "fed_staleness_mean": float(np.mean(taus)),
                       "fed_quorum_flush": bool(quorum),
-                      "fed_stale_drops": self.stale_drops})
+                      "fed_stale_drops": self.stale_drops,
+                      "fed_byzantine_flagged": len(flagged)})
 
     def run_buffered(self) -> None:
         for k in range(1, self.n_sites + 1):

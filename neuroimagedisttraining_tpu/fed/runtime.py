@@ -52,6 +52,8 @@ def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
     sets how long a fired straggle sleeps the REAL site process
     (default ``DEFAULT_STRAGGLE_S``). Example:
     ``"3:straggle=1.0:6.0"`` — site 3 always straggles, 6s per round.
+    ``"rank:byzantine"`` is sugar for ``rank:scale=1.0`` — an
+    always-lying site shipping the 100x-forged delta every round.
     Raises ``ValueError`` on malformed entries (parse-time validation,
     the derive() contract)."""
     out: Dict[int, Tuple[FaultSpec, float]] = {}
@@ -84,6 +86,10 @@ def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
                     f"fed_site_faults trailing field {tail!r} is neither "
                     "a fault clause nor a delay") from None
             rest = head
+        if rest == "byzantine":
+            # the Byzantine-role sugar: scale fires every round at the
+            # default 100x factor (parse_fault_spec's scale_factor)
+            rest = "scale=1.0"
         fs = parse_fault_spec(rest)
         if fs is None:
             raise ValueError(
@@ -229,6 +235,10 @@ def _make_aggregator(args, comm, world: int, algo,
         wire_impl=getattr(args, "agg_impl", "dense"),
         wire_density=getattr(args, "agg_topk_density", 0.1),
         replay_trace=replay,
+        robust_agg=getattr(args, "robust_agg", "none"),
+        robust_trim=getattr(args, "robust_trim", 0.2),
+        robust_krum_f=getattr(args, "robust_krum_f", 0),
+        robust_norm_bound=getattr(args, "norm_bound", 5.0),
         log_path=os.path.join(out_dir, "aggregator.jsonl"),
         events_path=os.path.join(out_dir, "aggregator.events.jsonl"))
 
@@ -288,6 +298,9 @@ def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
                            sorted(agg.staleness_hist.items())},
         "trace_path": trace_path, "out_dir": out_dir,
         "replayed": agg.replay_trace is not None,
+        "robust_agg": agg.robust_agg,
+        "byzantine_flags": {str(k): v for k, v in
+                            sorted(agg.byzantine_flags.items())},
         **fold, **agg.comm.counters.snapshot(),
     }
     with open(os.path.join(out_dir, "summary.json"), "w") as f:

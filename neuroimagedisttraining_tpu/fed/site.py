@@ -72,11 +72,27 @@ class SiteWorker(ClientManager):
 
     # -- fault model ------------------------------------------------------
     def _draw_faults(self, version: int):
+        """(straggled, dropped, byzantine, signflipped) for this round —
+        drawn from the shared ``fault_trace_round`` twin keyed by
+        ``(seed, version, rank)``, so the aggregator's analyzer can
+        reconstruct (and a replay re-forge) every fault offline."""
         if self.fault_spec is None or not self.fault_spec.any_active:
-            return False, False
+            return False, False, False, False
         tr = fault_trace_round(self.fault_spec, self.seed, version,
                                np.asarray([self.rank]))
-        return bool(tr["straggled"][0]), bool(tr["dropped"][0])
+        return (bool(tr["straggled"][0]), bool(tr["dropped"][0]),
+                bool(tr["byzantine"][0]), bool(tr["signflipped"][0]))
+
+    def _forge_factor(self, byzantine: bool, signflip: bool) -> float:
+        """The Byzantine delta multiplier this round: ``scale_factor``
+        when the scale draw fired (``rank:byzantine`` sugar = scale=1.0,
+        an always-on attacker), negated by a signflip draw."""
+        factor = 1.0
+        if byzantine:
+            factor *= float(self.fault_spec.scale_factor)
+        if signflip:
+            factor = -factor
+        return factor
 
     def _event(self, version: int, event_type: str, **extra) -> None:
         if self.events is not None:
@@ -89,7 +105,9 @@ class SiteWorker(ClientManager):
         version = int(msg.get("version"))
         mode = msg.get("mode")
         t0 = time.perf_counter()
-        straggled, dropped = self._draw_faults(version)
+        straggled, dropped, byzantine, signflip = \
+            self._draw_faults(version)
+        forged = byzantine or signflip
         if straggled and self.straggle_s > 0:
             # a REAL straggling process: the aggregator's round clock
             # (sync timeout / buffered staleness bound) sees this delay
@@ -116,6 +134,20 @@ class SiteWorker(ClientManager):
             rows, losses = self.trainer.train_sync(
                 params, msg.get_tensor("round_key"), version,
                 client_ids, slot_pos, int(msg.get("cohort_size")))
+            if forged:
+                # a LYING site: every row it ships is the forged delta
+                # g + factor*(row - g) — a real adversarial process on
+                # the wire, not a simulated slot. Pure in (seed,
+                # version, rank) + the deterministic trained rows, so
+                # the attack replays bit-for-bit.
+                factor = self._forge_factor(byzantine, signflip)
+                g32 = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x, np.float32), params)
+                rows = jax.tree_util.tree_map(
+                    lambda r, g: g[None] + np.float32(factor)
+                    * (np.asarray(r, np.float32) - g[None]), rows, g32)
+                self._event(version, "fed_site_byzantine",
+                            factor=factor)
             reply.add_tensor("rows", rows)
             reply.add_tensor("losses", losses)
             loss = float(np.mean(losses)) if losses.size else float("nan")
@@ -126,6 +158,13 @@ class SiteWorker(ClientManager):
                 self.seed, version, self.rank)
             delta, n_sum, loss = self.trainer.train_delta(
                 params, base_key, version, client_ids)
+            if forged:
+                factor = self._forge_factor(byzantine, signflip)
+                delta = jax.tree_util.tree_map(
+                    lambda d: np.float32(factor)
+                    * np.asarray(d, np.float32), delta)
+                self._event(version, "fed_site_byzantine",
+                            factor=factor)
             wire.encode_update(reply, delta, self.wire_impl,
                                density=self.wire_density)
             reply.add("n_sum", n_sum)
@@ -140,6 +179,7 @@ class SiteWorker(ClientManager):
                 "clients": int(client_ids.size),
                 "wall_s": time.perf_counter() - t0,
                 "fed_straggled": straggled,
+                "fed_byzantine": forged,
             })
 
     def _on_finish(self, msg: Message) -> None:

@@ -109,6 +109,8 @@ MEMORY_FIELDS = {
 #: per-round fault count fields summed into the fault summary
 FAULT_FIELDS = ("clients_dropped", "clients_quarantined",
                 "clients_straggled", "clients_byzantine",
+                "clients_signflipped", "clients_colluding",
+                "clients_labelflipped", "fed_byzantine_flagged",
                 "round_skipped")
 
 #: numerics precursor warning: a layer group whose max-abs gauge sits
@@ -271,7 +273,9 @@ def _analyze_memory(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 def _analyze_faults(records: List[Dict[str, Any]],
-                    metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+                    metrics: Optional[Dict[str, Any]],
+                    events: Optional[List[Dict[str, Any]]] = None
+                    ) -> Dict[str, Any]:
     totals = {f: 0.0 for f in FAULT_FIELDS}
     rounds_with = 0
     for r in records:
@@ -286,8 +290,21 @@ def _analyze_faults(records: List[Dict[str, Any]],
     for name, m in (metrics or {}).items():
         if name.startswith("fault_recovery_") and isinstance(m, dict):
             registry[name[len("fault_recovery_"):]] = m.get("value")
+    # Byzantine attribution: the fed aggregator's norm-screen events
+    # NAME the flagged sites (``sites`` on the raw event record) —
+    # fold them into site -> flag count so the report prints WHO
+    # attacked, not just how often the screen fired
+    byzantine_sites: Dict[str, int] = {}
+    for e in events or ():
+        if e.get("event_type") != "BYZANTINE":
+            continue
+        for s in e.get("sites") or (e.get("detail") or {}).get(
+                "sites") or ():
+            k = str(int(s))
+            byzantine_sites[k] = byzantine_sites.get(k, 0) + 1
     return {**{k: v for k, v in totals.items()},
-            "rounds_with_faults": rounds_with, "registry": registry}
+            "rounds_with_faults": rounds_with, "registry": registry,
+            "byzantine_sites": byzantine_sites}
 
 
 def _straggler_rounds(records: List[Dict[str, Any]],
@@ -713,10 +730,11 @@ def _analyze_comm(records: List[Dict[str, Any]],
 
 def _injected_fault_fn(config: Optional[Dict[str, Any]]):
     """``fn(round, retry) -> {"poisoned": [...], "dropped": [...],
-    "straggled": [...], "byzantine": [...]}`` of global client ids via
-    the deterministic fault-trace replay, or None when the run config
-    lacks a fault spec / cohort shape — the breach-attribution join's
-    evidence source."""
+    "straggled": [...], "byzantine": [...], "signflipped": [...],
+    "colluding": [...], "labelflipped": [...]}`` of global client ids
+    via the deterministic fault-trace replay, or None when the run
+    config lacks a fault spec / cohort shape — the breach-attribution
+    join's evidence source (it NAMES the attackers behind a breach)."""
     cfg = config or {}
     fault_spec = str(cfg.get("fault_spec") or "")
     num = int(cfg.get("client_num_in_total") or 0)
@@ -735,18 +753,15 @@ def _injected_fault_fn(config: Optional[Dict[str, Any]]):
         sel = replay_client_indexes(round_idx, num, per, retry=retry)
         tr = fault_trace_round(spec, seed, round_idx, sel)
         # EFFECTIVE faults, mirroring the health ledger's convention
-        # (obs/health.py): a straggle/byzantine draw overridden by NaN
-        # poison or a drop never reached the round program, and the
-        # breach timeline must name the same clients the ledger does
-        from .health import _effective_straggled
+        # (obs/health.py): a draw overridden further up the injector's
+        # chain (collude > byzantine/signflip > straggle; nan/drop
+        # remove the contribution entirely) never reached the round
+        # program, and the breach timeline must name the same clients
+        # the ledger does
+        from .health import _effective_masks
 
-        eff = {
-            "poisoned": tr["poisoned"],
-            "dropped": tr["dropped"],
-            "straggled": _effective_straggled(tr),
-            "byzantine": (tr["byzantine"] & ~tr["poisoned"]
-                          & ~tr["dropped"]),
-        }
+        eff = {"poisoned": tr["poisoned"], "dropped": tr["dropped"],
+               **_effective_masks(tr)}
         return {field: [int(c) for c, hit in zip(sel, flags) if hit]
                 for field, flags in eff.items()}
 
@@ -914,7 +929,7 @@ def analyze_records(records: List[Dict[str, Any]],
         "outlier_rounds": outliers,
         "stragglers": stragglers,
         "memory": _analyze_memory(rounds),
-        "faults": _analyze_faults(rounds, metrics),
+        "faults": _analyze_faults(rounds, metrics, events),
         "compile": _analyze_compile(metrics),
         "health": health,
         "numerics": numerics,
@@ -929,6 +944,9 @@ def analyze_records(records: List[Dict[str, Any]],
     flags += [f"missing_rounds_{len(analysis['rounds']['missing'])}"
               ] if analysis["rounds"]["missing"] else []
     flags += [f"degraded_site_{c}" for c in health["degraded_sites"]]
+    flags += [f"byzantine_site_{s}" for s in sorted(
+        analysis["faults"].get("byzantine_sites", {}),
+        key=lambda s: int(s))]
     flags += [f"drift_outlier_client_{c}"
               for c in numerics["client_outliers"]]
     flags += [f"numerics_fault_round_{a['round']}"
@@ -1128,6 +1146,12 @@ def render_report(analysis: Dict[str, Any]) -> str:
         lines.append(
             "faults: " + ", ".join(
                 f"{k}={f[k]:g}" for k in FAULT_FIELDS if f.get(k)))
+    if f.get("byzantine_sites"):
+        lines.append(
+            "byzantine sites (norm-screen flags): " + ", ".join(
+                f"site {s} x{n}" for s, n in sorted(
+                    f["byzantine_sites"].items(),
+                    key=lambda kv: int(kv[0]))))
     n = a.get("numerics") or {}
     if n.get("present"):
         lines.append("numerics (in-jit telemetry):")

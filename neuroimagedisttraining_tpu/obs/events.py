@@ -20,7 +20,8 @@ alive. This module is the single typed channel:
   sink that raises is logged and skipped: telemetry must never kill
   the run it observes.
 * :func:`events_from_record` — the record-derived event family
-  (``GUARD`` / ``WATCHDOG`` / ``DRIFT``), shared by the SLO engine so
+  (``GUARD`` / ``WATCHDOG`` / ``DRIFT`` / ``BYZANTINE``), shared by
+  the SLO engine so
   every event flows through one path. The SLO engine itself adds
   ``SLO_BREACH`` / ``BUDGET_BURN`` / ``HEALTH_TRANSITION``
   (obs/slo.py).
@@ -58,10 +59,20 @@ EVENT_TYPES = {
     "GUARD": "warning",            # in-jit quarantine fired this round
     "WATCHDOG": "error",           # rollback-retry / skip verdict
     "DRIFT": "warning",            # non-finite per-client drift
+    "BYZANTINE": "error",          # adversarial clients/sites this round
     "SLO_BREACH": "error",         # an SLO objective entered violation
     "BUDGET_BURN": "warning",      # multi-window burn-rate alert
     "HEALTH_TRANSITION": "info",   # run-health state machine moved
 }
+
+#: record fields whose positive counts mark an adversarial round: the
+#: in-process fault-replay counters (stamped by the runner's obs path)
+#: plus the fed aggregator's norm-screen flag count — one BYZANTINE
+#: event per round lists every nonzero field in its detail.
+BYZANTINE_FIELDS = (
+    "clients_byzantine", "clients_signflipped", "clients_colluding",
+    "clients_labelflipped", "fed_byzantine_flagged",
+)
 
 
 def severity_label(severity: int) -> str:
@@ -138,8 +149,9 @@ def event_key(rec: Dict[str, Any]):
 
 def events_from_record(record: Dict[str, Any]) -> List[Event]:
     """The record-derived events of one FLUSHED round record, in a
-    fixed deterministic order (GUARD, WATCHDOG, DRIFT). Reads only
-    already-materialized scalars — no device sync, no RNG."""
+    fixed deterministic order (GUARD, WATCHDOG, DRIFT, BYZANTINE).
+    Reads only already-materialized scalars — no device sync, no
+    RNG."""
     out: List[Event] = []
     r = record.get("round")
     if not isinstance(r, (int, float)) or int(r) < 0:
@@ -169,6 +181,15 @@ def events_from_record(record: Dict[str, Any]) -> List[Event]:
             "non-finite client drift in slot(s) "
             + ",".join(str(j) for j in bad),
             {"slots": bad}))
+    byz = {f: float(record.get(f) or 0) for f in BYZANTINE_FIELDS
+           if isinstance(record.get(f), (int, float))
+           and record.get(f) > 0}
+    if byz:
+        total = sum(byz.values())
+        out.append(make_event(
+            "BYZANTINE", r,
+            f"{total:g} adversarial contribution(s) this round "
+            "(" + ",".join(sorted(byz)) + ")", byz))
     return out
 
 

@@ -50,16 +50,41 @@ def _round_indices(records: List[Dict[str, Any]]) -> List[int]:
 def _effective_straggled(tr: Dict[str, Any]):
     """Straggle draws that actually took effect in the round program:
     ``make_fault_fn`` lets Byzantine scaling override the straggle
-    factor and NaN poison override every delta transform, and a dropped
-    client's payload never reaches the server at all."""
+    factor, a colluding client's delta is REPLACED by the shared attack
+    direction, NaN poison overrides every delta transform, and a
+    dropped client's payload never reaches the server at all. (A
+    signflip does NOT mask a straggle — the negation composes with the
+    straggle factor, so both draws show in the shipped delta.)"""
     import numpy as np
 
     return np.logical_and.reduce([
         tr["straggled"],
         np.logical_not(tr["byzantine"]),
+        np.logical_not(tr["colluding"]),
         np.logical_not(tr["poisoned"]),
         np.logical_not(tr["dropped"]),
     ])
+
+
+def _effective_masks(tr: Dict[str, Any]) -> Dict[str, Any]:
+    """The per-kind draws that actually shipped an adversarial delta,
+    after the injector's override chain (collude > byzantine/signflip >
+    straggle; nan poisons everything; drop withholds everything).
+    ``labelflipped`` is a DATA-path fault — it survives every delta
+    transform except drop/nan (which remove the round's contribution
+    entirely)."""
+    import numpy as np
+
+    alive = np.logical_not(tr["poisoned"]) \
+        & np.logical_not(tr["dropped"])
+    not_collude = np.logical_not(tr["colluding"])
+    return {
+        "byzantine": tr["byzantine"] & alive & not_collude,
+        "signflipped": tr["signflipped"] & alive & not_collude,
+        "colluding": tr["colluding"] & alive,
+        "labelflipped": tr["labelflipped"] & alive,
+        "straggled": _effective_straggled(tr),
+    }
 
 
 def replay_client_indexes(round_idx: int, num_clients: int,
@@ -88,9 +113,10 @@ def make_fault_counts_fn(fault_spec: str, seed: int, num_clients: int,
                          clients_per_round: int):
     """Per-round fault-count stamper for the runner's obs path: returns
     ``fn(round, retry=0) -> {"clients_straggled",
-    "clients_byzantine"}`` counted over that round's REPLAYED cohort
-    (drop/quarantine counts are measured in-jit by the guard and
-    deliberately not replayed here). Returns None when the spec
+    "clients_byzantine", "clients_signflipped", "clients_colluding",
+    "clients_labelflipped"}`` counted over that round's REPLAYED
+    cohort (drop/quarantine counts are measured in-jit by the guard
+    and deliberately not replayed here). Returns None when the spec
     injects nothing."""
     from ..robust.faults import fault_trace_round, parse_fault_spec
 
@@ -102,11 +128,13 @@ def make_fault_counts_fn(fault_spec: str, seed: int, num_clients: int,
         sel = replay_client_indexes(
             round_idx, num_clients, clients_per_round, retry=retry)
         tr = fault_trace_round(spec, seed, round_idx, sel)
+        eff = _effective_masks(tr)
         return {
-            "clients_straggled": float(_effective_straggled(tr).sum()),
-            "clients_byzantine": float(
-                (tr["byzantine"] & ~tr["poisoned"]
-                 & ~tr["dropped"]).sum()),
+            "clients_straggled": float(eff["straggled"].sum()),
+            "clients_byzantine": float(eff["byzantine"].sum()),
+            "clients_signflipped": float(eff["signflipped"].sum()),
+            "clients_colluding": float(eff["colluding"].sum()),
+            "clients_labelflipped": float(eff["labelflipped"].sum()),
         }
 
     return counts
@@ -153,6 +181,9 @@ def build_health_ledger(records: List[Dict[str, Any]],
     poisoned = np.zeros(num_clients, np.int64)
     straggled = np.zeros(num_clients, np.int64)
     byzantine = np.zeros(num_clients, np.int64)
+    signflipped = np.zeros(num_clients, np.int64)
+    colluding = np.zeros(num_clients, np.int64)
+    labelflipped = np.zeros(num_clients, np.int64)
     # in-jit numerics drift (obs/numerics.py, obs_schema v2): per-slot
     # ``num_drift_s<j>`` record keys map to global clients through the
     # SAME participation replay — per-site drift trajectories join the
@@ -185,11 +216,14 @@ def build_health_ledger(records: List[Dict[str, Any]],
             from ..robust.faults import fault_trace_round
 
             tr = fault_trace_round(spec, seed, r, sel)
+            eff = _effective_masks(tr)
             dropped[sel] += tr["dropped"]
             poisoned[sel] += tr["poisoned"]
-            straggled[sel] += _effective_straggled(tr)
-            byzantine[sel] += (tr["byzantine"] & ~tr["poisoned"]
-                               & ~tr["dropped"])
+            straggled[sel] += eff["straggled"]
+            byzantine[sel] += eff["byzantine"]
+            signflipped[sel] += eff["signflipped"]
+            colluding[sel] += eff["colluding"]
+            labelflipped[sel] += eff["labelflipped"]
         for j, v in drift_slots(rec_of.get(r) or {}).items():
             if j >= len(sel):
                 continue
@@ -219,6 +253,9 @@ def build_health_ledger(records: List[Dict[str, Any]],
             "quarantined": int(poisoned[c]),
             "straggled": int(straggled[c]),
             "byzantine": int(byzantine[c]),
+            "signflipped": int(signflipped[c]),
+            "colluding": int(colluding[c]),
+            "labelflipped": int(labelflipped[c]),
             "eval_points": len(traj),
             "last_acc": traj[-1] if traj else None,
             "drift_points": int(drift_points[c]),
@@ -236,6 +273,13 @@ def build_health_ledger(records: List[Dict[str, Any]],
         if participated[c] and \
                 faults / float(participated[c]) >= DEGRADED_FAULT_RATE:
             reasons.append("fault_rate")
+        attacks = int(byzantine[c] + signflipped[c] + colluding[c]
+                      + labelflipped[c])
+        if participated[c] and \
+                attacks / float(participated[c]) >= DEGRADED_FAULT_RATE:
+            # an ATTACKING site is degraded by attribution, not by
+            # health: the replayed trace names it an adversary
+            reasons.append("adversarial")
         if len(traj) >= MIN_TREND_POINTS:
             half = len(traj) // 2
             early = float(np.mean(traj[:half]))
@@ -259,11 +303,14 @@ def render_health(ledger: Dict[str, Any]) -> str:
                 else " (no fault replay: fault_spec empty/unavailable)")]
     for c, s in sorted(ledger["sites"].items(), key=lambda kv: int(kv[0])):
         noteworthy = s["degraded"] or s["dropped"] or s["quarantined"] \
-            or s["straggled"] or s["byzantine"]
+            or s["straggled"] or s["byzantine"] \
+            or s.get("signflipped") or s.get("colluding") \
+            or s.get("labelflipped")
         if not noteworthy:
             continue
         bits = [f"site {c}: participated {s['rounds_participated']}"]
-        for k in ("dropped", "quarantined", "straggled", "byzantine"):
+        for k in ("dropped", "quarantined", "straggled", "byzantine",
+                  "signflipped", "colluding", "labelflipped"):
             if s[k]:
                 bits.append(f"{k} {s[k]}")
         if s["last_acc"] is not None:

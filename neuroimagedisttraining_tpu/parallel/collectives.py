@@ -186,6 +186,44 @@ def _quantize_int8(x: jax.Array, rng: jax.Array):
     return q.astype(jnp.int8), scale
 
 
+def wire_roundtrip_mat(mat: jax.Array, wire: str, *,
+                       bucket_size: int = DEFAULT_BUCKET_SIZE,
+                       rng: Optional[jax.Array] = None) -> jax.Array:
+    """Encode+decode each row of an ``[S, N]`` client-delta matrix
+    through the ``wire`` format — WHAT THE SERVER WOULD SEE after the
+    cross-chip hop, without reducing.
+
+    The low-precision wires commute with the weighted SUM (cast, then
+    accumulate in f32 — the ``_reduce_mat`` contract) but NOT with order
+    statistics: a robust aggregator must rank the values the receiver
+    decodes, not the f32 values the sender held, or the robust statistic
+    silently runs on data the wire never carried. ``robust_agg`` on a
+    compressed ``agg_impl`` therefore pushes every row through this
+    roundtrip before the statistic.
+
+    bf16 is the plain double cast; int8 pads each row to whole
+    ``bucket_size`` buckets and applies the per-(row, bucket)
+    stochastic-rounded quantization — the IDENTICAL ``_quantize_int8``
+    spelling the reducing wire uses, so one client's decoded row here
+    matches its contribution there bit-for-bit when given the same
+    rng. f32 is the identity."""
+    _check_wire(wire, rng)
+    if wire == "f32":
+        return mat
+    if wire == "bf16":
+        return mat.astype(jnp.bfloat16).astype(jnp.float32)
+    s, n = mat.shape
+    b = min(bucket_size, max(n, 1))
+    nb = -(-n // b)
+    pad = nb * b - n
+    if pad:
+        mat = jnp.pad(mat, ((0, 0), (0, pad)))
+    buckets = mat.reshape(s, nb, b)
+    q, scale = _quantize_int8(buckets, rng)
+    deq = q.astype(jnp.float32) * scale
+    return deq.reshape(s, -1)[:, :n]
+
+
 import inspect as _inspect
 
 #: portable "disable the static replication check" kwarg — ``check_vma``

@@ -1,10 +1,18 @@
 from .aggregation import (
+    ROBUST_AGGS,
     RobustAggregator,
     add_gaussian_noise,
     norm_diff_clipping,
-    vectorize_weights,
+    resolve_krum_f,
+    robust_combine_mat,
 )
-from .faults import FaultSpec, make_fault_fn, parse_fault_spec
+from .faults import (
+    FaultSpec,
+    fault_trace_round,
+    make_fault_fn,
+    make_labelflip_fn,
+    parse_fault_spec,
+)
 from .guard import (
     carry_if_empty,
     finite_screen,
@@ -15,12 +23,16 @@ from .guard import (
 from .recovery import RoundWatchdog, tree_finite
 
 __all__ = [
+    "ROBUST_AGGS",
     "RobustAggregator",
     "add_gaussian_noise",
     "norm_diff_clipping",
-    "vectorize_weights",
+    "resolve_krum_f",
+    "robust_combine_mat",
     "FaultSpec",
+    "fault_trace_round",
     "make_fault_fn",
+    "make_labelflip_fn",
     "parse_fault_spec",
     "carry_if_empty",
     "finite_screen",

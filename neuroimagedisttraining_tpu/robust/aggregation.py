@@ -1,20 +1,42 @@
-"""Byzantine-robust aggregation defenses.
+"""Byzantine-robust aggregation: transform defenses + robust estimators.
 
-Re-design of ``fedml_core/robustness/robust_aggregation.py``: norm-difference
-clipping (:38-50, ``diff / max(1, |diff|/bound)``) and weak-DP Gaussian noise
-(:52-55), as pure pytree functions vmappable over the client axis so the
-whole defense runs inside the jitted round program.
+Two generations of defense live here:
 
-The reference's ``is_weight_param`` filter (:28-29) exists to skip BN running
-stats; this framework uses GroupNorm (no running stats), so every parameter
-leaf participates — ``vectorize_weights`` keeps the name for parity.
+* **Transform defenses** (re-design of
+  ``fedml_core/robustness/robust_aggregation.py``): norm-difference
+  clipping (:38-50, ``diff / max(1, |diff|/bound)``) and weak-DP Gaussian
+  noise (:52-55), pure pytree functions vmappable over the client axis so
+  the whole defense runs inside the jitted round program. They transform
+  every client's update and leave the weighted mean in place — a *finite*
+  poisoned update still votes (bounded, but it votes).
+* **Robust estimators** (``--robust_agg``): the weighted mean itself is
+  REPLACED by a Byzantine-robust statistic over the stacked client
+  deltas — coordinate-wise median / β-trimmed mean (Yin et al., 2018,
+  "Byzantine-Robust Distributed Learning") and Krum / Multi-Krum
+  pairwise-distance selection (Blanchard et al., 2017, "Machine Learning
+  with Adversaries"), plus ``norm_krum`` = Krum with the transform
+  defenses' norm clip as its pre-selection stage. All are jit-pure
+  functions of a ``[S, D]`` delta matrix and the aggregation weights,
+  traceable under ``lax.cond`` so they slot into
+  ``guard.guarded_aggregate`` unchanged.
 
-Composition with the aggregation subsystem (``parallel/collectives.py``):
-defenses transform the [C, ...]-stacked LOCAL models before the central
-weighted mean runs, so every ``agg_impl`` (dense / bucketed / bf16 / int8 /
-sparse) consumes defended trees unchanged — the defense never sees, and
-never needs to see, the wire format. The flattening both layers use is one
-definition (``collectives.tree_to_vec``).
+Quarantine convention: the estimators take the guard's survivor set from
+the WEIGHTS — a zero aggregation weight means "this row never reported"
+(exactly what ``guard.quarantine`` produces). This matters because order
+statistics are not weighted-linear: the guard's zero-row trick is exact
+for the weighted mean but a zeroed row would VOTE in a median, so the
+estimators mask on ``weights > 0`` instead of trusting row contents.
+
+The estimators are UNWEIGHTED over the survivor set (the classical
+definitions): sample-count weights gate membership, not influence —
+a deliberate deviation recorded in PARITY.md.
+
+The reference's ``is_weight_param`` filter (:28-29) exists to skip BN
+running stats; this framework uses GroupNorm (no running stats), so every
+parameter leaf participates. The flattening shared with the aggregation
+buckets is ONE definition: ``parallel.collectives.tree_to_vec`` (the
+former ``vectorize_weights`` alias — an orphaned duplicate with no
+callers — is deleted; see tests/test_robust_e2e.py).
 """
 from __future__ import annotations
 
@@ -23,14 +45,119 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import tree_to_vec
+#: the ``--robust_agg`` family ("none" = plain weighted mean)
+ROBUST_AGGS = ("none", "median", "trimmed_mean", "krum", "multikrum",
+               "norm_krum")
 
 
-def vectorize_weights(tree: Any) -> jax.Array:
-    """Flatten a parameter pytree into one vector
-    (robust_aggregation.py:4-9; shared with the aggregation buckets —
-    ``parallel.collectives.tree_to_vec``)."""
-    return tree_to_vec(tree)
+def resolve_krum_f(krum_f: int, n: int) -> int:
+    """The Krum Byzantine allowance ``f`` for an ``n``-row cohort:
+    an explicit positive setting wins; 0 (the ``--robust_krum_f``
+    default) auto-resolves to ``max(1, ceil(0.2 * n))`` — the ≤20%
+    attacker budget the acceptance scenario assumes. Static (python int):
+    the neighbor count must be shape-level, not traced."""
+    if krum_f > 0:
+        return int(krum_f)
+    return max(1, -(-n // 5))
+
+
+def _masked_median(mat: jax.Array, ok: jax.Array,
+                   m: jax.Array) -> jax.Array:
+    """Coordinate-wise median over the ``ok`` rows of ``[S, D]`` ``mat``.
+    Masked rows sort to +inf (a select, never arithmetic — NaN in a
+    quarantined row cannot propagate); with ``m`` survivors the median
+    reads sorted rows ``(m-1)//2`` and ``m//2`` (equal for odd ``m``, so
+    the 0.5*(x+x) spelling is bit-exact there)."""
+    big = jnp.where(ok[:, None], mat, jnp.inf)
+    srt = jnp.sort(big, axis=0)
+    lo = jnp.maximum((m - 1) // 2, 0)
+    hi = jnp.maximum(m // 2, 0)
+    return 0.5 * (srt[lo] + srt[hi])
+
+
+def _masked_trimmed_mean(mat: jax.Array, ok: jax.Array, m: jax.Array,
+                         trim_frac: float) -> jax.Array:
+    """Coordinate-wise β-trimmed mean: per coordinate, drop the
+    ``floor(β·m)`` largest and smallest survivor values, average the
+    rest. The trim clamps to ``(m-1)//2`` per side so at least one row
+    always remains (a tiny cohort with a big β degrades toward the
+    median, never to an empty mean)."""
+    s = mat.shape[0]
+    big = jnp.where(ok[:, None], mat, jnp.inf)
+    srt = jnp.sort(big, axis=0)
+    t = jnp.floor(trim_frac * m.astype(jnp.float32)).astype(jnp.int32)
+    t = jnp.clip(t, 0, jnp.maximum((m - 1) // 2, 0))
+    idx = jnp.arange(s)[:, None]
+    keep = jnp.logical_and(idx >= t, idx < m - t)
+    cnt = jnp.maximum(m - 2 * t, 1).astype(jnp.float32)
+    return jnp.sum(jnp.where(keep, srt, 0.0), axis=0) / cnt
+
+
+def _krum_scores(rows: jax.Array, ok: jax.Array, m: jax.Array,
+                 f_eff: int) -> jax.Array:
+    """Krum scores: for each survivor row, the sum of its ``m - f - 2``
+    smallest squared distances to OTHER survivors (non-survivors are
+    masked out of both the candidate and neighbor sets). Distances via
+    the Gram expansion (an [S,S,D] broadcast would materialize the whole
+    cohort squared), clamped at 0 against cancellation."""
+    s = rows.shape[0]
+    sq = jnp.sum(rows * rows, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (rows @ rows.T)
+    d2 = jnp.maximum(d2, 0.0)
+    eye = jnp.eye(s, dtype=bool)
+    valid = jnp.logical_and(ok[None, :], jnp.logical_not(eye))
+    d2 = jnp.where(valid, d2, jnp.inf)
+    srt = jnp.sort(d2, axis=1)
+    nb = jnp.clip(m - f_eff - 2, 1, jnp.maximum(m - 1, 1))
+    nbmask = jnp.arange(s)[None, :] < nb
+    scores = jnp.sum(jnp.where(nbmask, srt, 0.0), axis=1)
+    return jnp.where(ok, scores, jnp.inf)
+
+
+def robust_combine_mat(mat: jax.Array, weights: jax.Array, kind: str, *,
+                       trim_frac: float = 0.2, krum_f: int = 0,
+                       norm_bound: float = 5.0) -> jax.Array:
+    """Combine the ``[S, D]`` delta rows into ONE ``[D]`` robust delta.
+
+    ``weights`` are the round's aggregation weights — their only role
+    here is the survivor mask (``weights > 0``; see module docstring).
+    Jit-pure and ``lax.cond``-traceable; deterministic tie-breaks
+    (argmin/argsort pick the first/lowest index). With zero survivors
+    the result is garbage by construction — ``guard.carry_if_empty``
+    selects the fallback before it can matter."""
+    if kind not in ROBUST_AGGS or kind == "none":
+        raise ValueError(
+            f"robust_combine_mat: kind {kind!r} not a robust estimator "
+            f"(one of {ROBUST_AGGS[1:]})")
+    mat = mat.astype(jnp.float32)
+    ok = weights > 0
+    m = jnp.sum(ok.astype(jnp.int32))
+    if kind == "median":
+        return _masked_median(mat, ok, m)
+    if kind == "trimmed_mean":
+        return _masked_trimmed_mean(mat, ok, m, trim_frac)
+    f_eff = resolve_krum_f(krum_f, mat.shape[0])
+    rows = mat
+    if kind == "norm_krum":
+        # the transform defenses' norm clip (norm_diff_clipping's
+        # diff/max(1, |diff|/bound) formula) as Krum's pre-selection
+        # stage: selection runs on clipped rows and the WINNER is the
+        # clipped row, so even a mis-selected attacker is norm-bounded
+        norms = jnp.sqrt(jnp.sum(rows * rows, axis=1, keepdims=True))
+        rows = rows / jnp.maximum(1.0, norms / norm_bound)
+    scores = _krum_scores(rows, ok, m, f_eff)
+    if kind in ("krum", "norm_krum"):
+        # one survivor ⇒ every score is inf (no neighbors); return it
+        sel = jnp.where(m > 1, jnp.argmin(scores),
+                        jnp.argmax(ok.astype(jnp.int32)))
+        return rows[sel]
+    # multikrum: uniform mean of the q lowest-scoring survivors
+    q = jnp.clip(m - f_eff - 2, 1, jnp.maximum(m, 1))
+    order = jnp.argsort(scores)
+    qmask = jnp.arange(mat.shape[0]) < q
+    picked = rows[order]
+    return (jnp.sum(jnp.where(qmask[:, None], picked, 0.0), axis=0)
+            / q.astype(jnp.float32))
 
 
 def norm_diff_clipping(local: Any, global_: Any, norm_bound: float) -> Any:

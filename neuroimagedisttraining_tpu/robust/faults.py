@@ -32,11 +32,35 @@ updates are the steady state, so this module gives the round loop a
 * ``scale``    — Byzantine scaled update (the classic model-replacement
                  attack): delta scaled by ``factor`` (default 100;
                  ``scale=p:Fx`` sets it — the trailing ``x`` is
-                 optional).
+                 optional);
+* ``signflip`` — Byzantine sign-flip: the delta is negated (the client
+                 pushes the model AWAY from its own descent direction —
+                 finite, norm-preserving, invisible to the guard);
+* ``collude``  — colluding scaled clients: every client whose draw fires
+                 in a round ships the SAME forged delta — ``factor`` ×
+                 a per-(seed, round) Rademacher direction shared by all
+                 colluders (``collude=p:Fx`` sets the factor). Mutually
+                 identical updates are Krum's known blind spot: the
+                 colluders look maximally "close" to each other;
+* ``labelflip``— data poisoning via the DATA path: the flagged client
+                 trains on flipped labels (``C-1-y`` for integer
+                 class labels, ``1-y`` for binary targets) — the update
+                 itself is an honest SGD step on dishonest data, so no
+                 post-hoc screen on the update can see it.
 
-Faults compose per client in a fixed order: nan overrides the delta
-transforms; ``scale`` overrides ``straggle``; ``drop`` is orthogonal
+Faults compose per client in a fixed order: ``labelflip`` acts upstream
+(on the training data); post-training, nan overrides the delta
+transforms; ``collude`` REPLACES the delta (overriding ``scale`` /
+``straggle`` / ``signflip``); ``scale`` overrides ``straggle``;
+``signflip`` negates whatever factor survived; ``drop`` is orthogonal
 (a dropped client's payload is irrelevant — the guard discards it).
+
+Key-derivation note: the original four kinds draw from
+``uniform(k, (4,))`` and the straggle fraction from ``fold_in(k, 1)`` —
+those draws are FROZEN (recorded traces replay bit-for-bit across
+versions). The newer kinds (signflip/collude/labelflip) draw from the
+separately-folded ``fold_in(k, 2)``, so enabling them never perturbs an
+existing spec's trace.
 """
 from __future__ import annotations
 
@@ -50,7 +74,14 @@ import jax.numpy as jnp
 #: derived from the same run seed ("faul")
 FAULT_SALT = 0x6661756C
 
-_KINDS = ("drop", "straggle", "nan", "scale")
+#: round-level salt for the colluders' shared direction ("col")
+COLLUDE_SALT = 0x636F6C
+
+_KINDS = ("drop", "straggle", "nan", "scale", "signflip", "collude",
+          "labelflip")
+
+#: kinds taking a ``=p:Fx`` factor suffix -> FaultSpec factor field
+_FACTOR_KINDS = {"scale": "scale_factor", "collude": "collude_factor"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,16 +93,27 @@ class FaultSpec:
     nan: float = 0.0
     scale: float = 0.0
     scale_factor: float = 100.0
+    signflip: float = 0.0
+    collude: float = 0.0
+    collude_factor: float = 100.0
+    labelflip: float = 0.0
 
     @property
     def any_active(self) -> bool:
-        return max(self.drop, self.straggle, self.nan, self.scale) > 0.0
+        return max(self.drop, self.straggle, self.nan, self.scale,
+                   self.signflip, self.collude, self.labelflip) > 0.0
 
     def describe(self) -> str:
-        parts = [f"{k}={getattr(self, k):g}" for k in _KINDS
-                 if getattr(self, k) > 0]
-        if self.scale > 0:
-            parts[-1] = f"scale={self.scale:g}:{self.scale_factor:g}x"
+        parts = []
+        for k in _KINDS:
+            p = getattr(self, k)
+            if p <= 0:
+                continue
+            if k in _FACTOR_KINDS:
+                fac = getattr(self, _FACTOR_KINDS[k])
+                parts.append(f"{k}={p:g}:{fac:g}x")
+            else:
+                parts.append(f"{k}={p:g}")
         return ",".join(parts) or "none"
 
 
@@ -84,7 +126,7 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
     if not spec:
         return None
     fields = {}
-    factor = 100.0
+    factors = {}
     for entry in spec.split(","):
         entry = entry.strip()
         if not entry:
@@ -98,12 +140,17 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
         if kind not in _KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r} (kinds: {_KINDS})")
-        if kind == "scale" and ":" in val:
+        if ":" in val:
+            if kind not in _FACTOR_KINDS:
+                raise ValueError(
+                    f"fault kind {kind!r} takes no :factor suffix "
+                    f"(only {tuple(_FACTOR_KINDS)})")
             val, _, fac = val.partition(":")
             factor = float(fac.rstrip("xX"))
             if factor <= 0:
                 raise ValueError(
-                    f"scale factor must be positive, got {factor}")
+                    f"{kind} factor must be positive, got {factor}")
+            factors[_FACTOR_KINDS[kind]] = factor
         p = float(val)
         if not 0.0 <= p <= 1.0:
             raise ValueError(
@@ -111,7 +158,7 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
         if kind in fields:
             raise ValueError(f"duplicate fault kind {kind!r}")
         fields[kind] = p
-    return FaultSpec(scale_factor=factor, **fields)
+    return FaultSpec(**factors, **fields)
 
 
 FaultFn = Callable[[Any, Any, jax.Array, jax.Array], Tuple[Any, jax.Array]]
@@ -133,26 +180,48 @@ def make_fault_fn(spec: FaultSpec, seed: int) -> FaultFn:
     nan_p, drop_p = spec.nan, spec.drop
     straggle_p, scale_p = spec.straggle, spec.scale
     scale_factor = spec.scale_factor
+    signflip_p, collude_p = spec.signflip, spec.collude
+    collude_factor = spec.collude_factor
 
     def inject(stacked: Any, global_params: Any, sel_idx: jax.Array,
                round_idx: jax.Array) -> Tuple[Any, jax.Array]:
         rkey = jax.random.fold_in(
             base, jnp.asarray(round_idx).astype(jnp.int32))
+        coll_dir = None
+        if collude_p > 0:
+            # the colluders' shared direction: ONE Rademacher tree per
+            # (seed, round) — every colluding client in the round ships
+            # the identical forged delta, independent of which clients'
+            # draws fired (the shared-direction contract)
+            dkey = jax.random.fold_in(rkey, COLLUDE_SALT)
+            leaves, treedef = jax.tree_util.tree_flatten(global_params)
+            dkeys = jax.random.split(dkey, len(leaves))
+            coll_dir = jax.tree_util.tree_unflatten(treedef, [
+                jax.random.rademacher(k, x.shape, x.dtype)
+                for k, x in zip(dkeys, leaves)])
 
         def per_client(update, cid):
             k = jax.random.fold_in(rkey, cid)
             u = jax.random.uniform(k, (4,))
             frac = jax.random.uniform(
                 jax.random.fold_in(k, 1), minval=0.25, maxval=0.75)
+            # newer kinds draw from a SEPARATE folded key: the (4,)
+            # vector and the fold_in(k, 1) fraction above are frozen —
+            # extending them would silently rewrite every recorded trace
+            u2 = jax.random.uniform(jax.random.fold_in(k, 2), (3,))
             dropped = u[0] < drop_p
             straggles = u[1] < straggle_p
             poisoned = u[2] < nan_p
             byzantine = u[3] < scale_p
+            signflips = u2[0] < signflip_p
+            colludes = u2[1] < collude_p
             factor = jnp.where(straggles, frac, 1.0)
             factor = jnp.where(byzantine, scale_factor, factor)
-            rescaled = jnp.logical_or(straggles, byzantine)
+            factor = jnp.where(signflips, -factor, factor)
+            rescaled = jnp.logical_or(
+                jnp.logical_or(straggles, byzantine), signflips)
 
-            def leaf(p, g):
+            def leaf(p, g, d):
                 # select-guard the delta transform: a client with no
                 # fired fault passes through BIT-EXACT (g + (p - g) is
                 # not p in IEEE arithmetic, so an unconditional rewrite
@@ -160,15 +229,59 @@ def make_fault_fn(spec: FaultSpec, seed: int) -> FaultFn:
                 # contaminate faulted-vs-clean ablations)
                 out = jnp.where(
                     rescaled, g + (p - g) * factor.astype(p.dtype), p)
+                if d is not None:
+                    out = jnp.where(
+                        colludes,
+                        g + jnp.asarray(collude_factor, p.dtype) * d,
+                        out)
                 return jnp.where(
                     poisoned, jnp.full_like(out, jnp.nan), out)
 
-            return (jax.tree_util.tree_map(leaf, update, global_params),
-                    dropped)
+            if coll_dir is None:
+                faulted = jax.tree_util.tree_map(
+                    lambda p, g: leaf(p, g, None), update, global_params)
+            else:
+                faulted = jax.tree_util.tree_map(
+                    leaf, update, global_params, coll_dir)
+            return faulted, dropped
 
         return jax.vmap(per_client, in_axes=(0, 0))(stacked, sel_idx)
 
     return inject
+
+
+def make_labelflip_fn(spec: FaultSpec, seed: int, num_classes: int):
+    """The DATA-path twin of :func:`make_fault_fn` for ``labelflip``:
+    ``flip(y_sel, sel_idx, round_idx) -> y_flipped`` runs BEFORE local
+    training (label poisoning corrupts what the client learns from, not
+    what it ships). Integer class labels flip to ``C-1-y``; float
+    (binary/bce) targets to ``1-y``. Keys match the injector's
+    ``fold_in(k, 2)`` draw vector, so :func:`fault_trace_round`
+    attributes the same clients. Returns None when the spec never
+    flips."""
+    if spec is None or spec.labelflip <= 0:
+        return None
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), FAULT_SALT)
+    flip_p = spec.labelflip
+
+    def flip(y_sel: jax.Array, sel_idx: jax.Array,
+             round_idx: jax.Array) -> jax.Array:
+        rkey = jax.random.fold_in(
+            base, jnp.asarray(round_idx).astype(jnp.int32))
+
+        def per_client(y, cid):
+            k = jax.random.fold_in(rkey, cid)
+            u2 = jax.random.uniform(jax.random.fold_in(k, 2), (3,))
+            flagged = u2[2] < flip_p
+            if jnp.issubdtype(y.dtype, jnp.integer):
+                flipped = (num_classes - 1) - y
+            else:
+                flipped = jnp.asarray(1.0, y.dtype) - y
+            return jnp.where(flagged, flipped, y)
+
+        return jax.vmap(per_client, in_axes=(0, 0))(y_sel, sel_idx)
+
+    return flip
 
 
 def fault_trace_round(spec: FaultSpec, seed: int, round_idx: int,
@@ -184,8 +297,9 @@ def fault_trace_round(spec: FaultSpec, seed: int, round_idx: int,
     below must stay bit-for-bit in sync with ``make_fault_fn``'s
     (``tests/test_obs_analyze.py`` pins the parity).
 
-    Returns ``{"dropped", "straggled", "poisoned", "byzantine"}``, each
-    a ``bool`` numpy array aligned with ``client_ids``.
+    Returns ``{"dropped", "straggled", "poisoned", "byzantine",
+    "signflipped", "colluding", "labelflipped"}``, each a ``bool`` numpy
+    array aligned with ``client_ids``.
     """
     import contextlib
 
@@ -206,9 +320,15 @@ def fault_trace_round(spec: FaultSpec, seed: int, round_idx: int,
         keys = jax.vmap(lambda c: jax.random.fold_in(rkey, c))(cids)
         u = np.asarray(jax.vmap(
             lambda k: jax.random.uniform(k, (4,)))(keys))
+        u2 = np.asarray(jax.vmap(
+            lambda k: jax.random.uniform(
+                jax.random.fold_in(k, 2), (3,)))(keys))
     return {
         "dropped": u[:, 0] < spec.drop,
         "straggled": u[:, 1] < spec.straggle,
         "poisoned": u[:, 2] < spec.nan,
         "byzantine": u[:, 3] < spec.scale,
+        "signflipped": u2[:, 0] < spec.signflip,
+        "colluding": u2[:, 1] < spec.collude,
+        "labelflipped": u2[:, 2] < spec.labelflip,
     }

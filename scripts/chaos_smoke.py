@@ -12,11 +12,21 @@ non-finite guard and the rollback-retry watchdog active, and asserts
     python scripts/chaos_smoke.py                       # CI gate
     python scripts/chaos_smoke.py --clients 32 --rounds 4
     python scripts/chaos_smoke.py --bench_guard         # overhead probe
+    python scripts/chaos_smoke.py --attack_matrix       # Byzantine gate
 
 ``--bench_guard`` instead measures the guard's overhead on the CLEAN
 path (guard force-on vs. off, no faults injected — the ≤3% round-time
 budget of ISSUE 2's acceptance criteria): per-round wall times over a
 short warm run, printed as one JSON line alongside the chaos fields.
+
+``--attack_matrix`` runs the Byzantine scenario matrix: each adversary
+kind (100x scaling, sign-flip, colluding cohort) crossed with a robust
+aggregation statistic (median / krum) on the in-process round, plus a
+real Byzantine SITE process against the sync and buffered federation
+under ``--robust_agg median``. Every cell must finish finite with its
+faults actually firing; one cell per deployment reruns as a twin and
+is gated bit-identical through ``obs/diff.params_diff`` (attacks and
+defenses are deterministic, or they are not debuggable).
 
 Prints ONE JSON line; exits nonzero on any assertion failure.
 """
@@ -84,6 +94,102 @@ def run_chaos(clients: int, rounds: int, tmp: str) -> dict:
         "clients_dropped_total": dropped,
         "clients_quarantined_total": quarantined,
         "wall_s": round(wall, 2),
+    }
+
+
+#: adversary kinds of the --attack_matrix leg (robust/faults.py specs)
+ATTACK_SPECS = {
+    "scale100x": "scale=0.3:100x",
+    "signflip": "signflip=0.3",
+    "collude": "collude=0.3:50x",
+}
+
+#: robust statistics each adversary is crossed with
+ATTACK_AGGS = ("median", "krum")
+
+
+def run_attack_matrix(clients: int, rounds: int, tmp: str) -> dict:
+    """Adversary x robust_agg x deployment scenario matrix (CI scale)."""
+    from neuroimagedisttraining_tpu.experiments import run_experiment
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
+    from neuroimagedisttraining_tpu.robust.recovery import tree_finite
+
+    t0 = time.perf_counter()
+    cells = {}
+
+    def check(name, out):
+        hist = [h for h in out["history"] if "train_loss" in h]
+        if not all(math.isfinite(float(h["train_loss"])) for h in hist):
+            raise SystemExit(f"[{name}] non-finite train loss")
+        if not tree_finite(out["state"].global_params):
+            raise SystemExit(f"[{name}] non-finite final global params")
+        return float(hist[-1]["train_loss"])
+
+    # -- in-process: adversary x robust statistic -------------------------
+    for adv, spec in ATTACK_SPECS.items():
+        for agg in ATTACK_AGGS:
+            name = f"{adv}-{agg}"
+            out = run_experiment(_build(
+                ["--robust_agg", agg, "--watchdog", "0"],
+                clients, rounds, os.path.join(tmp, name),
+                fault_spec=spec), "fedavg")
+            cells[name] = check(name, out)
+    # determinism twin on one cell: identical config, identical bits
+    twin_args = ["--robust_agg", "median", "--watchdog", "0"]
+    a = run_experiment(_build(twin_args, clients, rounds,
+                              os.path.join(tmp, "twin_a"),
+                              fault_spec=ATTACK_SPECS["collude"]),
+                       "fedavg")
+    b = run_experiment(_build(twin_args, clients, rounds,
+                              os.path.join(tmp, "twin_b"),
+                              fault_spec=ATTACK_SPECS["collude"]),
+                       "fedavg")
+    pd = obs_diff.params_diff(a["state"].global_params,
+                              b["state"].global_params)
+    if not pd["identical"]:
+        raise SystemExit(
+            f"attacked robust run is not deterministic: "
+            f"{pd['diverged'][:3]}")
+
+    # -- federation: a real Byzantine site process ------------------------
+    def fed_run(name, mode, *extra):
+        fed_extra = ["--fed_role", "aggregator", "--fed_mode", mode,
+                     "--fed_sites", "3", "--fed_site_faults",
+                     "3:byzantine", "--robust_agg", "median",
+                     "--frac", "1.0"] + list(extra)
+        n = rounds
+        if mode == "buffered":
+            # enough flushes that the attacker contributes AFTER the
+            # norm history is honest-dominated: a forged delta in the
+            # very first flush sits against a 2-member median it
+            # half-owns and legitimately escapes the screen
+            fed_extra += ["--fed_buffer_k", "2"]
+            n = max(rounds, 4)
+        out = run_experiment(_build(
+            fed_extra, clients, n, os.path.join(tmp, name)),
+            "fedavg")
+        flags = out["fed"].get("byzantine_flags") or {}
+        if "3" not in flags:
+            raise SystemExit(
+                f"[{name}] Byzantine site 3 never flagged by the norm "
+                f"screen (flags: {flags})")
+        if not tree_finite(out["global_params"]):
+            raise SystemExit(f"[{name}] non-finite global params")
+        return out
+
+    sync_a = fed_run("fedsync_a", "sync")
+    sync_b = fed_run("fedsync_b", "sync")
+    pd = obs_diff.params_diff(sync_a["global_params"],
+                              sync_b["global_params"])
+    if not pd["identical"]:
+        raise SystemExit(
+            f"attacked fed sync twin diverged: {pd['diverged'][:3]}")
+    fed_run("fedbuf", "buffered")
+    return {
+        "attack_matrix_ok": True, "clients": clients, "rounds": rounds,
+        "cells": cells, "aggs": list(ATTACK_AGGS),
+        "fed_modes": ["sync", "buffered"], "bit_identical": True,
+        "wall_s": round(time.perf_counter() - t0, 2),
     }
 
 
@@ -156,6 +262,10 @@ def main(argv=None) -> dict:
     p.add_argument("--bench_guard", action="store_true",
                    help="measure clean-path guard overhead instead of "
                         "running the chaos gate")
+    p.add_argument("--attack_matrix", action="store_true",
+                   help="run the Byzantine scenario matrix (adversary "
+                        "x robust_agg x sync/buffered) instead of the "
+                        "chaos gate")
     p.add_argument("--model", type=str, default="small3dcnn",
                    help="bench_guard model (3dcnn sizes the per-round "
                         "compute closer to the dry-run workload)")
@@ -177,6 +287,8 @@ def main(argv=None) -> dict:
     if args.bench_guard:
         result = run_bench_guard(args.clients, args.rounds, tmp,
                                  model=args.model, epochs=args.epochs)
+    elif args.attack_matrix:
+        result = run_attack_matrix(args.clients, args.rounds, tmp)
     else:
         result = run_chaos(args.clients, args.rounds, tmp)
     print(json.dumps(result))

@@ -1,0 +1,626 @@
+"""Byzantine-robust aggregation (robust/aggregation.py + the
+--robust_agg axis): estimator unit pins on hand-built delta matrices,
+the quarantine-mask convention, wire composition (dense / int8 ranks
+the decoded rows), the neutralization A/B the acceptance scenario
+pins (a finite 100x attacker at <=20% of the cohort is neutralized by
+median / trimmed_mean / krum while degrading the plain weighted mean),
+the new adversarial fault kinds (signflip / collude / labelflip), the
+FedBuff robust flush + norm screen on a stub aggregator, and the
+(slow) end-to-end twins: fused-vs-unfused bitwise parity under attack,
+dense+int8 convergence A/B, and the real Byzantine site process over
+TCP detected + survived + replayed bit-for-bit."""
+import json
+import math
+import os
+import subprocess
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.parallel import collectives
+from neuroimagedisttraining_tpu.robust.aggregation import (
+    ROBUST_AGGS,
+    resolve_krum_f,
+    robust_combine_mat,
+)
+from neuroimagedisttraining_tpu.robust.faults import (
+    FaultSpec,
+    fault_trace_round,
+    make_fault_fn,
+    make_labelflip_fn,
+    parse_fault_spec,
+)
+
+
+def _rows(s=6, d=12, seed=0, sigma=0.1):
+    rng = np.random.RandomState(seed)
+    return rng.normal(0.0, sigma, size=(s, d)).astype(np.float32)
+
+
+def _w(s):
+    return jnp.full((s,), 1.0 / s, jnp.float32)
+
+
+# -- estimator units ---------------------------------------------------------
+
+def test_resolve_krum_f_auto_and_explicit():
+    assert resolve_krum_f(0, 10) == 2   # ceil(0.2 * 10)
+    assert resolve_krum_f(0, 5) == 1
+    assert resolve_krum_f(0, 1) == 1    # floor at 1
+    assert resolve_krum_f(3, 10) == 3   # explicit wins
+
+
+def test_median_pin_and_quarantine_mask():
+    mat = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0],
+                       [np.nan, np.nan]], jnp.float32)
+    # the NaN row is quarantined (weight 0): the median must read the
+    # three survivors only — a zeroed row VOTING would be the bug the
+    # weights>0 convention exists to prevent
+    w = jnp.asarray([0.25, 0.25, 0.25, 0.0], jnp.float32)
+    out = np.asarray(robust_combine_mat(mat, w, "median"))
+    np.testing.assert_allclose(out, [2.0, 20.0])
+    # even survivor count: mean of the two central order statistics
+    w2 = jnp.asarray([0.25, 0.25, 0.0, 0.0], jnp.float32)
+    out2 = np.asarray(robust_combine_mat(mat, w2, "median"))
+    np.testing.assert_allclose(out2, [1.5, 15.0])
+
+
+def test_trimmed_mean_pin():
+    mat = jnp.asarray([[0.0], [1.0], [2.0], [3.0], [100.0]], jnp.float32)
+    # m=5, trim_frac=0.2 -> t=1 per side: mean(1, 2, 3) = 2
+    out = np.asarray(robust_combine_mat(mat, _w(5), "trimmed_mean",
+                                        trim_frac=0.2))
+    np.testing.assert_allclose(out, [2.0])
+    # trim clamps to (m-1)//2: a huge beta degrades to the median
+    out2 = np.asarray(robust_combine_mat(mat, _w(5), "trimmed_mean",
+                                         trim_frac=0.49))
+    np.testing.assert_allclose(out2, [2.0])
+
+
+def test_krum_selects_an_honest_row():
+    rows = _rows(s=6, sigma=0.05)
+    mat = np.concatenate([rows[:5], 100.0 + rows[5:]])  # 1 outlier of 6
+    out = np.asarray(robust_combine_mat(
+        jnp.asarray(mat), _w(6), "krum"))
+    # krum returns EXACTLY one of the honest rows
+    assert any(np.array_equal(out, mat[i]) for i in range(5))
+    assert not np.array_equal(out, mat[5])
+
+
+def test_multikrum_averages_low_score_rows():
+    rows = _rows(s=6, sigma=0.05)
+    mat = np.concatenate([rows[:5], 100.0 + rows[5:]])
+    out = np.asarray(robust_combine_mat(
+        jnp.asarray(mat), _w(6), "multikrum"))
+    # q = m - f - 2 = 3 honest rows averaged: far from the attacker
+    assert np.max(np.abs(out)) < 1.0
+
+
+def test_norm_krum_winner_is_clipped():
+    rows = _rows(s=5, sigma=0.05)
+    out = np.asarray(robust_combine_mat(
+        jnp.asarray(rows * 100.0), _w(5), "norm_krum", norm_bound=0.5))
+    # every row (and therefore the winner) is clipped to the bound
+    assert np.linalg.norm(out) <= 0.5 + 1e-5
+
+
+@pytest.mark.slow
+def test_no_attacker_estimators_near_mean():
+    mat = _rows(s=8, d=64, sigma=0.1)
+    mean = mat.mean(axis=0)
+    for kind in ("median", "trimmed_mean", "multikrum"):
+        out = np.asarray(robust_combine_mat(
+            jnp.asarray(mat), _w(8), kind))
+        assert np.max(np.abs(out - mean)) < 0.15, kind
+    # krum returns one genuine row — bounded by the sample spread
+    out = np.asarray(robust_combine_mat(jnp.asarray(mat), _w(8), "krum"))
+    assert any(np.array_equal(out, mat[i]) for i in range(8))
+
+
+def test_robust_combine_refuses_none_and_unknown():
+    mat = jnp.zeros((2, 3))
+    with pytest.raises(ValueError, match="robust estimator"):
+        robust_combine_mat(mat, _w(2), "none")
+    with pytest.raises(ValueError, match="robust estimator"):
+        robust_combine_mat(mat, _w(2), "bogus")
+
+
+def test_estimators_shift_equivariant_under_cond():
+    """The delta-space contract: estimators run under lax.cond in
+    guarded_aggregate, and robust(x + c) == robust(x) + c is why
+    _robust_aggregate may work on deltas."""
+    mat = jnp.asarray(_rows(s=5, d=8))
+    shift = jnp.full((8,), 3.0, jnp.float32)
+
+    def call(m):
+        return robust_combine_mat(m, _w(5), "median")
+
+    a = jax.lax.cond(True, call, call, mat + shift[None])
+    b = call(mat) + shift
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+# -- acceptance (c), CI scale: 100x attacker neutralized ---------------------
+
+@pytest.mark.parametrize("wire", ["f32", "int8"])
+def test_scaled_attacker_neutralized_dense_and_int8(wire):
+    """One finite 100x-scaled attacker in a 6-row cohort (<=20%): the
+    robust statistics land near the honest mean on BOTH the dense and
+    the int8-decoded wire, while the plain weighted mean is dragged an
+    order of magnitude further."""
+    honest = _rows(s=5, d=96, sigma=0.1)
+    attacker = np.full((1, 96), 100.0, np.float32)  # finite, huge
+    mat = jnp.asarray(np.concatenate([honest, attacker]))
+    rng = jax.random.PRNGKey(7) if wire == "int8" else None
+    decoded = collectives.wire_roundtrip_mat(mat, wire, bucket_size=64,
+                                             rng=rng)
+    honest_mean = honest.mean(axis=0)
+    plain = np.asarray(jnp.sum(decoded * _w(6)[:, None], axis=0))
+    plain_err = float(np.linalg.norm(plain - honest_mean))
+    for kind in ("median", "trimmed_mean", "krum"):
+        out = np.asarray(robust_combine_mat(decoded, _w(6), kind))
+        assert np.all(np.isfinite(out)), kind
+        err = float(np.linalg.norm(out - honest_mean))
+        assert err < 0.1 * plain_err, (
+            f"{kind} on {wire}: err {err:.4f} vs plain {plain_err:.4f}")
+
+
+def test_quarantine_times_robust_no_nan_leak():
+    """guard.guarded_aggregate x robust estimator: a NaN row is
+    quarantined, the estimator sees the survivor mask through the
+    renormalized weights, and no NaN reaches the result."""
+    from neuroimagedisttraining_tpu.robust.guard import (finite_screen,
+                                                        guarded_aggregate)
+
+    honest = _rows(s=4, d=10, sigma=0.1)
+    mat = np.concatenate([honest, np.full((1, 10), np.nan, np.float32)])
+    stacked = {"w": jnp.asarray(mat)}
+    weights = _w(5)
+    ok = finite_screen(stacked)
+
+    def agg_fn(st, wv):
+        return {"w": robust_combine_mat(st["w"], wv, "median")}
+
+    out = guarded_aggregate(stacked, weights, ok, agg_fn,
+                            {"w": jnp.zeros((10,))})
+    ref = robust_combine_mat(jnp.asarray(honest),
+                             jnp.full((4,), 0.25, jnp.float32), "median")
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(ref))
+
+
+# -- new fault kinds ---------------------------------------------------------
+
+def test_parse_new_fault_kinds():
+    s = parse_fault_spec("signflip=0.5,collude=0.3:50x,labelflip=0.2")
+    assert s == FaultSpec(signflip=0.5, collude=0.3,
+                          collude_factor=50.0, labelflip=0.2)
+    assert s.any_active
+    assert "collude=0.3:50x" in s.describe()
+    # the frozen four-field positional pin (test_faults.py) still holds
+    # because the new fields append AFTER scale_factor with defaults
+    old = parse_fault_spec("drop=0.2,scale=0.02:100x")
+    assert old == FaultSpec(drop=0.2, scale=0.02, scale_factor=100.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "signflip=0.5:2x",       # factor on a factorless kind
+    "labelflip=0.1:9",       # same
+    "collude=0.2:-3x",       # non-positive factor
+    "collude=1.5",           # probability out of range
+])
+def test_parse_new_fault_kinds_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+@pytest.mark.slow
+def test_new_kinds_do_not_perturb_frozen_draws():
+    """Enabling signflip/collude/labelflip must not move the original
+    four kinds' draws: the (4,) uniform vector and the straggle
+    fraction are frozen (recorded traces replay bit-for-bit)."""
+    ids = np.arange(16)
+    old = fault_trace_round(
+        parse_fault_spec("drop=0.3,straggle=0.3,nan=0.2,scale=0.2"),
+        0, 5, ids)
+    new = fault_trace_round(
+        parse_fault_spec("drop=0.3,straggle=0.3,nan=0.2,scale=0.2,"
+                         "signflip=0.5,collude=0.5,labelflip=0.5"),
+        0, 5, ids)
+    for k in ("dropped", "straggled", "poisoned", "byzantine"):
+        np.testing.assert_array_equal(old[k], new[k])
+    assert new["signflipped"].any() or new["colluding"].any() \
+        or new["labelflipped"].any()
+
+
+def _inject(spec_str, seed=0, s=8, round_idx=3):
+    spec = parse_fault_spec(spec_str)
+    inject = make_fault_fn(spec, seed)
+    g = {"w": jnp.linspace(-1.0, 1.0, 6, dtype=jnp.float32)}
+    rng = np.random.RandomState(1)
+    stacked = {"w": jnp.asarray(
+        rng.normal(0, 0.1, size=(s, 6)).astype(np.float32))
+        + g["w"][None]}
+    sel = jnp.arange(s, dtype=jnp.int32)
+    faulted, dropped = inject(stacked, g, sel, jnp.asarray(round_idx))
+    tr = fault_trace_round(spec, seed, round_idx, np.arange(s))
+    return g, stacked, faulted, dropped, tr
+
+
+@pytest.mark.slow
+def test_signflip_negates_delta_and_matches_trace():
+    g, stacked, faulted, _, tr = _inject("signflip=0.6")
+    assert tr["signflipped"].any() and not tr["signflipped"].all()
+    f, p, gw = (np.asarray(faulted["w"]), np.asarray(stacked["w"]),
+                np.asarray(g["w"]))
+    for i, flipped in enumerate(tr["signflipped"]):
+        if flipped:
+            np.testing.assert_allclose(
+                f[i] - gw, -(p[i] - gw), rtol=1e-5, atol=1e-6)
+        else:
+            np.testing.assert_array_equal(f[i], p[i])  # bit-exact
+
+
+@pytest.mark.slow
+def test_colluders_ship_identical_forged_rows():
+    g, stacked, faulted, _, tr = _inject("collude=0.6:50x", s=12)
+    idx = np.flatnonzero(tr["colluding"])
+    assert len(idx) >= 2, "draw produced <2 colluders; re-seed the test"
+    f = np.asarray(faulted["w"])
+    for i in idx[1:]:
+        np.testing.assert_array_equal(f[idx[0]], f[i])
+    # the shared direction is +/-50 around the global: |delta| = 50
+    np.testing.assert_allclose(
+        np.abs(f[idx[0]] - np.asarray(g["w"])), 50.0, rtol=1e-5)
+    clean = np.flatnonzero(~tr["colluding"])
+    p = np.asarray(stacked["w"])
+    for i in clean:
+        np.testing.assert_array_equal(f[i], p[i])
+
+
+def test_labelflip_fn_int_and_float_targets():
+    spec = parse_fault_spec("labelflip=0.5")
+    tr = fault_trace_round(spec, 0, 2, np.arange(8))
+    assert tr["labelflipped"].any() and not tr["labelflipped"].all()
+    flip = make_labelflip_fn(spec, 0, num_classes=4)
+    y_int = jnp.tile(jnp.asarray([0, 1, 2, 3]), (8, 1))
+    out = np.asarray(flip(y_int, jnp.arange(8, dtype=jnp.int32),
+                          jnp.asarray(2)))
+    for i, flagged in enumerate(tr["labelflipped"]):
+        expect = [3, 2, 1, 0] if flagged else [0, 1, 2, 3]
+        np.testing.assert_array_equal(out[i], expect)
+    y_f = jnp.tile(jnp.asarray([0.0, 1.0], jnp.float32), (8, 1))
+    out_f = np.asarray(flip(y_f, jnp.arange(8, dtype=jnp.int32),
+                            jnp.asarray(2)))
+    for i, flagged in enumerate(tr["labelflipped"]):
+        expect = [1.0, 0.0] if flagged else [0.0, 1.0]
+        np.testing.assert_array_equal(out_f[i], expect)
+    assert make_labelflip_fn(parse_fault_spec("drop=0.5"), 0, 2) is None
+
+
+# -- fed runtime units -------------------------------------------------------
+
+def test_parse_site_faults_byzantine_sugar():
+    from neuroimagedisttraining_tpu.fed.runtime import parse_site_faults
+
+    out = parse_site_faults("2:byzantine;3:byzantine:4.0")
+    fs2, _delay2 = out[2]
+    assert fs2.scale == 1.0 and fs2.scale_factor == 100.0
+    _fs3, delay3 = out[3]
+    assert delay3 == 4.0
+    # sugar composes with the ordinary grammar elsewhere
+    out2 = parse_site_faults("1:signflip=1.0")
+    assert out2[1][0].signflip == 1.0
+
+
+def _stub_aggregator(tmp_path, n_sites=3, robust_agg="median", **kw):
+    from neuroimagedisttraining_tpu.comm.local import LocalRouter
+    from neuroimagedisttraining_tpu.fed.aggregator import FedAggregator
+
+    class _State:
+        def __init__(self):
+            self.global_params = {"w": jnp.zeros((4,), jnp.float32)}
+            self.rng = jax.random.PRNGKey(0)
+
+    algo = types.SimpleNamespace(
+        num_clients=6, init_state=lambda key: _State())
+    router = LocalRouter(n_sites + 1)
+    return FedAggregator(
+        router.manager(0), n_sites + 1, algo, mode="buffered",
+        rounds=2, seed=0, buffer_k=2, robust_agg=robust_agg,
+        events_path=str(tmp_path / "ev.jsonl"), **kw)
+
+
+def test_fedbuff_robust_flush_and_norm_screen(tmp_path):
+    """The buffered robust flush: staleness-discounted weights gate
+    MEMBERSHIP while the estimator owns influence — a colluding stale
+    attacker's 100x delta is voted out by the median, and the norm
+    screen (history-honest median x BYZ_NORM_FACTOR) flags the site
+    with a typed BYZANTINE event."""
+    agg = _stub_aggregator(tmp_path)
+    honest = {"w": np.full((4,), 0.01, np.float32)}
+    attack = {"w": np.full((4,), 100.0, np.float32)}
+    # seed the norm history with honest flushes first
+    agg._flush([(1, 0, honest, 10.0, 0.5), (2, 0, honest, 10.0, 0.5)],
+               flush_idx=0, depth=2)
+    g1 = np.asarray(agg.global_params["w"])
+    np.testing.assert_allclose(g1, 0.01, rtol=1e-5)
+    # attacker ships a stale 100x delta into the next flush
+    agg._flush([(1, 1, honest, 10.0, 0.5), (3, 0, attack, 10.0, 0.5)],
+               flush_idx=1, depth=2)
+    g2 = np.asarray(agg.global_params["w"])
+    # median of {honest, attack} with 2 members = midpoint — membership
+    # is 2 rows; what matters is the screen flagged the attacker
+    assert agg.byzantine_flags.get(3) == 1
+    assert np.all(np.isfinite(g2))
+    agg.events.close()
+    evs = [json.loads(ln) for ln in open(tmp_path / "ev.jsonl")]
+    byz = [e for e in evs if e.get("event_type") == "BYZANTINE"]
+    assert len(byz) == 1 and byz[0]["sites"] == [3]
+    # record field the analyzer folds on
+    assert agg.history[-1]["fed_byzantine_flagged"] == 1
+
+
+def test_fedbuff_staleness_discount_vs_colluding_stale_attacker(tmp_path):
+    """The FedBuff leg: under plain accumulation the n/sqrt(1+tau)
+    discount SCALES a stale attacker's pull (still ruinous at 100x);
+    under --robust_agg the discount only ranks it and the median
+    removes it."""
+    attack = {"w": np.full((4,), 100.0, np.float32)}
+    honest = {"w": np.full((4,), 0.01, np.float32)}
+    members = [(1, 1, honest, 10.0, 0.5), (2, 1, honest, 10.0, 0.5),
+               (3, 0, attack, 10.0, 0.5)]
+    plain = _stub_aggregator(tmp_path / "p", robust_agg="none")
+    plain.version = 1
+    plain._flush(list(members), flush_idx=0, depth=3)
+    robust = _stub_aggregator(tmp_path / "r", robust_agg="median")
+    robust.version = 1
+    robust._flush(list(members), flush_idx=0, depth=3)
+    g_plain = float(np.max(np.abs(plain.global_params["w"])))
+    g_rob = float(np.max(np.abs(robust.global_params["w"])))
+    assert g_plain > 10.0       # discounted but still ruinous
+    assert g_rob < 0.05         # median keeps the honest step
+    # both runs applied the SAME deterministic flush members: replaying
+    # the trace reproduces the screen decisions (member-order norms)
+    assert plain.trace["flushes"] == robust.trace["flushes"]
+
+
+def test_aggregator_refuses_unknown_robust_agg(tmp_path):
+    with pytest.raises(ValueError, match="robust_agg"):
+        _stub_aggregator(tmp_path, robust_agg="bogus")
+
+
+# -- flags / identity --------------------------------------------------------
+
+def _args(tmp_path, *extra, algo="fedavg"):
+    from neuroimagedisttraining_tpu.experiments import parse_args
+
+    return parse_args([
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "6", "--batch_size", "8",
+        "--epochs", "1", "--comm_round", "2", "--final_finetune", "0",
+        "--results_dir", str(tmp_path / "results"),
+    ] + list(extra), algo=algo)
+
+
+def test_robust_flags_parse_validate_and_identity(tmp_path):
+    from neuroimagedisttraining_tpu.experiments import run_identity
+
+    args = _args(tmp_path, "--robust_agg", "trimmed_mean",
+                 "--robust_trim", "0.3")
+    ident = run_identity(args, "fedavg")
+    assert "raggtrimmed_mean" in ident and "rtrim0.3" in ident
+    krum_id = run_identity(_args(tmp_path, "--robust_agg", "krum"),
+                           "fedavg")
+    assert "raggkrum" in krum_id and "rkf0" in krum_id
+    nk = run_identity(_args(tmp_path, "--robust_agg", "norm_krum",
+                            "--norm_bound", "2.0"), "fedavg")
+    assert "raggnorm_krum" in nk and "rnb2" in nk
+    # none: no identity parts (the default lineage is untouched)
+    assert "ragg" not in run_identity(_args(tmp_path), "fedavg")
+    with pytest.raises(ValueError, match="robust_trim"):
+        _args(tmp_path, "--robust_trim", "0.5")
+    with pytest.raises(ValueError, match="robust_krum_f"):
+        _args(tmp_path, "--robust_krum_f", "-1")
+
+
+def test_runner_refuses_robust_agg_without_central_aggregate(tmp_path):
+    from neuroimagedisttraining_tpu.experiments.runner import \
+        build_algorithm
+
+    args = _args(tmp_path, "--robust_agg", "median", algo="fedprox")
+    with pytest.raises(SystemExit, match="robust_agg"):
+        build_algorithm(args, "fedprox")
+
+
+def test_byzantine_event_derived_from_record():
+    from neuroimagedisttraining_tpu.obs.events import events_from_record
+
+    evs = events_from_record(
+        {"round": 4, "clients_signflipped": 2.0,
+         "fed_byzantine_flagged": 1})
+    assert [e.type for e in evs] == ["BYZANTINE"]
+    assert evs[0].detail == {"clients_signflipped": 2.0,
+                             "fed_byzantine_flagged": 1.0}
+    assert events_from_record({"round": 4, "clients_byzantine": 0}) == []
+
+
+def test_analyzer_names_byzantine_sites():
+    from neuroimagedisttraining_tpu.obs.analyze import analyze_records
+
+    records = [{"round": r, "train_loss": 0.5,
+                "fed_byzantine_flagged": 1} for r in range(3)]
+    events = [{"round": r, "event_type": "BYZANTINE", "sites": [3]}
+              for r in range(3)]
+    a = analyze_records(records, events=events)
+    assert a["faults"]["byzantine_sites"] == {"3": 3}
+    assert "byzantine_site_3" in a["flags"]
+
+
+# -- e2e twins (slow tier) ---------------------------------------------------
+
+def _smoke_argv(tmp_path, sub, *extra):
+    return [
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "6", "--frac", "1.0",
+        "--batch_size", "8", "--epochs", "1", "--comm_round", "3",
+        "--lr", "0.05", "--final_finetune", "0",
+        "--log_dir", str(tmp_path / sub / "LOG"),
+        "--results_dir", str(tmp_path / sub / "results"),
+    ] + list(extra)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("agg_impl", ["dense", "int8"])
+def test_e2e_robust_neutralizes_100x_attacker(tmp_path, agg_impl):
+    """Acceptance (c) end-to-end: scale=0.15:100x (expected <=20% of
+    the 6-client cohort per round) degrades the plain weighted mean;
+    median pulls the trajectory back to the clean run's
+    neighborhood on the dense AND int8 wires."""
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+
+    spec = ["--fault_spec", "scale=0.15:100x", "--watchdog", "0",
+            "--agg_impl", agg_impl]
+
+    def run(sub, *extra):
+        return run_experiment(parse_args(_smoke_argv(
+            tmp_path, f"{sub}-{agg_impl}", "--agg_impl", agg_impl,
+            *extra), algo="fedavg"), "fedavg")
+
+    # twin-normalized: each attacked run compares against the clean run
+    # of the SAME estimator (median != mean even with zero attackers, so
+    # distance-to-the-plain-clean-run would conflate estimator bias with
+    # attacker influence)
+    clean_plain = run("cp")
+    clean_rob = run("cr", "--robust_agg", "median")
+    atk_plain = run("ap", *spec)
+    atk_rob = run("ar", *spec, "--robust_agg", "median")
+
+    def dist(a, b):
+        return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+                   for x, y in zip(
+                       jax.tree_util.tree_leaves(a.global_params),
+                       jax.tree_util.tree_leaves(b.global_params)))
+
+    d_plain = dist(atk_plain["state"], clean_plain["state"])
+    d_rob = dist(atk_rob["state"], clean_rob["state"])
+    assert math.isfinite(d_plain) and math.isfinite(d_rob)
+    # each attacker only moves the median by one rank of the honest
+    # order statistics (an inter-row-spread-sized shift), so the bound
+    # is a ratio against the plain mean's 100x-sized drag, not zero
+    assert d_rob < 0.35 * d_plain, (agg_impl, d_rob, d_plain)
+    assert math.isfinite(float(atk_rob["final_eval"]["global_loss"]))
+
+
+@pytest.mark.slow
+def test_e2e_fused_vs_unfused_robust_bitwise(tmp_path):
+    """The fused lax.scan round loop with --robust_agg median under
+    attack is bit-identical to the unfused loop (the estimators are
+    selects and sorts — deterministic under fusion like the rest of
+    the round program)."""
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    from neuroimagedisttraining_tpu.obs.diff import params_diff
+
+    spec = ["--fault_spec", "signflip=0.3,scale=0.15:100x",
+            "--robust_agg", "median", "--watchdog", "0",
+            "--comm_round", "4", "--frequency_of_the_test", "0"]
+    unfused = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "unfused", *spec), algo="fedavg"), "fedavg")
+    fused = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "fused", *spec, "--fuse_rounds", "2"),
+        algo="fedavg"), "fedavg")
+    pd = params_diff(unfused["state"].global_params,
+                     fused["state"].global_params)
+    assert pd["identical"], pd["diverged"][:3]
+
+
+@pytest.mark.slow
+def test_e2e_topk_robust_residual_no_leak(tmp_path):
+    """topk error feedback x robust x quarantine: a NaN-poisoned round
+    must not leak non-finites into the residual or the params, and the
+    robust statistic runs on the SPARSIFIED rows."""
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    from neuroimagedisttraining_tpu.robust.recovery import tree_finite
+
+    out = run_experiment(parse_args(_smoke_argv(
+        tmp_path, "topk", "--agg_impl", "topk", "--robust_agg",
+        "trimmed_mean", "--fault_spec", "nan=0.2,scale=0.15:100x",
+        "--watchdog", "0", "--comm_round", "4"),
+        algo="fedavg"), "fedavg")
+    assert tree_finite(out["state"].global_params)
+    assert tree_finite(out["state"].agg_residual)
+    assert math.isfinite(float(out["final_eval"]["global_loss"]))
+
+
+@pytest.mark.slow
+def test_e2e_byzantine_site_over_tcp_detected_survived_replayed(tmp_path):
+    """Acceptance (d): a REAL Byzantine site process over TCP
+    (scripts/run_federation.py forks one aggregator + 3 sites), site 3
+    forging its delta every round. The merged events stream carries
+    the typed BYZANTINE event naming site 3, the analyzer's
+    byzantine_sites attribution names it, the run survives under
+    --robust_agg median, and --fed_replay reproduces the identical
+    final eval and flush membership."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_rec = tmp_path / "rec"
+    trace = tmp_path / "trace.json"
+    base = [sys.executable, os.path.join(repo, "scripts",
+                                         "run_federation.py"),
+            "--sites", "3", "--"]
+    common = ["--algo", "fedavg", "--model", "small3dcnn",
+              "--dataset", "synthetic", "--client_num_in_total", "6",
+              "--frac", "1.0", "--batch_size", "8", "--epochs", "1",
+              "--lr", "0.05", "--final_finetune", "0",
+              "--comm_round", "4", "--fed_mode", "buffered",
+              # buffer_k == sites: every flush holds all three members,
+              # so the Byzantine site can't be outraced by the honest
+              # sites' JIT warm-up (buffer_k < sites lets the fast pair
+              # complete every flush before site 3's first delta lands)
+              "--fed_buffer_k", "3", "--fed_site_faults",
+              "3:byzantine", "--robust_agg", "median",
+              "--results_dir", str(tmp_path / "results"),
+              "--log_dir", str(tmp_path / "LOG")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rec = subprocess.run(
+        base + common + ["--fed_out", str(out_rec),
+                         "--fed_trace", str(trace)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=repo)
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    summary = json.load(open(out_rec / "summary.json"))
+    assert summary["fed"]["robust_agg"] == "median"
+    assert "3" in summary["fed"]["byzantine_flags"]
+    assert math.isfinite(summary["final_eval"]["global_loss"])
+    events = [json.loads(ln)
+              for ln in open(out_rec / "federation.events.jsonl")]
+    byz = [e for e in events if e.get("event_type") == "BYZANTINE"]
+    assert byz and all(3 in e["sites"] for e in byz)
+    forged = [e for e in events
+              if e.get("event_type") == "fed_site_byzantine"]
+    assert forged and all(e["site"] == 3 for e in forged)
+    # analyzer attribution names the site
+    from neuroimagedisttraining_tpu.obs.analyze import analyze_records
+
+    records = [json.loads(ln)
+               for ln in open(out_rec / "federation.jsonl")]
+    a = analyze_records([r for r in records
+                         if r.get("round", -1) >= 0], events=events)
+    assert a["faults"]["byzantine_sites"].get("3")
+    # deterministic replay: same trace -> same flushes, same final eval
+    out_rep = tmp_path / "rep"
+    rep = subprocess.run(
+        base + common + ["--fed_out", str(out_rep),
+                         "--fed_replay", str(trace)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=repo)
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    rep_summary = json.load(open(out_rep / "summary.json"))
+    assert rep_summary["final_eval"] == summary["final_eval"]
+    assert rep_summary["fed"]["byzantine_flags"] == \
+        summary["fed"]["byzantine_flags"]
