@@ -196,6 +196,37 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "fed_backoff_s": ("inert", "send retry backoff, timing only"),
     "fed_trace": ("inert", "trace output path"),
     "fed_out": ("inert", "federation output path"),
+    # serving plane (serve/): ALL serve_* flags are inert — serving
+    # reads trained models, it never enters the training computation
+    # (the fed_role precedent: names WHICH process this is)
+    "serve_role": ("inert", "names WHICH serving process this is; "
+                            "serving never trains"),
+    "serve_backend": ("inert", "transport choice; the push wire is "
+                               "bit-transparent "
+                               "(tests/test_serve_push.py)"),
+    "serve_endpoints": ("inert", "process placement"),
+    "serve_requests": ("inert", "synthetic load volume — read-only "
+                                "inference traffic"),
+    "serve_rps": ("inert", "open-loop traffic rate, timing only"),
+    "serve_batch": ("inert", "micro-batch slab width — inference "
+                             "batching, never values"),
+    "serve_linger_ms": ("inert", "batch coalescing window, timing "
+                                 "only"),
+    "serve_zipf": ("inert", "traffic popularity skew — load shape, "
+                            "read-only"),
+    "serve_wire": ("inert", "push codec; reconstruction is "
+                            "bit-identical to the disk checkpoint by "
+                            "the shared-decode contract"),
+    "serve_push_every": ("inert", "push cadence — staleness/timing, "
+                                  "not what gets trained"),
+    "serve_ckpt_dir": ("inert", "servable checkpoint output path"),
+    "serve_out": ("inert", "serving output path"),
+    "serve_trace": ("inert", "request trace output path"),
+    "serve_replay": ("inert", "replays a request stream — inference "
+                              "inputs, not training"),
+    "serve_store": ("inert", "row residency only — the client_store "
+                             "precedent, resident==streamed"),
+    "serve_timeout_s": ("inert", "drain/ack wait budget, timing only"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

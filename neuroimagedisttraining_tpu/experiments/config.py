@@ -453,6 +453,74 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "<results_dir>/fed/<identity>): per-process "
                         "JSONL streams, the folded federation.jsonl, "
                         "trace.json, summary.json")
+    # -- serving plane (serve/): the checkpoint-streaming inference
+    # worker. Serving never touches training lineage — every serve_*
+    # flag is census-classified inert
+    p.add_argument("--serve_role", type=str, default="",
+                   choices=["", "worker", "publisher"],
+                   help="serving-plane role: 'worker' serves per-client "
+                        "inference (with --serve_backend local it also "
+                        "hosts the publisher's training loop in-process); "
+                        "'publisher' trains and streams checkpoints "
+                        "(tcp only). Empty = not a serving run")
+    p.add_argument("--serve_backend", type=str, default="local",
+                   choices=["local", "tcp"],
+                   help="serving transport: 'local' = in-process "
+                        "loopback (tests/CI), 'tcp' = the native "
+                        "transport across real processes")
+    p.add_argument("--serve_endpoints", type=str, default="",
+                   help="rank-ordered 'host:port,host:port' — rank 0 "
+                        "publisher, rank 1 worker (--serve_backend tcp)")
+    p.add_argument("--serve_requests", type=int, default=256,
+                   help="synthetic requests the worker's traffic pump "
+                        "submits (Zipf-skewed client popularity)")
+    p.add_argument("--serve_rps", type=float, default=200.0,
+                   help="open-loop target request rate (requests/sec); "
+                        "the schedule never slips with service time, so "
+                        "a slow worker builds queue depth")
+    p.add_argument("--serve_batch", type=int, default=16,
+                   help="micro-batch slab width: the one compiled "
+                        "forward's leading axis (partial batches pad)")
+    p.add_argument("--serve_linger_ms", type=float, default=2.0,
+                   help="micro-batch coalescing window from the OLDEST "
+                        "pending request — the tail-latency bound")
+    p.add_argument("--serve_zipf", type=float, default=1.1,
+                   help="Zipf skew exponent for client popularity "
+                        "(1.0-1.2 is the classic web range; larger = "
+                        "hotter head — harder on the store LRU)")
+    p.add_argument("--serve_wire", type=str, default="int8",
+                   choices=["dense", "bf16", "int8"],
+                   help="fed/wire codec for checkpoint delta pushes "
+                        "(first push is always dense full). The worker "
+                        "stays bit-identical to the disk checkpoint "
+                        "through ANY of these — lossy exactly once, at "
+                        "encode")
+    p.add_argument("--serve_push_every", type=int, default=1,
+                   help="publisher pushes a model version every N "
+                        "training rounds")
+    p.add_argument("--serve_ckpt_dir", type=str, default="",
+                   help="servable checkpoint dir (default: "
+                        "<serve_out>/ckpt); the bit-identity gate "
+                        "compares the live model against these files")
+    p.add_argument("--serve_out", type=str, default="",
+                   help="serving output dir (default: "
+                        "<results_dir>/serve/<identity>-serve): the "
+                        "per-tick JSONL/events streams, metrics.json, "
+                        "store rows, checkpoints")
+    p.add_argument("--serve_trace", type=str, default="",
+                   help="record the served request stream here (JSON; "
+                        "replayable with --serve_replay)")
+    p.add_argument("--serve_replay", type=str, default="",
+                   help="serve a recorded request trace instead of a "
+                        "fresh Zipf draw (replay-equality contract)")
+    p.add_argument("--serve_store", type=str, default="disk",
+                   choices=["disk", "host"],
+                   help="personal-model population tier (core/"
+                        "client_store): 'disk' rows + host-RAM LRU hot "
+                        "set (--store_hot_clients), or all-host")
+    p.add_argument("--serve_timeout_s", type=float, default=60.0,
+                   help="drain/ack wait budget: worker waits this long "
+                        "for serve_finish; publisher for the last ack")
     p.add_argument("--checkpoint_dir", type=str, default="",
                    help="enable round-granular orbax checkpointing here")
     p.add_argument("--resume", action="store_true",
@@ -749,6 +817,30 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
                 not os.path.isfile(args.fed_replay):
             raise ValueError(
                 f"--fed_replay trace {args.fed_replay!r} does not exist")
+    # serving plane (serve/): parse-time validation of what can be
+    # checked without building anything (the fault_spec rule); the
+    # full refusal cluster runs in serve.runtime.validate_serve_args
+    serve_role = getattr(args, "serve_role", "")
+    if serve_role:
+        if fed_role:
+            raise ValueError(
+                "--serve_role and --fed_role are different processes; "
+                "run the federation and the serving worker separately")
+        if getattr(args, "serve_backend", "local") == "local" and \
+                serve_role != "worker":
+            raise ValueError(
+                "--serve_backend local hosts the publisher in-process; "
+                "--serve_role publisher needs --serve_backend tcp")
+        if getattr(args, "serve_backend", "local") == "tcp" and \
+                not getattr(args, "serve_endpoints", ""):
+            raise ValueError(
+                "--serve_backend tcp needs --serve_endpoints "
+                "host:port,host:port (rank 0 publisher, rank 1 worker)")
+        if getattr(args, "serve_replay", "") and \
+                not os.path.isfile(args.serve_replay):
+            raise ValueError(
+                f"--serve_replay trace {args.serve_replay!r} does not "
+                "exist")
     return args
 
 

@@ -733,6 +733,17 @@ def run_experiment(args: argparse.Namespace,
     import jax
 
     algo_name = algo_name or getattr(args, "algo", "fedavg")
+    if getattr(args, "serve_role", ""):
+        # serving plane (serve/): the checkpoint-streaming inference
+        # worker / publisher pair — its own lifecycle, obs session, and
+        # refusal cluster. Dispatched before the fed runtime (the two
+        # roles refuse each other) and before checkpoint/obs setup: the
+        # serve runtime owns all of it
+        from ..serve.runtime import run_serving
+
+        configure_console()
+        seed_everything(args.seed)
+        return run_serving(args, algo_name)
     if getattr(args, "fed_role", ""):
         # distributed federation (fed/): a genuinely multi-process
         # deployment — its own round loop, obs streams, and lifecycle.

@@ -252,10 +252,14 @@ def entry_from_run(run_dir: str, identity: str,
         final_metrics=final_metrics_from_records(records),
         slo_health=health, event_counts=counts,
         rounds_recorded=n_rounds, artifacts=artifacts,
-        # finish() leaves one of two traces: the final (round -1) eval
-        # record, or — on runs with final eval disabled — the
-        # metrics.json snapshot it always writes before closing
+        # finish() leaves one of three traces: the final (round -1)
+        # eval record, the metrics.json snapshot it always writes
+        # before closing, or — serving streams (serve/), which have no
+        # training round -1 — the graceful-drain marker the worker
+        # writes after serving its last request
         completed=(any(r.get("round") == -1 for r in records)
+                   or any(bool(r.get("serve_drained"))
+                          for r in records)
                    or os.path.exists(metrics_json)),
         obs_schema=schema)
 

@@ -1,0 +1,190 @@
+"""Checkpoint publisher: the training-side model push hook.
+
+``CheckpointPublisher.publish(params, version)`` does three things in
+one deterministic motion:
+
+1. **Encode** the update for the wire. The first publish ships the
+   full params dense (nothing exists to delta from); every later one
+   ships ``params - base`` in a ``fed/wire`` codec (int8 default,
+   ``--serve_wire``), where ``base`` is the previous *reconstructed*
+   version.
+2. **Reconstruct** the servable model by decoding its own payload:
+   ``base' = base + decode(encode(delta))``. The lossy impls lose
+   precision exactly once, at encode — so the worker decoding the
+   identical payload lands on the identical float32 bytes. This is the
+   error-feedback trick from the top-k wire applied to model pushes:
+   quantization error is carried in ``params - base`` and re-shipped
+   next version, it never compounds silently.
+3. **Checkpoint** the reconstruction to disk (atomic tmp+rename,
+   ``comm/message.py`` binary pytree framing — the same serializer the
+   wire uses, so "bit-identical to loading the checkpoint from disk"
+   is a structural property, not a numerical hope).
+
+The worker ACKs each adopted version (``serve_ack``); ``wait_acked``
+is the publisher's pacing/accounting hook and the smoke's proof that
+>= N pushes actually landed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..comm.manager import ServerManager
+from ..comm.message import Message
+from ..fed import wire
+from ..fed.protocol import send_with_retry
+from . import (MSG_SERVE_ACK, MSG_SERVE_FINISH, MSG_SERVE_PUSH,
+               PUSH_WIRE_IMPLS)
+
+logger = logging.getLogger(__name__)
+
+
+def _np_f32_tree(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32), tree)
+
+
+def _tree_add(a: Any, b: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x, y: (np.asarray(x, np.float32)
+                      + np.asarray(y, np.float32)), a, b)
+
+
+def _tree_sub(a: Any, b: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x, y: (np.asarray(x, np.float32)
+                      - np.asarray(y, np.float32)), a, b)
+
+
+# -- checkpoint files ----------------------------------------------------
+
+def checkpoint_path(ckpt_dir: str, version: int) -> str:
+    return os.path.join(ckpt_dir, f"model_v{int(version):05d}.bin")
+
+
+def save_checkpoint(ckpt_dir: str, version: int, params: Any) -> str:
+    """Write one servable model version (atomic: a concurrent loader
+    never sees a torn file)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    msg = Message("serve_ckpt", 0, 0)
+    msg.add("version", int(version))
+    msg.add_tensor("params", _np_f32_tree(params))
+    path = checkpoint_path(ckpt_dir, version)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(msg.to_bytes())
+    os.replace(tmp, path)
+    return path
+
+
+def load_checkpoint(path: str) -> Tuple[int, Any]:
+    """``(version, params)`` — the disk half of the bit-identity
+    contract the smoke gates."""
+    with open(path, "rb") as f:
+        msg = Message.from_bytes(f.read())
+    return int(msg.get("version")), msg.get_tensor("params")
+
+
+class CheckpointPublisher(ServerManager):
+    """Rank-0 manager the training loop calls ``publish`` on."""
+
+    def __init__(self, comm, rank: int = 0, world_size: int = 2,
+                 worker_rank: int = 1, ckpt_dir: str = "",
+                 wire_impl: str = "int8", retries: int = 2,
+                 backoff_s: float = 0.05):
+        super().__init__(comm, rank=rank, world_size=world_size)
+        if wire_impl not in PUSH_WIRE_IMPLS:
+            raise ValueError(
+                f"push wire {wire_impl!r} not in {PUSH_WIRE_IMPLS}")
+        self.worker_rank = int(worker_rank)
+        self.ckpt_dir = ckpt_dir
+        self.wire_impl = wire_impl
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self._base: Optional[Any] = None  # last reconstructed version
+        self.pushes = 0
+        self.bytes_pushed = 0
+        self._ack_cond = threading.Condition()
+        self._acked_version = -1
+        self.register_message_receive_handler(MSG_SERVE_ACK,
+                                              self._on_ack)
+
+    # -- protocol ---------------------------------------------------------
+    def _on_ack(self, msg: Message) -> None:
+        with self._ack_cond:
+            self._acked_version = max(self._acked_version,
+                                      int(msg.get("version")))
+            self._ack_cond.notify_all()
+
+    @property
+    def acked_version(self) -> int:
+        with self._ack_cond:
+            return self._acked_version
+
+    def wait_acked(self, version: int, timeout_s: float = 30.0) -> bool:
+        deadline = time.perf_counter() + float(timeout_s)
+        with self._ack_cond:
+            while self._acked_version < int(version):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._ack_cond.wait(left)
+        return True
+
+    # -- the push ---------------------------------------------------------
+    def publish(self, params: Any, version: int) -> str:
+        """Ship one model version to the worker and checkpoint the
+        reconstruction; returns the checkpoint path ('' if ckpt_dir is
+        unset)."""
+        params = _np_f32_tree(params)
+        msg = Message(MSG_SERVE_PUSH, self.rank, self.worker_rank)
+        msg.add("version", int(version))
+        if self._base is None:
+            # the baseline: full params, dense — bit-exact by
+            # construction, and the only push that may not be a delta
+            msg.add("kind", "full")
+            wire.encode_update(msg, params, "dense", key="delta")
+            self._base = wire.decode_update(msg, key="delta")
+        else:
+            delta = _tree_sub(params, self._base)
+            msg.add("kind", "delta")
+            wire.encode_update(msg, delta, self.wire_impl, key="delta")
+            # decode OUR OWN payload: the worker's reconstruction twin
+            self._base = _tree_add(self._base,
+                                   wire.decode_update(msg, key="delta"))
+        payload = msg.to_bytes()
+        self.bytes_pushed += len(payload)
+        send_with_retry(self, msg, retries=self.retries,
+                        backoff_s=self.backoff_s)
+        self.pushes += 1
+        path = ""
+        if self.ckpt_dir:
+            path = save_checkpoint(self.ckpt_dir, version, self._base)
+        logger.info("serve publish v%d: %s wire, %d B%s",
+                    version, msg.get("kind"), len(payload),
+                    f" -> {path}" if path else "")
+        return path
+
+    def finish_worker(self) -> None:
+        """Tell the worker to drain and exit (``serve_finish``)."""
+        msg = Message(MSG_SERVE_FINISH, self.rank, self.worker_rank)
+        send_with_retry(self, msg, retries=self.retries,
+                        backoff_s=self.backoff_s)
+
+    @property
+    def servable_params(self) -> Optional[Any]:
+        """The current reconstructed model — what the worker serves
+        after adopting the latest push (and what the checkpoint
+        holds)."""
+        return self._base

@@ -1,0 +1,271 @@
+"""ServeWorker: the rank-1 inference process.
+
+Three planes, one class:
+
+* **Model plane** — ``serve_push`` handler: decode the ``fed/wire``
+  payload, reconstruct (full push = the new base; delta push =
+  ``base + decode(delta)``, the publisher's reconstruction twin), and
+  swap atomically under a lock: requests batched before the swap ran
+  on the old version, requests after it run on the new one, nothing
+  ever sees a half-updated tree. The swap is host-side (the device
+  copy is ``jax.device_put`` of the finished tree), so "atomic" is a
+  single reference assignment.
+* **Data plane** — the serve loop: pull a micro-batch, gather the
+  clients' personal-delta rows from the ``core/client_store.py`` tier
+  (disk population, host LRU hot set — the hit/miss counters become
+  the per-tick ``serve_hit_rate`` gauge), pad to the fixed slab width
+  (one compiled shape; padding rows are replicas, their outputs
+  dropped), run the ONE vmapped jitted forward
+  ``vmap(apply(g + delta_c, x_c))``, block, stamp latencies.
+* **Obs plane** — every tick writes one record through a real
+  ``obs.export.ObsSession`` (``record_round`` with the tick index as
+  the round key): latency/throughput/hit-rate/staleness/version land
+  on the JSONL line, the SLO engine evaluates objectives like
+  ``p99:serve_latency_ms<50@w=200`` live, breaches become typed
+  events, and the catalog entry at close carries the serving gauges.
+
+The drain contract (the satellite-6 fix rides it): on
+``serve_finish`` the loop finishes the queue, writes a final
+``{"round": -1, "serve_drained": true, ...}`` totals record — the
+serving stream's graceful-completion trace, which both the live
+session (``finish()`` -> ``completed=true``) and the offline catalog
+rebuild (``obs/catalog.py entry_from_run``) recognize.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..comm.manager import ClientManager
+from ..comm.message import Message
+from ..fed import wire
+from ..fed.protocol import send_with_retry
+from . import MSG_SERVE_ACK, MSG_SERVE_FINISH, MSG_SERVE_PUSH
+from .batcher import MicroBatcher
+
+logger = logging.getLogger(__name__)
+
+#: store field holding each client's personal delta against the global
+#: model (served params = global + delta_c; unwritten rows synthesize
+#: byte-exact zeros — an unpersonalized client serves the global model)
+PERSONAL_FIELD = "personal_delta"
+
+
+class ServeWorker(ClientManager):
+    """``apply_fn`` is the algorithm's own
+    (``models.make_apply_fn``) so serving runs the exact training
+    forward; ``init_params`` seeds version 0 (served until the first
+    push lands); ``data_x``/``data_n`` are the synthetic volumes the
+    requests index."""
+
+    def __init__(self, comm, rank: int, world_size: int, apply_fn,
+                 init_params: Any, store, data_x, data_n,
+                 batcher: MicroBatcher, session=None,
+                 retries: int = 2, backoff_s: float = 0.05):
+        super().__init__(comm, rank=rank, world_size=world_size)
+        import jax
+
+        self.apply_fn = apply_fn
+        self.store = store
+        self.data_x = np.asarray(data_x)
+        self.data_n = np.asarray(data_n)
+        self.batcher = batcher
+        self.session = session
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        # model plane
+        self._swap_lock = threading.Lock()
+        self._g_host = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32), init_params)
+        self._g_dev = jax.device_put(self._g_host)
+        self.version = 0
+        self._last_swap_t = time.perf_counter()
+        self.pushes_adopted = 0
+        # data plane
+        self.done = threading.Event()       # serve_finish received
+        self.traffic_done = threading.Event()  # all requests submitted
+        self.drained = threading.Event()    # serve loop exited
+        self.requests_served = 0
+        self.batches_served = 0
+        self._hits0 = self._miss0 = 0.0
+        self._t_prev_tick: Optional[float] = None
+
+        def _serve_batch(deltas, x, g):
+            def one(delta, xi):
+                p = jax.tree_util.tree_map(
+                    lambda a, b: a + b, g, delta)
+                return self.apply_fn(p, xi[None], False, None)[0]
+
+            return jax.vmap(one)(deltas, x)
+
+        self._jserve = jax.jit(_serve_batch)
+        self.register_message_receive_handler(MSG_SERVE_PUSH,
+                                              self._on_push)
+        self.register_message_receive_handler(MSG_SERVE_FINISH,
+                                              self._on_finish)
+
+    # -- model plane ------------------------------------------------------
+    @property
+    def global_params(self) -> Any:
+        """The served model's host tree (the bit-identity gate compares
+        this against the publisher's on-disk checkpoint)."""
+        with self._swap_lock:
+            return self._g_host
+
+    def _on_push(self, msg: Message) -> None:
+        import jax
+
+        version = int(msg.get("version"))
+        kind = msg.get("kind")
+        payload = wire.decode_update(msg, key="delta")
+        if kind == "full":
+            new_host = jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float32), payload)
+        else:
+            with self._swap_lock:
+                base = self._g_host
+            new_host = jax.tree_util.tree_map(
+                lambda b, d: (np.asarray(b, np.float32)
+                              + np.asarray(d, np.float32)),
+                base, payload)
+        new_dev = jax.device_put(new_host)
+        with self._swap_lock:
+            self._g_host = new_host
+            self._g_dev = new_dev
+            self.version = version
+            self._last_swap_t = time.perf_counter()
+        self.pushes_adopted += 1
+        if self.session is not None:
+            self.session.registry.gauge("serve_model_version").set(
+                float(version))
+            self.session.registry.counter(
+                "serve_pushes_adopted_total").inc()
+        ack = Message(MSG_SERVE_ACK, self.rank, msg.sender_id)
+        ack.add("version", version)
+        send_with_retry(self, ack, retries=self.retries,
+                        backoff_s=self.backoff_s)
+        logger.info("serve worker adopted v%d (%s push)", version, kind)
+
+    def _on_finish(self, msg: Message) -> None:
+        self.done.set()
+        # wake the serve loop if it is parked in next_batch
+        self.batcher.wake()
+
+    def mark_traffic_done(self) -> None:
+        """The traffic pump's last act. The serve loop may not exit on
+        a momentarily-empty queue while submissions are still coming
+        (``serve_finish`` from a remote publisher races the local
+        pump); this event closes that hole."""
+        self.traffic_done.set()
+        self.batcher.wake()
+
+    # -- data plane -------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile the serve program off the latency clock (first-batch
+        latency would otherwise be the XLA compile, not the serve)."""
+        import jax
+
+        ids = [0] * self.batcher.max_batch
+        deltas = self.store.gather(PERSONAL_FIELD, ids)
+        x = self.data_x[ids, 0]
+        out = self._jserve(jax.device_put(deltas), jax.device_put(x),
+                           self._g_dev)
+        jax.block_until_ready(out)
+
+    def _tick_record(self, tick: int, batch, lat_ms: np.ndarray,
+                     wall_s: float) -> Dict[str, Any]:
+        hits = float(self.store.hits)
+        misses = float(self.store.misses)
+        dh, dm = hits - self._hits0, misses - self._miss0
+        self._hits0, self._miss0 = hits, misses
+        now = time.perf_counter()
+        rps = (len(batch) / (now - self._t_prev_tick)
+               if self._t_prev_tick is not None and
+               now > self._t_prev_tick else 0.0)
+        self._t_prev_tick = now
+        with self._swap_lock:
+            version = self.version
+            staleness = now - self._last_swap_t
+        return {
+            "round": int(tick),
+            "serve_requests": float(len(batch)),
+            "serve_batch_fill": len(batch) / self.batcher.max_batch,
+            "serve_latency_ms": float(np.max(lat_ms)),
+            "serve_latency_mean_ms": float(np.mean(lat_ms)),
+            "serve_wall_ms": wall_s * 1e3,
+            "serve_rps": float(rps),
+            "serve_queue_depth": float(self.batcher.depth()),
+            "serve_hit_rate": (dh / (dh + dm)) if dh + dm else 1.0,
+            "serve_model_version": float(version),
+            "serve_model_staleness_s": float(staleness),
+        }
+
+    def _serve_one(self, batch, tick: int) -> None:
+        import jax
+
+        t0 = time.perf_counter()
+        ids = [r.client_id for r in batch]
+        deltas = self.store.gather(PERSONAL_FIELD, ids)
+        x = self.data_x[ids, [r.sample_idx for r in batch]]
+        pad = self.batcher.max_batch - len(batch)
+        if pad:
+            # fixed slab width = one compiled shape; pad AFTER the
+            # gather (replicated rows must not inflate hit counters)
+            deltas = jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [a, np.repeat(a[:1], pad, axis=0)]), deltas)
+            x = np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
+        with self._swap_lock:
+            g = self._g_dev
+        out = self._jserve(jax.device_put(deltas), jax.device_put(x), g)
+        jax.block_until_ready(out)
+        t1 = time.perf_counter()
+        lat_ms = np.asarray([(t1 - r.t_submit) * 1e3 for r in batch])
+        self.requests_served += len(batch)
+        self.batches_served += 1
+        if self.session is not None:
+            reg = self.session.registry
+            reg.counter("serve_requests_total").inc(float(len(batch)))
+            reg.counter("serve_batches_total").inc()
+            reg.distribution("serve_latency_ms").observe(
+                float(np.max(lat_ms)))
+            self.session.record_round(
+                self._tick_record(tick, batch, lat_ms, t1 - t0))
+
+    def serve_loop(self) -> None:
+        """Drain-aware consumer loop (run in its own thread): serve
+        until ``serve_finish`` has landed, the traffic pump is done
+        submitting, AND the queue is empty."""
+        tick = 0
+        try:
+            while True:
+                batch = self.batcher.next_batch(timeout_s=0.05)
+                if batch:
+                    self._serve_one(batch, tick)
+                    tick += 1
+                elif (self.done.is_set() and self.traffic_done.is_set()
+                        and self.batcher.depth() == 0):
+                    break
+        finally:
+            self.drained.set()
+
+    def drain_record(self) -> Dict[str, Any]:
+        """The graceful-drain totals record (``round=-1`` +
+        ``serve_drained`` — the serving stream's completion trace)."""
+        hits = float(self.store.hits)
+        misses = float(self.store.misses)
+        return {
+            "round": -1,
+            "serve_drained": True,
+            "serve_requests_total": float(self.requests_served),
+            "serve_batches_total": float(self.batches_served),
+            "serve_hit_rate_total": (hits / (hits + misses)
+                                     if hits + misses else 1.0),
+            "serve_pushes_adopted": float(self.pushes_adopted),
+            "serve_model_version": float(self.version),
+            **self.comm.counters.snapshot(),
+        }

@@ -107,6 +107,41 @@ ATTACK_SPECS = {
 #: robust statistics each adversary is crossed with
 ATTACK_AGGS = ("median", "krum")
 
+#: accuracy-under-attack SLO: the same objective through three
+#: estimator kinds (obs/slo.py DSL) — EWMA drift floor, windowed-mean
+#: floor, lower-quartile floor. Each attack cell's eval-round history
+#: replays through a fresh engine offline; the per-estimator
+#: breach/no-breach verdict is pinned into the matrix output (the
+#: robustness claim as an SLO, not a one-off assert).
+ATTACK_SLO = ("ewma:global_acc>0.4@a=0.3;"
+              "rate:global_acc>0.4@w=6;"
+              "p25:global_acc>0.35@w=6")
+
+
+def attack_slo_verdicts(name: str, history) -> dict:
+    """Replay one attacked run's round records through the SLO engine;
+    every estimator must produce a verdict (evaluate at least once)."""
+    from neuroimagedisttraining_tpu.obs.slo import (SloEngine,
+                                                    parse_slo_spec)
+
+    engine = SloEngine(parse_slo_spec(ATTACK_SLO))
+    engine.replay([h for h in history
+                   if isinstance(h.get("round"), int)])
+    verdicts = {}
+    for obj_name, obj in engine.summary()["objectives"].items():
+        if not obj["evaluated"]:
+            raise SystemExit(
+                f"[{name}] SLO estimator {obj_name} never evaluated — "
+                "the attacked history carries no global_acc records")
+        verdicts[obj_name] = {
+            "breached": bool(obj["violating"]
+                             or obj["budget_exhausted"]),
+            "violations": obj["violations"],
+            "compliance": round(obj["compliance"], 4),
+            "value": obj["value"],
+        }
+    return verdicts
+
 
 def run_attack_matrix(clients: int, rounds: int, tmp: str) -> dict:
     """Adversary x robust_agg x deployment scenario matrix (CI scale)."""
@@ -123,7 +158,8 @@ def run_attack_matrix(clients: int, rounds: int, tmp: str) -> dict:
             raise SystemExit(f"[{name}] non-finite train loss")
         if not tree_finite(out["state"].global_params):
             raise SystemExit(f"[{name}] non-finite final global params")
-        return float(hist[-1]["train_loss"])
+        return {"final_train_loss": float(hist[-1]["train_loss"]),
+                "slo": attack_slo_verdicts(name, out["history"])}
 
     # -- in-process: adversary x robust statistic -------------------------
     for adv, spec in ATTACK_SPECS.items():
@@ -188,6 +224,7 @@ def run_attack_matrix(clients: int, rounds: int, tmp: str) -> dict:
     return {
         "attack_matrix_ok": True, "clients": clients, "rounds": rounds,
         "cells": cells, "aggs": list(ATTACK_AGGS),
+        "attack_slo": ATTACK_SLO,
         "fed_modes": ["sync", "buffered"], "bit_identical": True,
         "wall_s": round(time.perf_counter() - t0, 2),
     }

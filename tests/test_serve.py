@@ -1,0 +1,243 @@
+"""Serving subsystem contracts: batcher semantics, the refusal
+cluster, the catalog drain fix, and one end-to-end loopback run.
+
+The end-to-end test is the in-process twin of ``scripts/serve_smoke.py``:
+a real training loop streams checkpoints to a real worker absorbing
+Zipf traffic against a disk-resident store, and the JSONL/SLO/catalog
+surfaces all carry the serving gauges.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.serve.batcher import (MicroBatcher,
+                                                      ServeRequest)
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_full_batch_closes_immediately():
+    b = MicroBatcher(max_batch=4, linger_ms=10_000.0)
+    for i in range(4):
+        b.submit(ServeRequest(i, 0))
+    t0 = time.perf_counter()
+    batch = b.next_batch(timeout_s=5.0)
+    # a full slab never waits out the linger
+    assert time.perf_counter() - t0 < 1.0
+    assert [r.client_id for r in batch] == [0, 1, 2, 3]
+    assert b.depth() == 0
+
+
+def test_batcher_linger_closes_partial_batch():
+    b = MicroBatcher(max_batch=64, linger_ms=30.0)
+    b.submit(ServeRequest(7, 1))
+    t0 = time.perf_counter()
+    batch = b.next_batch(timeout_s=5.0)
+    waited = time.perf_counter() - t0
+    assert [r.client_id for r in batch] == [7]
+    # closed by the linger deadline, not the 5s timeout
+    assert waited < 2.0
+
+
+def test_batcher_timeout_returns_none():
+    b = MicroBatcher(max_batch=4, linger_ms=1.0)
+    assert b.next_batch(timeout_s=0.02) is None
+
+
+def test_batcher_overflow_spills_to_next_batch():
+    b = MicroBatcher(max_batch=3, linger_ms=0.0)
+    for i in range(5):
+        b.submit(ServeRequest(i, 0))
+    assert len(b.next_batch(timeout_s=1.0)) == 3
+    assert len(b.next_batch(timeout_s=1.0)) == 2
+
+
+def test_batcher_wake_unblocks_consumer():
+    b = MicroBatcher(max_batch=4, linger_ms=5.0)
+    out = {}
+
+    def consume():
+        out["batch"] = b.next_batch(timeout_s=10.0)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    b.wake()
+    t.join(timeout=1.0)
+    # woken with an empty queue: re-checks, sees nothing, keeps waiting
+    # until ITS deadline — so wake alone must not hang the consumer
+    # forever when a submit follows
+    b.submit(ServeRequest(1, 0))
+    t.join(timeout=6.0)
+    assert not t.is_alive()
+
+
+def test_batcher_validation():
+    with pytest.raises(ValueError):
+        MicroBatcher(max_batch=0)
+    with pytest.raises(ValueError):
+        MicroBatcher(linger_ms=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# refusal cluster (parse-time and runtime)
+# ---------------------------------------------------------------------------
+
+def _parse(extra):
+    from neuroimagedisttraining_tpu.experiments.config import parse_args
+
+    return parse_args(["--model", "small3dcnn", "--dataset",
+                       "synthetic", "--client_num_in_total", "8",
+                       "--comm_round", "1"] + extra)
+
+
+def test_parse_refuses_serve_plus_fed_role():
+    with pytest.raises(ValueError, match="different processes"):
+        _parse(["--serve_role", "worker", "--fed_role", "aggregator",
+                "--fed_sites", "2"])
+
+
+def test_parse_refuses_local_publisher():
+    with pytest.raises(ValueError, match="needs --serve_backend tcp"):
+        _parse(["--serve_role", "publisher",
+                "--serve_backend", "local"])
+
+
+def test_parse_refuses_tcp_without_endpoints():
+    with pytest.raises(ValueError, match="serve_endpoints"):
+        _parse(["--serve_role", "worker", "--serve_backend", "tcp"])
+
+
+def test_parse_refuses_missing_replay_trace():
+    with pytest.raises(ValueError, match="does not exist"):
+        _parse(["--serve_role", "worker",
+                "--serve_replay", "/nonexistent/trace.json"])
+
+
+def test_runtime_refusals():
+    from neuroimagedisttraining_tpu.serve.runtime import \
+        validate_serve_args
+
+    args = _parse(["--serve_role", "worker"])
+    with pytest.raises(SystemExit, match="unsupported"):
+        validate_serve_args(args, "fedprox")
+    args = _parse(["--serve_role", "worker", "--serve_requests", "0"])
+    with pytest.raises(SystemExit, match="serve_requests"):
+        validate_serve_args(args, "fedavg")
+    args = _parse(["--serve_role", "worker", "--serve_rps", "0"])
+    with pytest.raises(SystemExit, match="serve_rps"):
+        validate_serve_args(args, "fedavg")
+    args = _parse(["--serve_role", "worker", "--multihost"])
+    with pytest.raises(SystemExit, match="multihost"):
+        validate_serve_args(args, "fedavg")
+
+
+def test_serve_flags_are_census_classified():
+    """Satellite: every serve_* flag must be classified in the identity
+    census (lint_gate runs the census with findings=0)."""
+    from neuroimagedisttraining_tpu.analysis.identity import \
+        FLAG_CLASSES
+    from neuroimagedisttraining_tpu.experiments.config import \
+        parse_args
+
+    args = parse_args(["--model", "small3dcnn", "--dataset",
+                       "synthetic"])
+    serve_flags = [k for k in vars(args) if k.startswith("serve_")]
+    assert serve_flags, "no serve_* flags parsed?"
+    for flag in serve_flags:
+        assert flag in FLAG_CLASSES, f"{flag} unclassified"
+        cls, _why = FLAG_CLASSES[flag]
+        assert cls == "inert", (
+            f"{flag} classified {cls!r}: serving must never fork "
+            "training lineage")
+
+
+# ---------------------------------------------------------------------------
+# catalog: serving streams complete on graceful drain (the fix)
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_catalog_serving_stream_completes_on_drain(tmp_path):
+    from neuroimagedisttraining_tpu.obs import catalog
+
+    run_dir = str(tmp_path)
+    ticks = [{"round": t, "serve_latency_ms": 3.0 + t,
+              "serve_requests": 8.0} for t in range(4)]
+    # graceful drain: no training round -1 eval record, no
+    # metrics.json — the serve_drained marker alone must complete it
+    _write_jsonl(os.path.join(run_dir, "w1-serve.obs.jsonl"),
+                 ticks + [{"round": -1, "serve_drained": True,
+                           "serve_requests_total": 32.0}])
+    # crashed twin: same ticks, no drain record
+    _write_jsonl(os.path.join(run_dir, "w2-serve.obs.jsonl"), ticks)
+    entries = {e["identity"]: e for e in catalog.scan(run_dir)}
+    assert entries["w1-serve"]["completed"] is True
+    assert entries["w2-serve"]["completed"] is False
+    assert entries["w1-serve"]["rounds_recorded"] == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end loopback (the serve_smoke twin, pytest-sized)
+# ---------------------------------------------------------------------------
+
+def test_serving_loopback_end_to_end(tmp_path):
+    from neuroimagedisttraining_tpu.experiments.config import parse_args
+    from neuroimagedisttraining_tpu.experiments.runner import \
+        run_experiment
+
+    tmp = str(tmp_path)
+    trace = os.path.join(tmp, "trace.json")
+    args = parse_args([
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "16", "--frac", "0.25",
+        "--batch_size", "8", "--epochs", "1", "--comm_round", "2",
+        "--lr", "0.05", "--seed", "3", "--final_finetune", "0",
+        "--results_dir", os.path.join(tmp, "results"),
+        "--log_dir", os.path.join(tmp, "LOG"),
+        "--serve_role", "worker", "--serve_backend", "local",
+        "--serve_requests", "48", "--serve_rps", "400",
+        "--serve_batch", "8", "--serve_wire", "int8",
+        "--serve_store", "disk", "--store_hot_clients", "4",
+        "--serve_trace", trace,
+        "--slo_spec", "p99:serve_latency_ms<50@w=200",
+    ])
+    out = run_experiment(args)
+    s = out["serve"]
+    assert s["requests"] == 48
+    # full baseline + one delta per round
+    assert s["pushes_adopted"] == 3
+    assert s["model_version"] == 2
+    assert s["bit_identical"] is True
+    assert 0.0 < s["hit_rate"] < 1.0  # hot set of 4/16: real misses
+    assert s["slo"] is not None
+    with open(s["jsonl"]) as f:
+        records = [json.loads(line) for line in f]
+    ticks = [r for r in records
+             if isinstance(r.get("round"), int) and r["round"] >= 0]
+    assert ticks
+    for key in ("serve_latency_ms", "serve_hit_rate",
+                "serve_model_version", "serve_model_staleness_s",
+                "serve_rps", "slo_health"):
+        assert key in ticks[-1], key
+    assert any(r.get("serve_drained") for r in records)
+    # the recorded trace replays to the same request count
+    from neuroimagedisttraining_tpu.serve.traffic import trace_load
+
+    assert len(trace_load(trace)) == 48
+    # catalog entry: completed, distinct -serve lineage
+    cat = os.path.join(tmp, "results", "runs_index.jsonl")
+    with open(cat) as f:
+        entries = [json.loads(line) for line in f]
+    mine = [e for e in entries if e["identity"].endswith("-serve")]
+    assert mine and mine[-1]["completed"] is True

@@ -1,0 +1,191 @@
+"""Property tests: serving model pushes are bit-transparent end-to-end.
+
+The publisher ships model versions over ``fed/wire`` codecs (dense
+full baseline, then bf16/int8/dense deltas); the worker reconstructs
+by applying the identical decode to the identical payload. The
+contract pinned here — over the REAL ``CheckpointPublisher`` and
+``ServeWorker`` message handlers, on both the in-memory loopback and
+the native TCP transport: after any push sequence, the worker's
+served model is bit-identical to loading the same version's
+checkpoint from disk. Lossy codecs lose precision exactly once, at
+encode; the reconstruction chains on both ends are twins.
+"""
+import socket
+import tempfile
+
+import numpy as np
+import pytest
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    from _hypothesis_fallback import given, settings, strategies as st
+
+from neuroimagedisttraining_tpu.comm.local import LocalRouter
+from neuroimagedisttraining_tpu.comm.tcp import (TcpCommManager,
+                                                 native_available)
+from neuroimagedisttraining_tpu.serve import PUSH_WIRE_IMPLS
+from neuroimagedisttraining_tpu.serve.batcher import MicroBatcher
+from neuroimagedisttraining_tpu.serve.publisher import (
+    CheckpointPublisher, load_checkpoint)
+from neuroimagedisttraining_tpu.serve.worker import ServeWorker
+
+
+def _assert_tree_identical(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y)
+
+
+def _arrays(draw):
+    shape = tuple(draw(st.lists(st.integers(0, 4), min_size=0,
+                                max_size=2)))
+    n = int(np.prod(shape)) if shape else 1
+    vals = draw(st.lists(st.floats(-4.0, 4.0), min_size=n, max_size=n))
+    return np.asarray(vals, np.float32).reshape(shape)
+
+
+@st.composite
+def param_trees(draw, depth=2):
+    if depth == 0 or draw(st.booleans()):
+        return _arrays(draw)
+    keys = st.text(st.characters(codec="ascii", min_codepoint=97,
+                                 max_codepoint=122), min_size=1,
+                   max_size=4)
+    return draw(st.dictionaries(keys, param_trees(depth=depth - 1),
+                                max_size=3))
+
+
+def _versions(tree):
+    """A deterministic 3-version training trajectory with the same
+    structure: v0 = init, then two drifted updates."""
+    import jax
+
+    v1 = jax.tree_util.tree_map(
+        lambda a: (a * np.float32(1.5) + np.float32(0.25)), tree)
+    v2 = jax.tree_util.tree_map(
+        lambda a: (a * np.float32(0.75) - np.float32(0.125)), tree)
+    return [tree, v1, v2]
+
+
+def _dummy_apply(params, x, train, rng):
+    return np.zeros((x.shape[0], 2), np.float32)
+
+
+def _make_worker(comm):
+    # no traffic in these tests: the data plane is inert, only the
+    # push handler (the model plane) runs
+    return ServeWorker(comm, rank=1, world_size=2,
+                       apply_fn=_dummy_apply,
+                       init_params={"w": np.zeros(1, np.float32)},
+                       store=None, data_x=np.zeros((1, 1, 2)),
+                       data_n=np.ones(1, np.int64),
+                       batcher=MicroBatcher(max_batch=2))
+
+
+def _push_and_compare(pub, worker, versions, timeout_s=20.0):
+    path = ""
+    for v, params in enumerate(versions):
+        path = pub.publish(params, v)
+    assert pub.wait_acked(len(versions) - 1, timeout_s=timeout_s)
+    disk_version, disk_params = load_checkpoint(path)
+    assert disk_version == len(versions) - 1
+    assert worker.version == disk_version
+    # the three-way contract: worker's live tree == publisher's
+    # reconstruction == the disk checkpoint, bitwise
+    _assert_tree_identical(worker.global_params, disk_params)
+    _assert_tree_identical(pub.servable_params, disk_params)
+
+
+@settings(max_examples=8, deadline=None)
+@given(tree=param_trees(), impl=st.sampled_from(PUSH_WIRE_IMPLS))
+def test_push_bit_identity_over_local(tree, impl):
+    # no pytest fixture here: the hypothesis fallback shim calls the
+    # test with strategy kwargs only
+    tmp = tempfile.mkdtemp(prefix="serve_push_")
+    router = LocalRouter(2)
+    worker = _make_worker(router.manager(1))
+    worker.run(background=True)
+    pub = CheckpointPublisher(router.manager(0), ckpt_dir=tmp,
+                              wire_impl=impl)
+    pub.run(background=True)
+    try:
+        _push_and_compare(pub, worker, _versions(tree))
+    finally:
+        worker.finish()
+        pub.finish()
+
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++/native build unavailable")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@needs_native
+@pytest.mark.parametrize("impl", PUSH_WIRE_IMPLS)
+def test_push_bit_identity_over_tcp(impl, tmp_path):
+    """The same contract through the REAL TCP transport — the
+    deployment shape scripts/serve_smoke.py gates in CI."""
+    rng = np.random.default_rng(13)
+    tree = {"conv": {"w": rng.standard_normal((3, 4)).astype(np.float32),
+                     "b": np.zeros((4,), np.float32)},
+            "head": {"k": rng.standard_normal((5,)).astype(np.float32)}}
+    eps = [("127.0.0.1", p) for p in _free_ports(2)]
+    worker = _make_worker(TcpCommManager(1, eps))
+    worker.run(background=True)
+    pub = CheckpointPublisher(TcpCommManager(0, eps),
+                              ckpt_dir=str(tmp_path), wire_impl=impl)
+    pub.run(background=True)
+    try:
+        _push_and_compare(pub, worker, _versions(tree))
+    finally:
+        worker.finish()
+        pub.finish()
+
+
+def test_lossy_push_still_converges_to_checkpoint(tmp_path):
+    """int8 deltas are lossy against the TRUE params but exact against
+    the reconstruction — after many pushes the worker still equals the
+    checkpoint bit-for-bit (error feedback: quantization error is
+    re-shipped, never silently accumulated)."""
+    rng = np.random.default_rng(5)
+    base = {"w": rng.standard_normal(32).astype(np.float32)}
+    versions = [base]
+    for _ in range(6):
+        versions.append({"w": (versions[-1]["w"]
+                               + rng.standard_normal(32)
+                               .astype(np.float32) * np.float32(0.1))})
+    router = LocalRouter(2)
+    worker = _make_worker(router.manager(1))
+    worker.run(background=True)
+    pub = CheckpointPublisher(router.manager(0),
+                              ckpt_dir=str(tmp_path), wire_impl="int8")
+    pub.run(background=True)
+    try:
+        _push_and_compare(pub, worker, versions)
+        # and the reconstruction is NOT the raw params (int8 is lossy
+        # on the wire) — the bit-identity above is a property of the
+        # shared decode chain, not of a lossless codec
+        assert not np.array_equal(
+            np.asarray(pub.servable_params["w"]),
+            versions[-1]["w"])
+    finally:
+        worker.finish()
+        pub.finish()
