@@ -227,6 +227,16 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "serve_store": ("inert", "row residency only — the client_store "
                              "precedent, resident==streamed"),
     "serve_timeout_s": ("inert", "drain/ack wait budget, timing only"),
+    "serve_probe_every": ("inert", "read-only eval probe on the "
+                                   "serving worker — telemetry, "
+                                   "never training"),
+    # cross-process distributed tracing (obs/xtrace.py): pure
+    # telemetry — tracing off is byte-inert on every wire, tracing on
+    # adds control-plane headers the decode path ignores
+    "xtrace": ("inert", "span telemetry + clock-sync frames; decode "
+                        "ignores the headers, payloads untouched "
+                        "(tests/test_xtrace.py pins the roundtrip)"),
+    "xtrace_dir": ("inert", "trace stream output path"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

@@ -168,7 +168,17 @@ class Message:
     def to_json(self) -> str:
         if self.tensors:
             raise ValueError("tensor payloads need to_bytes(), not JSON")
-        return json.dumps(self.params)
+        payload = json.dumps(self.params)
+        # control-plane messages are wire bytes too: without this stamp
+        # the comm_msg_bytes counters silently undercount every JSON
+        # frame — and the trace-context header overhead rides free
+        self.nbytes = len(payload.encode())
+        for hook in list(_NBYTES_HOOKS):
+            try:
+                hook(self.type, self.nbytes)
+            except Exception:
+                logger.debug("message nbytes hook failed", exc_info=True)
+        return payload
 
     @classmethod
     def from_json(cls, payload: str) -> "Message":

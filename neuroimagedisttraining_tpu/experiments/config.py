@@ -551,6 +551,35 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                         "JSON, Perfetto-loadable) here at end of run; "
                         "pair with --profile_dir to line host spans up "
                         "with the XLA device trace")
+    p.add_argument("--xtrace", type=int, default=0,
+                   help="cross-process distributed tracing "
+                        "(obs/xtrace.py) for the federation/serving "
+                        "planes: the aggregator (or publisher) mints "
+                        "one trace context per round, every TRAIN/"
+                        "delta/FINISH/push frame carries it as "
+                        "control-plane headers, and each process "
+                        "writes its own <process>.xtrace.json span "
+                        "stream — clock-aligned (HELLO-handshake NTP "
+                        "offsets) and folded into one Perfetto-"
+                        "loadable federation.trace.json with per-"
+                        "process lanes. Also stamps fed_round_ms/"
+                        "fed_wire_ms/fed_queue_ms/serve_adopt_lag_ms "
+                        "on the round streams for live --slo_spec "
+                        "objectives. Off (the default) is byte-inert "
+                        "on every wire; never enters run identity")
+    p.add_argument("--xtrace_dir", type=str, default="",
+                   help="where the per-process *.xtrace.json streams "
+                        "and the merged federation.trace.json land "
+                        "(default: the fed/serve out_dir)")
+    p.add_argument("--serve_probe_every", type=int, default=0,
+                   help="accuracy-under-staleness probe: every N "
+                        "serving ticks the worker evaluates its "
+                        "CURRENT global model on a small fixed batch "
+                        "and stamps serve_probe_acc beside "
+                        "serve_model_staleness_s — declarable as an "
+                        "SLO objective and joined against staleness "
+                        "by the analyzer. 0 (the default) disables "
+                        "the probe")
     p.add_argument("--obs_sample_every", type=int, default=1,
                    help="memory-watermark sampling cadence in rounds "
                         "(obs/memory.py; the live-arrays fallback walk "

@@ -36,7 +36,9 @@ import numpy as np
 from ..comm.manager import ServerManager
 from ..comm.message import Message
 from ..core.state import weighted_tree_sum
-from ..obs.export import RoundLogWriter
+from ..obs import xtrace
+from ..obs.export import RoundLogWriter, record_schema
+from ..obs.xtrace import XTracer
 from . import protocol, wire
 
 logger = logging.getLogger(__name__)
@@ -58,7 +60,8 @@ class FedAggregator(ServerManager):
                  replay_trace: Optional[Dict[str, Any]] = None,
                  robust_agg: str = "none", robust_trim: float = 0.2,
                  robust_krum_f: int = 0, robust_norm_bound: float = 5.0,
-                 log_path: str = "", events_path: str = ""):
+                 log_path: str = "", events_path: str = "",
+                 tracer: Optional[XTracer] = None, slo: Any = None):
         super().__init__(comm, rank=0, world_size=world_size)
         import jax
 
@@ -111,9 +114,85 @@ class FedAggregator(ServerManager):
         self.events = RoundLogWriter(events_path, force=True) \
             if events_path else None
         self._norm_history: List[float] = []
+        self.tracer = tracer
+        self.slo = slo  # SloEngine observing federation round records
         self._updates: "queue.Queue[Message]" = queue.Queue()
         self.register_message_receive_handler(
-            protocol.MSG_FED_UPDATE, self._updates.put)
+            protocol.MSG_FED_UPDATE, self._enqueue_update)
+        self._hello_acks: "queue.Queue[Dict[str, float]]" = queue.Queue()
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HELLO_ACK, self._on_hello_ack)
+        # per-round wire/queue accumulators (tracing on): reset at every
+        # round / flush boundary
+        self._xt_wire_ns = 0.0
+        self._xt_queue_ns = 0.0
+        self._xt_round_t0 = time.perf_counter()
+
+    # -- clock sync / trace plumbing (xtrace-gated, byte-inert off) -------
+    def _enqueue_update(self, msg: Message) -> None:
+        # arrival stamp BEFORE the queue: dequeue - arrival is queue
+        # wait, site-send - arrival (offset-corrected) is the wire leg.
+        # The attribute lives on the in-memory Message only — never
+        # serialized, so the wire stays byte-identical either way.
+        if self.tracer is not None:
+            msg.xt_arrival_ns = self.tracer.wall_ns()
+        self._updates.put(msg)
+
+    def _on_hello_ack(self, msg: Message) -> None:
+        t2 = self.tracer.wall_ns() if self.tracer is not None \
+            else time.time_ns()
+        self._hello_acks.put({"rank": int(msg.get("rank", -1)),
+                              "t0": float(msg.get("t0_ns", 0)),
+                              "t1": float(msg.get("t1_ns", 0)),
+                              "t2": float(t2)})
+
+    def clock_sync(self) -> None:
+        """One HELLO handshake per site: NTP-midpoint clock-offset
+        estimate (``xtrace.ntp_offset``) recorded on the tracer, keying
+        both the merged-trace lane alignment and the per-update wire
+        attribution. Only ever called when tracing is on."""
+        if self.tracer is None:
+            return
+        for k in range(1, self.n_sites + 1):
+            self._send(protocol.hello_message(
+                0, k, self.tracer.wall_ns()))
+        deadline = time.monotonic() + self.timeout_s
+        got = 0
+        while got < self.n_sites:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                ack = self._hello_acks.get(timeout=remaining)
+            except queue.Empty:
+                break
+            offset, rtt = xtrace.ntp_offset(
+                ack["t0"], ack["t1"], ack["t2"])
+            self.tracer.note_offset(
+                f"site{int(ack['rank'])}", offset, rtt)
+            got += 1
+        if got < self.n_sites:
+            logger.warning("fed hello: %d/%d sites answered before "
+                           "timeout; missing lanes merge unaligned",
+                           got, self.n_sites)
+
+    def _note_arrival(self, msg: Message) -> None:
+        """Fold one dequeued update into the round's queue-wait and
+        wire-leg accumulators (tracing on; no-op otherwise)."""
+        if self.tracer is None:
+            return
+        arrival = getattr(msg, "xt_arrival_ns", None)
+        if arrival is None:
+            return
+        self._xt_queue_ns += max(
+            0.0, self.tracer.wall_ns() - arrival)
+        send = xtrace.send_wall_ns(msg)
+        if send is None:
+            return
+        site = msg.get("site")
+        peer = f"site{int(site)}" if site is not None else ""
+        self._xt_wire_ns += max(
+            0.0, arrival - self.tracer.to_ref_ns(send, peer))
 
     # -- Byzantine screen / robust combine --------------------------------
     def _byzantine_screen(self, round_idx: int, sites: List[int],
@@ -173,12 +252,25 @@ class FedAggregator(ServerManager):
 
     def _record(self, rec: Dict[str, Any]) -> None:
         self.history.append(rec)
+        if self.slo is not None and int(rec.get("round", -1)) >= 0:
+            # live SLO evaluation on the federation round stream
+            # (PR 10 engine): p95:fed_round_ms<... style objectives
+            # breach DURING the run, not in a postmortem
+            rec = dict(rec)
+            for ev in self.slo.observe(rec):
+                if self.events is not None:
+                    self.events.write(ev.to_record())
+            rec["slo_health"] = self.slo.health
+            rec["slo_breached"] = float(len(self.slo.breached))
+            rec["obs_schema"] = record_schema(rec)
+            self.history[-1] = rec
         if self.writer is not None:
             self.writer.write(rec)
 
     def execute(self) -> None:
         """Run the configured number of rounds (sync) or flushes
         (buffered), then tell every site to finish."""
+        self.clock_sync()
         if self.mode == "sync":
             for r in range(self.rounds):
                 self.run_sync_round(r)
@@ -186,11 +278,17 @@ class FedAggregator(ServerManager):
             self.run_buffered_replay()
         else:
             self.run_buffered()
-        for dest in range(1, self.world_size):
-            try:
-                self._send(Message(protocol.MSG_FED_FINISH, 0, dest))
-            except OSError:
-                logger.warning("site %d unreachable at finish", dest)
+        with xtrace.xspan(self.tracer, "finish",
+                          trace_id="finish") as fin:
+            for dest in range(1, self.world_size):
+                msg = Message(protocol.MSG_FED_FINISH, 0, dest)
+                if self.tracer is not None:
+                    xtrace.inject(msg, fin.ctx(),
+                                  wall_ns=self.tracer.wall_ns())
+                try:
+                    self._send(msg)
+                except OSError:
+                    logger.warning("site %d unreachable at finish", dest)
         if self.writer is not None:
             self._record({"round": -1, "fed_mode": self.mode,
                           "fed_version": self.version,
@@ -209,122 +307,167 @@ class FedAggregator(ServerManager):
         import jax
         import jax.numpy as jnp
 
-        algo = self.algo
-        sel = algo._selected_client_indexes(round_idx)
-        s_total = int(sel.shape[0])
-        self.rng, round_key = jax.random.split(self.rng)
-        parts = protocol.partition_slots(s_total, self.n_sites)
-        for k in range(1, self.n_sites + 1):
-            pos = parts[k - 1]
-            msg = Message(protocol.MSG_FED_TRAIN, 0, k)
-            msg.add("version", round_idx)
-            msg.add("mode", "sync")
-            msg.add("cohort_size", s_total)
-            msg.add_tensor("params", self.global_params)
-            msg.add_tensor("round_key", np.asarray(round_key))
-            msg.add_tensor("client_ids", sel[pos].astype(np.int32))
-            msg.add_tensor("slot_pos", pos.astype(np.int32))
-            self._send(msg)
-        rows_by_site: Dict[int, Any] = {}
-        losses_by_site: Dict[int, np.ndarray] = {}
-        deadline = time.monotonic() + self.timeout_s
-        while len(rows_by_site) < self.n_sites:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            try:
-                msg = self._updates.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if msg.get("mode") != "sync" or \
-                    int(msg.get("version")) != round_idx:
+        tr = self.tracer
+        self._xt_wire_ns = self._xt_queue_ns = 0.0
+        t_round = time.perf_counter()
+        # the round's trace tree: minted from the round index, so twin
+        # runs produce identical ids (the structure-determinism contract)
+        with xtrace.xspan(tr, "fed_round", trace_id=f"r{round_idx}",
+                          args={"round": round_idx}) as rspan:
+            algo = self.algo
+            sel = algo._selected_client_indexes(round_idx)
+            s_total = int(sel.shape[0])
+            self.rng, round_key = jax.random.split(self.rng)
+            parts = protocol.partition_slots(s_total, self.n_sites)
+            with xtrace.xspan(tr, "dispatch",
+                              args={"sites": self.n_sites}) as dspan:
+                for k in range(1, self.n_sites + 1):
+                    pos = parts[k - 1]
+                    msg = Message(protocol.MSG_FED_TRAIN, 0, k)
+                    msg.add("version", round_idx)
+                    msg.add("mode", "sync")
+                    msg.add("cohort_size", s_total)
+                    msg.add_tensor("params", self.global_params)
+                    msg.add_tensor("round_key", np.asarray(round_key))
+                    msg.add_tensor("client_ids",
+                                   sel[pos].astype(np.int32))
+                    msg.add_tensor("slot_pos", pos.astype(np.int32))
+                    if tr is not None:
+                        xtrace.inject(msg, dspan.ctx(),
+                                      wall_ns=tr.wall_ns())
+                    self._send(msg)
+            rows_by_site: Dict[int, Any] = {}
+            losses_by_site: Dict[int, np.ndarray] = {}
+            with xtrace.xspan(tr, "collect"):
+                deadline = time.monotonic() + self.timeout_s
+                while len(rows_by_site) < self.n_sites:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        msg = self._updates.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    self._note_arrival(msg)
+                    if msg.get("mode") != "sync" or \
+                            int(msg.get("version")) != round_idx:
+                        logger.warning(
+                            "dropping stale fed update (site %s, version "
+                            "%s != round %d)", msg.get("site"),
+                            msg.get("version"), round_idx)
+                        continue
+                    site = int(msg.get("site"))
+                    if site in rows_by_site:
+                        logger.warning(
+                            "duplicate update from site %d dropped", site)
+                        continue
+                    rows_by_site[site] = msg.get_tensor("rows")
+                    losses_by_site[site] = np.asarray(
+                        msg.get_tensor("losses"))
+            received = sorted(rows_by_site)
+            missing = [k for k in range(1, self.n_sites + 1)
+                       if k not in rows_by_site]
+            if not received:
                 logger.warning(
-                    "dropping stale fed update (site %s, version %s != "
-                    "round %d)", msg.get("site"), msg.get("version"),
-                    round_idx)
-                continue
-            site = int(msg.get("site"))
-            if site in rows_by_site:
-                logger.warning("duplicate update from site %d dropped",
-                               site)
-                continue
-            rows_by_site[site] = msg.get_tensor("rows")
-            losses_by_site[site] = np.asarray(msg.get_tensor("losses"))
-        received = sorted(rows_by_site)
-        missing = [k for k in range(1, self.n_sites + 1)
-                   if k not in rows_by_site]
-        if not received:
-            logger.warning(
-                "sync round %d TIMEOUT: no site reported; global carried",
-                round_idx)
-            self._event(round_idx, "fed_timeout", sites_missing=missing)
-            self._record({"round": round_idx,
-                          "train_loss": float("nan"),
-                          "sites_reported": 0, "fed_status": "timeout"})
-            self.version = round_idx + 1
-            return "timeout"
-        # reassemble the cohort in slot order: partitions are contiguous
-        # blocks, so concatenating the received sites' rows in rank
-        # order restores ascending slot positions
-        slot_pos = np.concatenate([parts[k - 1] for k in received])
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.asarray(np.concatenate(xs, axis=0)),
-            *[rows_by_site[k] for k in received])
-        losses = jnp.asarray(np.concatenate(
-            [losses_by_site[k] for k in received]))
-        n_all = np.asarray(algo.data.n_train)[sel]
-        n_sel = jnp.asarray(n_all[slot_pos])
-        # the in-process aggregation, verbatim (base.py round body):
-        # f32 sample weights normalized over whoever reported — all
-        # sites is the bit-parity path, a subset is the survivor-
-        # renormalization degradation
-        weights = n_sel.astype(jnp.float32)
-        weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
-        # Byzantine norm screen: per-SITE delta norm of the shipped rows
-        # against the running median (detection; typed event)
-        gl = [np.asarray(x, np.float32)
-              for x in jax.tree_util.tree_leaves(self.global_params)]
-        site_norms = []
-        for k in received:
-            d2 = 0.0
-            for rl, g in zip(
-                    jax.tree_util.tree_leaves(rows_by_site[k]), gl):
-                d = np.asarray(rl, np.float32) - g[None]
-                d2 += float(np.sum(d * d))
-            site_norms.append(float(np.sqrt(d2)))
-        flagged = self._byzantine_screen(round_idx, received, site_norms)
-        if self.robust_agg != "none":
-            # the in-process _robust_aggregate, verbatim over the same
-            # [S]-stacked client rows: robust statistic on the deltas,
-            # survivor mask from the (renormalized) weights — loopback
-            # sync stays the bit-parity anchor under attack too
-            from ..parallel import collectives
+                    "sync round %d TIMEOUT: no site reported; global "
+                    "carried", round_idx)
+                self._event(round_idx, "fed_timeout",
+                            sites_missing=missing)
+                rspan.add(status="timeout")
+                self._record(self._xt_round_rec(
+                    {"round": round_idx, "train_loss": float("nan"),
+                     "sites_reported": 0, "fed_status": "timeout"},
+                    t_round))
+                self.version = round_idx + 1
+                return "timeout"
+            with xtrace.xspan(tr, "combine",
+                              args={"robust": self.robust_agg,
+                                    "members": len(received)}):
+                # reassemble the cohort in slot order: partitions are
+                # contiguous blocks, so concatenating the received
+                # sites' rows in rank order restores ascending slot
+                # positions
+                slot_pos = np.concatenate(
+                    [parts[k - 1] for k in received])
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.asarray(np.concatenate(xs, axis=0)),
+                    *[rows_by_site[k] for k in received])
+                losses = jnp.asarray(np.concatenate(
+                    [losses_by_site[k] for k in received]))
+                n_all = np.asarray(algo.data.n_train)[sel]
+                n_sel = jnp.asarray(n_all[slot_pos])
+                # the in-process aggregation, verbatim (base.py round
+                # body): f32 sample weights normalized over whoever
+                # reported — all sites is the bit-parity path, a subset
+                # is the survivor-renormalization degradation
+                weights = n_sel.astype(jnp.float32)
+                weights = weights / jnp.maximum(jnp.sum(weights), 1.0)
+                # Byzantine norm screen: per-SITE delta norm of the
+                # shipped rows against the running median (detection;
+                # typed event)
+                gl = [np.asarray(x, np.float32) for x in
+                      jax.tree_util.tree_leaves(self.global_params)]
+                site_norms = []
+                for k in received:
+                    d2 = 0.0
+                    for rl, g in zip(
+                            jax.tree_util.tree_leaves(rows_by_site[k]),
+                            gl):
+                        d = np.asarray(rl, np.float32) - g[None]
+                        d2 += float(np.sum(d * d))
+                    site_norms.append(float(np.sqrt(d2)))
+                flagged = self._byzantine_screen(
+                    round_idx, received, site_norms)
+                if self.robust_agg != "none":
+                    # the in-process _robust_aggregate, verbatim over
+                    # the same [S]-stacked client rows: robust statistic
+                    # on the deltas, survivor mask from the
+                    # (renormalized) weights — loopback sync stays the
+                    # bit-parity anchor under attack too
+                    from ..parallel import collectives
 
-            spec = collectives.flat_spec(stacked, stacked=True)
-            gvec = collectives.tree_to_vec(self.global_params).astype(
-                jnp.float32)
-            combined = self._robust_combine(
-                np.asarray(collectives.stacked_to_mat(stacked)
-                           - gvec[None]),
-                np.asarray(weights, np.float32))
-            self.global_params = collectives.vec_to_tree(
-                jnp.asarray(np.asarray(gvec) + combined), spec)
-        else:
-            self.global_params = weighted_tree_sum(stacked, weights)
-        loss = float(jnp.mean(losses))
-        self.version = round_idx + 1
-        status = "completed" if not missing else "quorum"
-        if missing:
-            logger.warning(
-                "sync round %d QUORUM %d/%d (missing sites %s; weights "
-                "renormalized)", round_idx, len(received), self.n_sites,
-                missing)
-            self._event(round_idx, "fed_quorum", sites_missing=missing)
-        self._record({"round": round_idx, "train_loss": loss,
-                      "sites_reported": len(received),
-                      "fed_status": status,
-                      "fed_byzantine_flagged": len(flagged)})
+                    spec = collectives.flat_spec(stacked, stacked=True)
+                    gvec = collectives.tree_to_vec(
+                        self.global_params).astype(jnp.float32)
+                    combined = self._robust_combine(
+                        np.asarray(collectives.stacked_to_mat(stacked)
+                                   - gvec[None]),
+                        np.asarray(weights, np.float32))
+                    self.global_params = collectives.vec_to_tree(
+                        jnp.asarray(np.asarray(gvec) + combined), spec)
+                else:
+                    self.global_params = weighted_tree_sum(
+                        stacked, weights)
+                loss = float(jnp.mean(losses))
+            self.version = round_idx + 1
+            status = "completed" if not missing else "quorum"
+            if missing:
+                logger.warning(
+                    "sync round %d QUORUM %d/%d (missing sites %s; "
+                    "weights renormalized)", round_idx, len(received),
+                    self.n_sites, missing)
+                self._event(round_idx, "fed_quorum",
+                            sites_missing=missing)
+            rspan.add(status=status)
+            self._record(self._xt_round_rec(
+                {"round": round_idx, "train_loss": loss,
+                 "sites_reported": len(received),
+                 "fed_status": status,
+                 "fed_byzantine_flagged": len(flagged)}, t_round))
         return status
+
+    def _xt_round_rec(self, rec: Dict[str, Any],
+                      t_round: float) -> Dict[str, Any]:
+        """Join the round's critical-path metrics onto its record
+        (tracing on only — the keys are volatile in ``obs/diff.py``, so
+        twins with tracing off still gate ``identical``)."""
+        if self.tracer is None:
+            return rec
+        rec["fed_round_ms"] = (time.perf_counter() - t_round) * 1e3
+        rec["fed_wire_ms"] = self._xt_wire_ns / 1e6
+        rec["fed_queue_ms"] = self._xt_queue_ns / 1e6
+        self._xt_wire_ns = self._xt_queue_ns = 0.0
+        return rec
 
     # -- buffered async (FedBuff) ----------------------------------------
     def _np_global(self) -> Any:
@@ -340,7 +483,15 @@ class FedAggregator(ServerManager):
         msg.add_tensor("params", self.global_params)
         msg.add_tensor(
             "client_ids", self.partition[site - 1].astype(np.int32))
-        self._send(msg)
+        # buffered trace trees are keyed by the dispatched base version
+        # (the async analogue of the sync round id)
+        with xtrace.xspan(self.tracer, "dispatch",
+                          trace_id=f"v{int(version)}",
+                          args={"site": int(site)}) as dspan:
+            if self.tracer is not None:
+                xtrace.inject(msg, dspan.ctx(),
+                              wall_ns=self.tracer.wall_ns())
+            self._send(msg)
 
     def _entry(self, msg: Message) -> Tuple[int, int, Any, float, float]:
         return (int(msg.get("site")), int(msg.get("version")),
@@ -356,55 +507,64 @@ class FedAggregator(ServerManager):
         import jax
         import jax.numpy as jnp
 
-        taus = [self.version - base for _, base, _, _, _ in members]
-        for t in taus:
-            self.staleness_hist[t] = self.staleness_hist.get(t, 0) + 1
-        raw = []
-        for (_, _, _, n_sum, _), tau in zip(members, taus):
-            raw.append(np.float32(n_sum) /
-                       np.float32(np.sqrt(np.float32(1.0 + tau))))
-        wsum = np.float32(0.0)
-        for w in raw:
-            wsum = np.float32(wsum + w)
-        wnorm = [np.float32(w / wsum) for w in raw]
-        g = self._np_global()
-        leaves, treedef = jax.tree_util.tree_flatten(g)
-        deltas = [jax.tree_util.tree_flatten(d)[0]
-                  for _, _, d, _, _ in members]
-        # Byzantine norm screen over the flush members (typed event)
-        member_sites = [site for site, _, _, _, _ in members]
-        norms = [float(np.sqrt(sum(
-            float(np.sum(np.square(np.asarray(dl_i, np.float32))))
-            for dl_i in dl))) for dl in deltas]
-        flagged = self._byzantine_screen(flush_idx, member_sites, norms)
-        if self.robust_agg != "none":
-            # robust statistic over the member deltas: the staleness-
-            # discounted weights keep gating MEMBERSHIP (a zero weight
-            # is a masked row) while influence is the estimator's —
-            # FedBuff's n/sqrt(1+tau) discount no longer scales a
-            # colluding stale attacker's pull, it only ranks it
-            mat = np.stack([np.concatenate(
-                [np.asarray(x, np.float32).ravel() for x in dl])
-                for dl in deltas])
-            combined = self._robust_combine(
-                mat, np.asarray(wnorm, np.float32))
-            new_leaves = []
-            off = 0
-            for leaf in leaves:
-                n = int(leaf.size)
-                new_leaves.append(
-                    leaf + combined[off:off + n].reshape(leaf.shape))
-                off += n
-        else:
-            new_leaves = []
-            for i, leaf in enumerate(leaves):
-                out = leaf.copy()
-                for w, dl in zip(wnorm, deltas):
-                    out += w * np.asarray(dl[i], np.float32)
-                new_leaves.append(out)
-        self.global_params = jax.tree_util.tree_map(
-            jnp.asarray, jax.tree_util.tree_unflatten(treedef, new_leaves))
-        self.version += 1
+        t_round = self._xt_round_t0
+        with xtrace.xspan(self.tracer, "flush",
+                          trace_id=f"v{self.version + 1}",
+                          args={"members": len(members),
+                                "quorum": bool(quorum)}):
+            taus = [self.version - base for _, base, _, _, _ in members]
+            for t in taus:
+                self.staleness_hist[t] = \
+                    self.staleness_hist.get(t, 0) + 1
+            raw = []
+            for (_, _, _, n_sum, _), tau in zip(members, taus):
+                raw.append(np.float32(n_sum) /
+                           np.float32(np.sqrt(np.float32(1.0 + tau))))
+            wsum = np.float32(0.0)
+            for w in raw:
+                wsum = np.float32(wsum + w)
+            wnorm = [np.float32(w / wsum) for w in raw]
+            g = self._np_global()
+            leaves, treedef = jax.tree_util.tree_flatten(g)
+            deltas = [jax.tree_util.tree_flatten(d)[0]
+                      for _, _, d, _, _ in members]
+            # Byzantine norm screen over the flush members (typed event)
+            member_sites = [site for site, _, _, _, _ in members]
+            norms = [float(np.sqrt(sum(
+                float(np.sum(np.square(np.asarray(dl_i, np.float32))))
+                for dl_i in dl))) for dl in deltas]
+            flagged = self._byzantine_screen(
+                flush_idx, member_sites, norms)
+            if self.robust_agg != "none":
+                # robust statistic over the member deltas: the
+                # staleness-discounted weights keep gating MEMBERSHIP
+                # (a zero weight is a masked row) while influence is
+                # the estimator's — FedBuff's n/sqrt(1+tau) discount no
+                # longer scales a colluding stale attacker's pull, it
+                # only ranks it
+                mat = np.stack([np.concatenate(
+                    [np.asarray(x, np.float32).ravel() for x in dl])
+                    for dl in deltas])
+                combined = self._robust_combine(
+                    mat, np.asarray(wnorm, np.float32))
+                new_leaves = []
+                off = 0
+                for leaf in leaves:
+                    n = int(leaf.size)
+                    new_leaves.append(
+                        leaf + combined[off:off + n].reshape(leaf.shape))
+                    off += n
+            else:
+                new_leaves = []
+                for i, leaf in enumerate(leaves):
+                    out = leaf.copy()
+                    for w, dl in zip(wnorm, deltas):
+                        out += w * np.asarray(dl[i], np.float32)
+                    new_leaves.append(out)
+            self.global_params = jax.tree_util.tree_map(
+                jnp.asarray,
+                jax.tree_util.tree_unflatten(treedef, new_leaves))
+            self.version += 1
         losses = [loss for _, _, _, _, loss in members]
         mean_loss = float(np.mean(np.asarray(losses, np.float32)))
         member_ids = [[site, base] for site, base, _, _, _ in members]
@@ -412,14 +572,18 @@ class FedAggregator(ServerManager):
             {"version": self.version, "members": member_ids})
         self._event(flush_idx, "fed_flush", members=member_ids,
                     buffer_depth=depth, quorum=quorum)
-        self._record({"round": flush_idx, "train_loss": mean_loss,
-                      "fed_version": self.version,
-                      "fed_buffer_depth": depth,
-                      "fed_staleness_max": int(max(taus)),
-                      "fed_staleness_mean": float(np.mean(taus)),
-                      "fed_quorum_flush": bool(quorum),
-                      "fed_stale_drops": self.stale_drops,
-                      "fed_byzantine_flagged": len(flagged)})
+        # flush-to-flush wall time is the buffered analogue of the sync
+        # round clock
+        self._xt_round_t0 = time.perf_counter()
+        self._record(self._xt_round_rec(
+            {"round": flush_idx, "train_loss": mean_loss,
+             "fed_version": self.version,
+             "fed_buffer_depth": depth,
+             "fed_staleness_max": int(max(taus)),
+             "fed_staleness_mean": float(np.mean(taus)),
+             "fed_quorum_flush": bool(quorum),
+             "fed_stale_drops": self.stale_drops,
+             "fed_byzantine_flagged": len(flagged)}, t_round))
 
     def run_buffered(self) -> None:
         for k in range(1, self.n_sites + 1):
@@ -429,6 +593,7 @@ class FedAggregator(ServerManager):
         while flushes < self.rounds:
             try:
                 msg = self._updates.get(timeout=self.timeout_s)
+                self._note_arrival(msg)
             except queue.Empty:
                 if buffer:
                     # degrade: flush what arrived rather than stall the
@@ -490,6 +655,7 @@ class FedAggregator(ServerManager):
             while not all(k in pool for k in need):
                 try:
                     msg = self._updates.get(timeout=self.timeout_s)
+                    self._note_arrival(msg)
                 except queue.Empty:
                     waiting = [k for k in need if k not in pool]
                     raise RuntimeError(

@@ -17,6 +17,14 @@ Messages (all via ``comm/message.py``'s binary pytree framing):
   local delta in a ``fed/wire.py`` format, tagged with the base
   ``version`` it trained from.
 * ``fed_finish`` (aggregator -> site): drain and exit.
+* ``fed_hello`` / ``fed_hello_ack``: the clock-sync handshake behind
+  cross-process tracing (``obs/xtrace.py``). The initiator stamps its
+  wall clock ``t0``; the peer echoes it with its own ``t1``; the
+  initiator reads ``t2`` at the ACK and estimates the peer's clock
+  offset by the NTP midpoint. Only ever sent when ``--xtrace`` is on
+  (the byte-inert contract); both planes reuse the same pair — the
+  aggregator initiates toward its sites, the serve worker toward its
+  publisher.
 """
 from __future__ import annotations
 
@@ -33,6 +41,26 @@ logger = logging.getLogger(__name__)
 MSG_FED_TRAIN = "fed_train"
 MSG_FED_UPDATE = "fed_update"
 MSG_FED_FINISH = "fed_finish"
+MSG_FED_HELLO = "fed_hello"
+MSG_FED_HELLO_ACK = "fed_hello_ack"
+
+
+def hello_message(sender: int, receiver: int, t0_ns: int) -> Message:
+    """The handshake's first leg: the initiator's wall clock."""
+    msg = Message(MSG_FED_HELLO, sender, receiver)
+    msg.add("t0_ns", int(t0_ns))
+    return msg
+
+
+def hello_ack(msg: Message, sender: int, rank: int,
+              t1_ns: int) -> Message:
+    """The echo leg: ``t0`` returned untouched, the peer's ``t1`` and
+    rank added (``rank`` keys the initiator's offset table)."""
+    reply = Message(MSG_FED_HELLO_ACK, sender, msg.sender_id)
+    reply.add("t0_ns", int(msg.get("t0_ns", 0)))
+    reply.add("rank", int(rank))
+    reply.add("t1_ns", int(t1_ns))
+    return reply
 
 #: PRNG domain separator for the buffered policy's per-site key chain
 #: ("fed" in ascii) — the same fold-in idiom as robust.faults.FAULT_SALT,

@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import xtrace
+from ..obs.xtrace import XTracer
 from ..robust.faults import FaultSpec, parse_fault_spec
 from . import wire
 from .aggregator import FedAggregator
@@ -206,8 +208,41 @@ def _site_paths(out_dir: str, rank: int) -> Tuple[str, str]:
             os.path.join(out_dir, f"site{rank}.events.jsonl"))
 
 
+def _xtrace_dir(args, out_dir: str) -> str:
+    return getattr(args, "xtrace_dir", "") or out_dir
+
+
+def _fed_tracer(args, process: str) -> Optional[XTracer]:
+    """One :class:`XTracer` per federation process (``--xtrace`` only;
+    ``None`` keeps every wire byte-inert). The aggregator is the
+    reference clock for both lanes and offsets."""
+    if not getattr(args, "xtrace", 0):
+        return None
+    return XTracer(process, ref="aggregator")
+
+
+def _write_stream(tracer: Optional[XTracer], args,
+                  out_dir: str) -> str:
+    if tracer is None:
+        return ""
+    return tracer.write(os.path.join(
+        _xtrace_dir(args, out_dir),
+        tracer.process + xtrace.STREAM_SUFFIX))
+
+
+def _fed_slo(args):
+    """The live federation SLO engine (PR 10's, observing aggregator
+    round records) — armed only by ``--slo_spec``."""
+    if not getattr(args, "slo_spec", ""):
+        return None
+    from ..obs.slo import SloEngine, load_slo_spec
+
+    return SloEngine(load_slo_spec(args.slo_spec))
+
+
 def _make_worker(args, comm, rank: int, world: int,
-                 trainer: SiteTrainer, out_dir: str) -> SiteWorker:
+                 trainer: SiteTrainer, out_dir: str,
+                 tracer: Optional[XTracer] = None) -> SiteWorker:
     faults = parse_site_faults(getattr(args, "fed_site_faults", ""))
     fs, delay = faults.get(rank, (None, 0.0))
     log_path, events_path = _site_paths(out_dir, rank)
@@ -217,11 +252,11 @@ def _make_worker(args, comm, rank: int, world: int,
         wire_density=getattr(args, "agg_topk_density", 0.1),
         fault_spec=fs, straggle_s=delay,
         retries=args.fed_retries, backoff_s=args.fed_backoff_s,
-        log_path=log_path, events_path=events_path)
+        log_path=log_path, events_path=events_path, tracer=tracer)
 
 
-def _make_aggregator(args, comm, world: int, algo,
-                     out_dir: str) -> FedAggregator:
+def _make_aggregator(args, comm, world: int, algo, out_dir: str,
+                     tracer: Optional[XTracer] = None) -> FedAggregator:
     replay = None
     if getattr(args, "fed_replay", ""):
         with open(args.fed_replay) as f:
@@ -240,7 +275,8 @@ def _make_aggregator(args, comm, world: int, algo,
         robust_krum_f=getattr(args, "robust_krum_f", 0),
         robust_norm_bound=getattr(args, "norm_bound", 5.0),
         log_path=os.path.join(out_dir, "aggregator.jsonl"),
-        events_path=os.path.join(out_dir, "aggregator.events.jsonl"))
+        events_path=os.path.join(out_dir, "aggregator.events.jsonl"),
+        tracer=tracer, slo=_fed_slo(args))
 
 
 def _fold_obs(out_dir: str, n_sites: int) -> Dict[str, str]:
@@ -291,6 +327,14 @@ def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
     final_eval = {"global_acc": float(ev["acc"]),
                   "global_loss": float(ev["loss"])}
     fold = _fold_obs(out_dir, agg.n_sites)
+    xtrace_path = _write_stream(agg.tracer, args, out_dir)
+    merged_trace = ""
+    if agg.tracer is not None:
+        # loopback: every site stream is on disk by now, so this is the
+        # complete merge; TCP: a partial (aggregator-lane) merge the
+        # launcher re-runs once the site processes have written theirs
+        merged_trace = xtrace.merge_run_dir(
+            _xtrace_dir(args, out_dir)) or ""
     fed = {
         "mode": agg.mode, "sites": agg.n_sites,
         "version": agg.version, "stale_drops": agg.stale_drops,
@@ -303,6 +347,11 @@ def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
                             sorted(agg.byzantine_flags.items())},
         **fold, **agg.comm.counters.snapshot(),
     }
+    if xtrace_path:
+        fed["xtrace_path"] = xtrace_path
+        fed["merged_trace"] = merged_trace
+    if agg.slo is not None:
+        fed["slo"] = agg.slo.summary()
     with open(os.path.join(out_dir, "summary.json"), "w") as f:
         json.dump({"identity": identity, "final_eval": final_eval,
                    "rounds": len([r for r in agg.history
@@ -333,11 +382,12 @@ def _run_loopback(args, algo_name: str, identity: str,
     workers = []
     for k in range(1, world):
         w = _make_worker(args, router.manager(k), k, world, trainer,
-                         out_dir)
+                         out_dir, tracer=_fed_tracer(args, f"site{k}"))
         w.run(background=True)
         workers.append(w)
     agg = _make_aggregator(args, router.manager(0), world, algo,
-                           out_dir)
+                           out_dir,
+                           tracer=_fed_tracer(args, "aggregator"))
     agg.run(background=True)
     try:
         agg.execute()
@@ -347,6 +397,7 @@ def _run_loopback(args, algo_name: str, identity: str,
             # handler; bounded wait, daemon pumps die with the process
             w.done.wait(timeout=2.0)
             w.finish()
+            _write_stream(w.tracer, args, out_dir)
         agg.finish()
     return _finish_aggregator(args, agg, algo, identity, out_dir)
 
@@ -365,7 +416,8 @@ def _run_tcp(args, algo_name: str, identity: str,
             _refuse(f"sync cohort of {algo.clients_per_round} clients "
                     f"cannot cover {args.fed_sites} sites")
         agg = _make_aggregator(
-            args, TcpCommManager(0, endpoints), world, algo, out_dir)
+            args, TcpCommManager(0, endpoints), world, algo, out_dir,
+            tracer=_fed_tracer(args, "aggregator"))
         agg.run(background=True)
         try:
             agg.execute()
@@ -378,15 +430,19 @@ def _run_tcp(args, algo_name: str, identity: str,
                 f"{args.fed_sites}]")
     trainer = SiteTrainer(algo)
     worker = _make_worker(args, TcpCommManager(rank, endpoints), rank,
-                          world, trainer, out_dir)
+                          world, trainer, out_dir,
+                          tracer=_fed_tracer(args, f"site{rank}"))
     worker.run(background=True)
     worker.done.wait()
     worker.finish()
+    xtrace_path = _write_stream(worker.tracer, args, out_dir)
+    fed: Dict[str, Any] = {"role": "site", "rank": rank,
+                           "rounds_trained": worker.rounds_trained,
+                           **worker.comm.counters.snapshot()}
+    if xtrace_path:
+        fed["xtrace_path"] = xtrace_path
     return {"identity": identity, "history": [], "final_eval": {},
-            "stat_path": out_dir, "state": None,
-            "fed": {"role": "site", "rank": rank,
-                    "rounds_trained": worker.rounds_trained,
-                    **worker.comm.counters.snapshot()}}
+            "stat_path": out_dir, "state": None, "fed": fed}
 
 
 def run_federated(args, algo_name: str) -> Dict[str, Any]:

@@ -26,7 +26,9 @@ import numpy as np
 
 from ..comm.manager import ClientManager
 from ..comm.message import Message
+from ..obs import xtrace
 from ..obs.export import RoundLogWriter
+from ..obs.xtrace import XTracer
 from ..robust.faults import FaultSpec, fault_trace_round
 from . import protocol, wire
 from .trainer import SiteTrainer
@@ -49,7 +51,8 @@ class SiteWorker(ClientManager):
                  fault_spec: Optional[FaultSpec] = None,
                  straggle_s: float = 0.0, retries: int = 2,
                  backoff_s: float = 0.05, log_path: str = "",
-                 events_path: str = ""):
+                 events_path: str = "",
+                 tracer: Optional[XTracer] = None):
         super().__init__(comm, rank=rank, world_size=world_size)
         self.trainer = trainer
         self.seed = int(seed)
@@ -59,6 +62,7 @@ class SiteWorker(ClientManager):
         self.straggle_s = float(straggle_s)
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
+        self.tracer = tracer
         self.writer = RoundLogWriter(log_path, force=True) \
             if log_path else None
         self.events = RoundLogWriter(events_path, force=True) \
@@ -69,6 +73,17 @@ class SiteWorker(ClientManager):
             protocol.MSG_FED_TRAIN, self._on_train)
         self.register_message_receive_handler(
             protocol.MSG_FED_FINISH, self._on_finish)
+        # clock-sync echo: registered unconditionally (inert unless the
+        # aggregator actually initiates a HELLO, which is xtrace-gated)
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HELLO, self._on_hello)
+
+    def _on_hello(self, msg: Message) -> None:
+        t1 = self.tracer.wall_ns() if self.tracer is not None \
+            else time.time_ns()
+        reply = protocol.hello_ack(msg, self.rank, self.rank, t1)
+        protocol.send_with_retry(self, reply, retries=self.retries,
+                                 backoff_s=self.backoff_s)
 
     # -- fault model ------------------------------------------------------
     def _draw_faults(self, version: int):
@@ -105,72 +120,97 @@ class SiteWorker(ClientManager):
         version = int(msg.get("version"))
         mode = msg.get("mode")
         t0 = time.perf_counter()
-        straggled, dropped, byzantine, signflip = \
-            self._draw_faults(version)
-        forged = byzantine or signflip
-        if straggled and self.straggle_s > 0:
-            # a REAL straggling process: the aggregator's round clock
-            # (sync timeout / buffered staleness bound) sees this delay
-            self._event(version, "fed_site_straggle",
-                        sleep_s=self.straggle_s)
-            time.sleep(self.straggle_s)
-        if dropped:
-            # withhold the reply entirely — site death for this round;
-            # the aggregator degrades to quorum / flushes without us
-            self._event(version, "fed_site_drop")
-            return
-        import jax
-        import jax.numpy as jnp
+        # causal link: the aggregator's dispatch span is this round's
+        # parent; absent headers (old peers, tracing off) read as None
+        ctx = xtrace.extract(msg) if self.tracer is not None else None
+        with xtrace.xspan(self.tracer, "site_round",
+                          trace_id=ctx.trace_id if ctx else None,
+                          parent=ctx.span_id if ctx else None,
+                          args={"site": self.rank,
+                                "version": version}) as sr:
+            straggled, dropped, byzantine, signflip = \
+                self._draw_faults(version)
+            forged = byzantine or signflip
+            if straggled and self.straggle_s > 0:
+                # a REAL straggling process: the aggregator's round
+                # clock (sync timeout / buffered staleness bound) sees
+                # this delay
+                self._event(version, "fed_site_straggle",
+                            sleep_s=self.straggle_s)
+                with xtrace.xspan(self.tracer, "straggle",
+                                  args={"sleep_s": self.straggle_s}):
+                    time.sleep(self.straggle_s)
+            if dropped:
+                # withhold the reply entirely — site death for this
+                # round; the aggregator degrades to quorum / flushes
+                # without us
+                self._event(version, "fed_site_drop")
+                sr.add(dropped=True)
+                return
+            import jax
+            import jax.numpy as jnp
 
-        params = jax.tree_util.tree_map(
-            jnp.asarray, msg.get_tensor("params"))
-        client_ids = np.asarray(msg.get_tensor("client_ids"))
-        reply = Message(protocol.MSG_FED_UPDATE, self.rank, 0)
-        reply.add("version", version)
-        reply.add("site", self.rank)
-        reply.add("mode", mode)
-        if mode == "sync":
-            slot_pos = np.asarray(msg.get_tensor("slot_pos"))
-            rows, losses = self.trainer.train_sync(
-                params, msg.get_tensor("round_key"), version,
-                client_ids, slot_pos, int(msg.get("cohort_size")))
-            if forged:
-                # a LYING site: every row it ships is the forged delta
-                # g + factor*(row - g) — a real adversarial process on
-                # the wire, not a simulated slot. Pure in (seed,
-                # version, rank) + the deterministic trained rows, so
-                # the attack replays bit-for-bit.
-                factor = self._forge_factor(byzantine, signflip)
-                g32 = jax.tree_util.tree_map(
-                    lambda x: np.asarray(x, np.float32), params)
-                rows = jax.tree_util.tree_map(
-                    lambda r, g: g[None] + np.float32(factor)
-                    * (np.asarray(r, np.float32) - g[None]), rows, g32)
-                self._event(version, "fed_site_byzantine",
-                            factor=factor)
-            reply.add_tensor("rows", rows)
-            reply.add_tensor("losses", losses)
-            loss = float(np.mean(losses)) if losses.size else float("nan")
-            n_sum = float(np.sum(
-                np.asarray(self.trainer.algo.data.n_train)[client_ids]))
-        else:  # buffered
-            base_key = protocol.site_round_key(
-                self.seed, version, self.rank)
-            delta, n_sum, loss = self.trainer.train_delta(
-                params, base_key, version, client_ids)
-            if forged:
-                factor = self._forge_factor(byzantine, signflip)
-                delta = jax.tree_util.tree_map(
-                    lambda d: np.float32(factor)
-                    * np.asarray(d, np.float32), delta)
-                self._event(version, "fed_site_byzantine",
-                            factor=factor)
-            wire.encode_update(reply, delta, self.wire_impl,
-                               density=self.wire_density)
-            reply.add("n_sum", n_sum)
-            reply.add("train_loss", loss)
-        protocol.send_with_retry(self, reply, retries=self.retries,
-                                 backoff_s=self.backoff_s)
+            params = jax.tree_util.tree_map(
+                jnp.asarray, msg.get_tensor("params"))
+            client_ids = np.asarray(msg.get_tensor("client_ids"))
+            reply = Message(protocol.MSG_FED_UPDATE, self.rank, 0)
+            reply.add("version", version)
+            reply.add("site", self.rank)
+            reply.add("mode", mode)
+            if mode == "sync":
+                slot_pos = np.asarray(msg.get_tensor("slot_pos"))
+                with xtrace.xspan(self.tracer, "train"):
+                    rows, losses = self.trainer.train_sync(
+                        params, msg.get_tensor("round_key"), version,
+                        client_ids, slot_pos,
+                        int(msg.get("cohort_size")))
+                if forged:
+                    # a LYING site: every row it ships is the forged
+                    # delta g + factor*(row - g) — a real adversarial
+                    # process on the wire, not a simulated slot. Pure
+                    # in (seed, version, rank) + the deterministic
+                    # trained rows, so the attack replays bit-for-bit.
+                    factor = self._forge_factor(byzantine, signflip)
+                    g32 = jax.tree_util.tree_map(
+                        lambda x: np.asarray(x, np.float32), params)
+                    rows = jax.tree_util.tree_map(
+                        lambda r, g: g[None] + np.float32(factor)
+                        * (np.asarray(r, np.float32) - g[None]),
+                        rows, g32)
+                    self._event(version, "fed_site_byzantine",
+                                factor=factor)
+                with xtrace.xspan(self.tracer, "encode"):
+                    reply.add_tensor("rows", rows)
+                    reply.add_tensor("losses", losses)
+                loss = float(np.mean(losses)) if losses.size \
+                    else float("nan")
+                n_sum = float(np.sum(np.asarray(
+                    self.trainer.algo.data.n_train)[client_ids]))
+            else:  # buffered
+                base_key = protocol.site_round_key(
+                    self.seed, version, self.rank)
+                with xtrace.xspan(self.tracer, "train"):
+                    delta, n_sum, loss = self.trainer.train_delta(
+                        params, base_key, version, client_ids)
+                if forged:
+                    factor = self._forge_factor(byzantine, signflip)
+                    delta = jax.tree_util.tree_map(
+                        lambda d: np.float32(factor)
+                        * np.asarray(d, np.float32), delta)
+                    self._event(version, "fed_site_byzantine",
+                                factor=factor)
+                with xtrace.xspan(self.tracer, "encode"):
+                    wire.encode_update(reply, delta, self.wire_impl,
+                                       density=self.wire_density)
+                reply.add("n_sum", n_sum)
+                reply.add("train_loss", loss)
+            if ctx is not None:
+                # the reply carries OUR span as the aggregator-side
+                # parent plus our send wall clock (its wire-time input)
+                xtrace.inject(reply, sr.ctx(),
+                              wall_ns=self.tracer.wall_ns())
+            protocol.send_with_retry(self, reply, retries=self.retries,
+                                     backoff_s=self.backoff_s)
         self.rounds_trained += 1
         if self.writer is not None:
             self.writer.write({
@@ -183,6 +223,13 @@ class SiteWorker(ClientManager):
             })
 
     def _on_finish(self, msg: Message) -> None:
+        ctx = xtrace.extract(msg) if self.tracer is not None else None
+        if ctx is not None:
+            with xtrace.xspan(self.tracer, "site_finish",
+                              trace_id=ctx.trace_id,
+                              parent=ctx.span_id,
+                              args={"site": self.rank}):
+                pass
         if self.writer is not None:
             self.writer.write({"round": -1, "site": self.rank,
                                "rounds_trained": self.rounds_trained,

@@ -45,6 +45,15 @@
         [--out results/fleet_report.html] \
         [--history results/bench_history.jsonl]
 
+    # cross-process causal trace: merge the per-process
+    # *.xtrace.json streams of a --xtrace federation/serving run dir
+    # (if not already merged) and print the per-round critical-path
+    # decomposition — dispatch / site train / encode / wire /
+    # queue-wait / combine / flush / publish / adopt — with the
+    # straggler site named per round
+    python -m neuroimagedisttraining_tpu.obs xtrace results/fed_run \
+        [--json] [--enforce]
+
 Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
 tail — 0 (interrupt to stop; --once prints what's there and exits,
 --all prints the newest line of every cataloged run, 2 when no stream
@@ -53,7 +62,10 @@ FAILING, 2 when nothing replays; regress — the perf-gate codes (0
 pass, 1 regression, 2 no history); ls — 0, 2 when the catalog is
 empty and nothing rescans; diff — 0 when the --expect expectation
 holds (or no expectation), 1 when it is violated, 2 when a run fails
-to load; report — 0, 2 when the catalog resolves empty.
+to load; report — 0, 2 when the catalog resolves empty; xtrace — 0,
+1 with --enforce when the causal tree has orphan spans or a named
+straggler contradicts the injected straggle trace, 2 when the dir
+holds no trace streams.
 """
 from __future__ import annotations
 
@@ -122,6 +134,16 @@ def resolve_all_streams(target: str,
     if not paths:
         paths = [os.path.join(target, f) for f in os.listdir(target)
                  if f.endswith(suffix)]
+    if not paths and suffix == ".obs.jsonl":
+        # federation run dirs carry per-process streams under plain
+        # ``.jsonl`` names (aggregator.jsonl + site<k>.jsonl — the
+        # merged federation.jsonl fold is skipped so no line prints
+        # twice): ``tail --all`` renders one lane per process
+        paths = [os.path.join(target, f) for f in os.listdir(target)
+                 if f.endswith(".jsonl")
+                 and not f.endswith(".events.jsonl")
+                 and (f == "aggregator.jsonl"
+                      or (f.startswith("site")))]
     return sorted(set(paths))
 
 
@@ -141,9 +163,10 @@ def tail_all(target: str, suffix: str = ".obs.jsonl",
         if not records:
             continue
         ident = os.path.basename(path)
-        for s in (".obs.jsonl", ".events.jsonl"):
+        for s in (".obs.jsonl", ".events.jsonl", ".jsonl"):
             if ident.endswith(s):
                 ident = ident[:-len(s)]
+                break
         out(f"{ident}: {format_tail_line(records[-1])}")
         printed += 1
     return printed
@@ -407,8 +430,67 @@ def fleet_report_cli(target: str, out_path: str = "",
     history = history or os.path.join(results_dir,
                                       "bench_history.jsonl")
     written = obs_report.write_report(out_path, path,
-                                      history_path=history)
+                                      history_path=history,
+                                      results_dir=results_dir)
     out(f"fleet report -> {written}")
+    return 0
+
+
+def xtrace_cli(run_dir: str, as_json: bool = False,
+               enforce: bool = False,
+               out: Callable[[str], None] = print) -> int:
+    """``obs xtrace <run_dir>``: the cross-process causal-trace
+    report. Loads the clock-aligned merged trace (merging the
+    per-process ``*.xtrace.json`` streams first when no
+    ``federation.trace.json`` exists yet — e.g. a TCP run whose
+    processes exited before the best-effort runtime merge saw every
+    lane), joins it against the dir's round streams, and prints the
+    per-round critical-path decomposition. Exit 2 when the dir holds
+    no trace streams; 1 with ``enforce`` when the causal tree has
+    orphan spans or a named straggler contradicts the injected
+    straggle trace."""
+    import json as _json
+
+    from . import analyze as obs_analyze, export as obs_export, \
+        xtrace as obs_xtrace
+
+    if not os.path.isdir(run_dir):
+        print(f"not a directory: {run_dir}", file=sys.stderr)
+        return 2
+    merged = os.path.join(run_dir, obs_xtrace.MERGED_TRACE_NAME)
+    if obs_xtrace.stream_paths(run_dir):
+        # always re-merge: pure function of the streams, and a
+        # late-written site lane must not be left out
+        obs_xtrace.merge_run_dir(run_dir)
+    if not os.path.exists(merged):
+        print(f"no *{obs_xtrace.STREAM_SUFFIX} streams or merged "
+              f"trace under {run_dir} (was the run launched with "
+              "--xtrace 1?)", file=sys.stderr)
+        return 2
+    doc = obs_xtrace.load_doc(merged)
+    # every round stream in the dir joins: the aggregator's
+    # wire/queue stamps, the sites' straggle truth, serve probe ticks
+    records = []
+    for fname in sorted(os.listdir(run_dir)):
+        if not fname.endswith(".jsonl") or \
+                fname.endswith(".events.jsonl") or \
+                fname == "federation.jsonl":
+            continue
+        try:
+            records.extend(obs_export.read_jsonl(
+                os.path.join(run_dir, fname), allow_partial_tail=True))
+        except (OSError, ValueError):
+            continue
+    xt = obs_analyze._analyze_xtrace(doc, records)
+    if as_json:
+        out(_json.dumps(xt, indent=1, sort_keys=True))
+    else:
+        out(f"== xtrace: {run_dir} ==")
+        for line in obs_analyze.render_xtrace(xt):
+            out(line)
+        out(f"merged trace -> {merged}")
+    if enforce and (xt["orphans"] or xt["straggler_mismatches"]):
+        return 1
     return 0
 
 
@@ -514,7 +596,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="bench history for the rounds/sec scatter "
                          "(default <results_dir>/bench_history.jsonl)")
 
+    px = sub.add_parser(
+        "xtrace", help="cross-process causal-trace report (merged "
+                       "critical-path decomposition)")
+    px.add_argument("run_dir", help="a --xtrace federation/serving "
+                                    "run dir (holds *.xtrace.json "
+                                    "streams / federation.trace.json)")
+    px.add_argument("--json", action="store_true",
+                    help="print the xtrace section JSON instead of "
+                         "the report")
+    px.add_argument("--enforce", action="store_true",
+                    help="exit 1 on orphan spans or a straggler "
+                         "attribution that contradicts the injected "
+                         "straggle trace")
+
     args = p.parse_args(argv)
+
+    if args.cmd == "xtrace":
+        return xtrace_cli(args.run_dir, as_json=args.json,
+                          enforce=args.enforce)
 
     if args.cmd == "analyze":
         from . import analyze as obs_analyze

@@ -41,7 +41,8 @@ from . import export as obs_export
 
 __all__ = [
     "ANALYSIS_SCHEMA_VERSION", "analyze_records", "analyze_run_dir",
-    "render_report", "validate_analysis", "write_analysis",
+    "render_report", "render_xtrace", "validate_analysis",
+    "write_analysis",
 ]
 
 #: version of the analysis.json schema this module emits.
@@ -58,11 +59,18 @@ __all__ = [
 #: compliance and error-budget spend from a deterministic engine
 #: replay, and the breach timeline from the ``<identity>.events.jsonl``
 #: stream joined against the fault-trace replay so each breach names
-#: the injected rounds and clients behind it). Older documents (and
-#: older ``obs_schema`` round streams) are still accepted — each
+#: the injected rounds and clients behind it). v5 adds the ``xtrace``
+#: section (obs/xtrace.py cross-process distributed tracing: per-round
+#: critical-path decomposition over the clock-aligned merged trace —
+#: dispatch / site train / encode / wire / queue-wait / combine /
+#: flush / publish / adopt — with the straggler site named per round
+#: from the slowest ``site_round`` lane, cross-checked against the
+#: sites' own injected-straggle records, plus the staleness→accuracy
+#: join from the serving probe). Older documents (and older
+#: ``obs_schema`` round streams) are still accepted — each
 #: version's keys are required only of documents at that version or
 #: newer.
-ANALYSIS_SCHEMA_VERSION = 4
+ANALYSIS_SCHEMA_VERSION = 5
 
 #: host span name -> phase bucket. Container / nested spans are mapped
 #: to None and skipped so phase totals never double-count (``round``
@@ -858,6 +866,177 @@ def _analyze_slo(records: List[Dict[str, Any]],
     return out
 
 
+#: merged-trace span names that each root one causal timeline: a sync
+#: federation round (``fed_round``), a buffered flush (``flush``), or
+#: a serving push (``publish``) — matched in this priority order
+XTRACE_ROOT_SPANS = ("fed_round", "flush", "publish")
+
+#: critical-path buckets, in timeline order. ``wire``/``queue_wait``
+#: come from the aggregator's per-round wall stamps (a span cannot
+#: straddle two clocks); everything else is a span duration. Buckets
+#: a timeline does not exercise are simply absent from its row.
+XTRACE_PHASES = ("dispatch", "site_train", "encode", "wire",
+                 "queue_wait", "combine", "flush", "publish", "adopt")
+
+
+def _xt_proc(span_id: str) -> str:
+    """Span ids are ``<process>:<seq>`` — the lane is the prefix."""
+    return str(span_id).rsplit(":", 1)[0]
+
+
+def _xt_trace_key(trace: str) -> Tuple[str, int]:
+    """Sort ``r0 < r1 < ... < v1 < ...`` numerically, not lexically."""
+    head, tail = trace[:1], trace[1:]
+    if tail.isdigit():
+        return (head, int(tail))
+    return (trace, -1)
+
+
+def _analyze_xtrace(xtrace_doc: Optional[Dict[str, Any]],
+                    records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The schema-v5 xtrace section: per-round critical-path rows over
+    the clock-aligned merged trace (``federation.trace.json``). Each
+    causal timeline (one trace id) decomposes into the phase buckets
+    above; the slowest ``site_round`` lane names the round's straggler,
+    which is cross-checked against the sites' own ``fed_straggled``
+    records (the injected ground truth) — a disagreement lands in
+    ``straggler_mismatches``. ``probe`` joins the serving worker's
+    ``serve_probe_acc`` ticks against model staleness (satellite:
+    accuracy-under-staleness). ``present`` only when a merged trace
+    with spans exists — untraced runs analyze with an empty section."""
+    out: Dict[str, Any] = {
+        "present": False, "processes": [], "orphans": [],
+        "rounds": [], "straggler_counts": {},
+        "straggler_mismatches": [], "probe": {},
+    }
+    if not isinstance(xtrace_doc, dict):
+        return out
+    from . import xtrace as obs_xtrace
+
+    idx = obs_xtrace.span_index(xtrace_doc)
+    if not idx:
+        return out
+    out["present"] = True
+    meta = xtrace_doc.get("xtrace") or {}
+    out["processes"] = [str(p) for p in (meta.get("processes") or ())]
+    out["orphans"] = obs_xtrace.validate_parentage(xtrace_doc)
+    # joins from the round stream(s): the aggregator's wall stamps for
+    # the two clock-straddling buckets, and the sites' straggle truth
+    agg_ms: Dict[int, Dict[str, float]] = {}
+    straggled_gt: Dict[int, set] = {}
+    for r in records or ():
+        if not isinstance(r.get("round"), (int, float)):
+            continue
+        rnd = int(r["round"])
+        if rnd < 0:
+            continue
+        if "site" in r:
+            if r.get("fed_straggled"):
+                straggled_gt.setdefault(rnd, set()).add(
+                    int(r["site"]))
+        elif isinstance(r.get("fed_wire_ms"), (int, float)):
+            agg_ms[rnd] = {
+                "wire": float(r["fed_wire_ms"]),
+                "queue_wait": float(r.get("fed_queue_ms") or 0.0)}
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for sid in sorted(idx):
+        t = str((idx[sid].get("args") or {}).get("trace", ""))
+        if t:
+            by_trace.setdefault(t, []).append(idx[sid])
+    counts: Dict[str, int] = {}
+    for trace in sorted(by_trace, key=_xt_trace_key):
+        evs = by_trace[trace]
+        root = None
+        for name in XTRACE_ROOT_SPANS:
+            root = next((e for e in evs if e.get("name") == name),
+                        None)
+            if root is not None:
+                break
+        if root is None:
+            continue
+        rargs = root.get("args") or {}
+        rnd = rargs.get("round", rargs.get("version"))
+        if rnd is None and trace[1:].isdigit():
+            rnd = int(trace[1:])
+        rnd = int(rnd) if isinstance(rnd, (int, float)) else -1
+        durs: Dict[str, List[float]] = {}
+        sites: Dict[str, float] = {}
+        injected: set = set()
+        for e in evs:
+            name = str(e.get("name", ""))
+            d_ms = float(e.get("dur", 0.0)) / 1e3
+            proc = _xt_proc((e.get("args") or {}).get("span_id", ""))
+            if name == "site_round":
+                sites[proc] = d_ms
+            elif name == "straggle":
+                injected.add(proc)
+            durs.setdefault(name, []).append(d_ms)
+        # sites run in parallel: their buckets enter the critical path
+        # at the max across lanes, not the sum
+        phases: Dict[str, float] = {}
+        for bucket, src, how in (
+                ("dispatch", "dispatch", sum),
+                ("site_train", "train", max),
+                ("encode", "encode", max),
+                ("combine", "combine", sum),
+                ("flush", "flush", sum),
+                ("publish", "publish", sum),
+                ("adopt", "adopt", max)):
+            if src == root.get("name"):
+                continue  # the root is the total, not a bucket
+            if durs.get(src):
+                phases[bucket] = how(durs[src])
+        for bucket, v in (agg_ms.get(rnd) or {}).items():
+            phases[bucket] = v
+        row: Dict[str, Any] = {
+            "trace": trace, "round": rnd,
+            "root": str(root.get("name")),
+            "total_ms": float(root.get("dur", 0.0)) / 1e3,
+            "phases": {k: phases[k] for k in XTRACE_PHASES
+                       if k in phases},
+            "sites": {k: sites[k] for k in sorted(sites)},
+        }
+        if sites:
+            straggler = max(sorted(sites), key=lambda p: sites[p])
+            row["straggler"] = straggler
+            counts[straggler] = counts.get(straggler, 0) + 1
+            if injected:
+                row["injected_straggle"] = sorted(injected)
+            gt = {f"site{s}" for s in straggled_gt.get(rnd, ())}
+            gt |= injected
+            if gt and straggler not in gt:
+                out["straggler_mismatches"].append(
+                    {"trace": trace, "round": rnd,
+                     "named": straggler, "injected": sorted(gt)})
+        out["rounds"].append(row)
+    out["straggler_counts"] = {k: counts[k] for k in sorted(counts)}
+    # staleness -> accuracy join from the serving probe ticks
+    pairs = [(float(r["serve_model_staleness_s"]),
+              float(r["serve_probe_acc"]))
+             for r in records or ()
+             if isinstance(r.get("serve_probe_acc"), (int, float))
+             and isinstance(r.get("serve_model_staleness_s"),
+                            (int, float))]
+    if pairs:
+        stale = sorted(s for s, _ in pairs)
+        accs = [a for _, a in pairs]
+        med = stale[len(stale) // 2]
+        fresh = [a for s, a in pairs if s <= med]
+        old = [a for s, a in pairs if s > med]
+        out["probe"] = {
+            "n": len(pairs),
+            "staleness_s": {"min": stale[0], "max": stale[-1],
+                            "median": med},
+            "acc": {"min": min(accs), "max": max(accs),
+                    "last": accs[-1]},
+            "acc_fresh_mean": (sum(fresh) / len(fresh)
+                               if fresh else None),
+            "acc_stale_mean": (sum(old) / len(old)
+                               if old else None),
+        }
+    return out
+
+
 def _analyze_compile(metrics: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     m = metrics or {}
     out: Dict[str, Any] = {"present": False, "total_s": 0.0,
@@ -893,7 +1072,8 @@ def analyze_records(records: List[Dict[str, Any]],
                     config: Optional[Dict[str, Any]] = None,
                     identity: str = "run",
                     devtrace: Optional[Dict[str, Any]] = None,
-                    events: Optional[List[Dict[str, Any]]] = None
+                    events: Optional[List[Dict[str, Any]]] = None,
+                    xtrace_doc: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Any]:
     """Pure-function analyzer core over an already-loaded round stream
     (plus optional trace / metrics.json / run-config dicts)."""
@@ -906,7 +1086,10 @@ def analyze_records(records: List[Dict[str, Any]],
             f"analyzer understands <= {obs_export.OBS_SCHEMA_VERSION} "
             "— upgrade before analyzing")
     # duplicate detection wants the RAW stream; everything else the
-    # deduped (keep-last, sorted) timeline
+    # deduped (keep-last, sorted) timeline. The xtrace join also wants
+    # the raw stream: fed dirs interleave aggregator and per-site
+    # records sharing round numbers, which keep-last would collapse.
+    raw_records = list(records)
     rounds_info = _analyze_rounds(_round_records(records))
     records = obs_export.dedupe_rounds(records)
     rounds = _round_records(records)
@@ -920,6 +1103,7 @@ def analyze_records(records: List[Dict[str, Any]],
     comm = _analyze_comm(rounds, metrics, devtrace=devtrace,
                          config=config)
     slo = _analyze_slo(rounds, events, config)
+    xtr = _analyze_xtrace(xtrace_doc, raw_records)
     analysis = {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
         "identity": identity,
@@ -936,6 +1120,7 @@ def analyze_records(records: List[Dict[str, Any]],
         "outlier_table": _outlier_table(stragglers, numerics),
         "comm": comm,
         "slo": slo,
+        "xtrace": xtr,
     }
     flags = []
     flags += [f"straggler_round_{s['round']}" for s in stragglers]
@@ -965,6 +1150,12 @@ def analyze_records(records: List[Dict[str, Any]],
                             if b["event_type"] == "SLO_BREACH"})
     if breach_rounds:
         flags.append(f"slo_breach_rounds_{len(breach_rounds)}")
+    if xtr["present"]:
+        if xtr["orphans"]:
+            flags.append(f"xtrace_orphans_{len(xtr['orphans'])}")
+        if xtr["straggler_mismatches"]:
+            flags.append("xtrace_straggler_mismatch_"
+                         f"{len(xtr['straggler_mismatches'])}")
     analysis["flags"] = flags
     return analysis
 
@@ -988,6 +1179,9 @@ _SCHEMA_KEYS_V3 = {"comm": dict}
 #: keys ADDED by schema v4 — required only of v4+ documents
 _SCHEMA_KEYS_V4 = {"slo": dict}
 
+#: keys ADDED by schema v5 — required only of v5+ documents
+_SCHEMA_KEYS_V5 = {"xtrace": dict}
+
 
 def validate_analysis(analysis: Dict[str, Any]) -> None:
     """Raise ValueError describing every schema violation (an explicit
@@ -1004,6 +1198,8 @@ def validate_analysis(analysis: Dict[str, Any]) -> None:
             required.update(_SCHEMA_KEYS_V3)
         if analysis["schema_version"] >= 4:
             required.update(_SCHEMA_KEYS_V4)
+        if analysis["schema_version"] >= 5:
+            required.update(_SCHEMA_KEYS_V5)
     for key, typ in required.items():
         if key not in analysis:
             problems.append(f"missing key {key!r}")
@@ -1051,6 +1247,12 @@ def analyze_run_dir(run_dir: str, trace_dir: str = "",
     beside its stream."""
     if not os.path.isdir(run_dir):
         raise ValueError(f"not a directory: {run_dir}")
+    from . import xtrace as obs_xtrace
+
+    # the clock-aligned merged trace is per run DIR (one federation /
+    # serving fleet), not per identity — every run under it shares it
+    xtrace_doc = _maybe_json(
+        os.path.join(run_dir, obs_xtrace.MERGED_TRACE_NAME))
     out = []
     for fname in sorted(os.listdir(run_dir)):
         if not fname.endswith(".obs.jsonl"):
@@ -1081,13 +1283,60 @@ def analyze_run_dir(run_dir: str, trace_dir: str = "",
         analysis = analyze_records(
             records, trace_doc=trace_doc, metrics=metrics,
             config=(stat or {}).get("config"), identity=identity,
-            devtrace=devtrace, events=events)
+            devtrace=devtrace, events=events, xtrace_doc=xtrace_doc)
         if write:
             analysis["analysis_path"] = write_analysis(
                 analysis, os.path.join(run_dir,
                                        identity + ".analysis.json"))
         out.append(analysis)
     return out
+
+
+def render_xtrace(xt: Dict[str, Any]) -> List[str]:
+    """The human-readable side of the v5 xtrace section — shared by
+    ``render_report`` and the ``obs xtrace`` CLI. Empty (no lines) for
+    untraced runs."""
+    if not xt.get("present"):
+        return []
+    lines = [
+        "xtrace (clock-aligned causal trace): "
+        + f"{len(xt.get('processes') or ())} lane(s): "
+        + ", ".join(xt.get("processes") or ())]
+    if xt.get("orphans"):
+        lines.append(
+            f"  WARNING {len(xt['orphans'])} orphan span(s) — "
+            "causal tree not closed")
+    for rd in (xt.get("rounds") or ())[:16]:
+        bits = [f"{k} {v:.1f}" for k, v in rd["phases"].items()]
+        lines.append(
+            f"  {rd['trace']:<8} total {rd['total_ms']:8.1f} ms"
+            + (" | " + " ".join(bits) if bits else "")
+            + (f" | straggler {rd['straggler']}"
+               if rd.get("straggler") else ""))
+    if len(xt.get("rounds") or ()) > 16:
+        lines.append(
+            f"  ... {len(xt['rounds']) - 16} more timeline(s)")
+    sc = xt.get("straggler_counts") or {}
+    if sc:
+        lines.append("  stragglers: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(
+                sc.items(), key=lambda kv: -kv[1])))
+    for m in xt.get("straggler_mismatches") or ():
+        lines.append(
+            f"  MISMATCH {m['trace']}: named {m['named']} but "
+            "injected " + ", ".join(m["injected"]))
+    pr = xt.get("probe") or {}
+    if pr:
+        fm, sm = pr.get("acc_fresh_mean"), pr.get("acc_stale_mean")
+        lines.append(
+            f"  staleness probe: {pr['n']} tick(s), staleness "
+            f"{pr['staleness_s']['min']:.2f}-"
+            f"{pr['staleness_s']['max']:.2f} s, acc last "
+            f"{pr['acc']['last']:.3f}"
+            + (f" (fresh-half mean {fm:.3f} vs stale-half "
+               f"{sm:.3f})" if fm is not None and sm is not None
+               else ""))
+    return lines
 
 
 def render_report(analysis: Dict[str, Any]) -> str:
@@ -1288,6 +1537,7 @@ def render_report(analysis: Dict[str, Any]) -> str:
             lines.append("  events: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(
                     (ev.get("by_type") or {}).items())))
+    lines.extend(render_xtrace(a.get("xtrace") or {}))
     c = a["compile"]
     if c["present"]:
         lines.append(f"compile: {c['total_s']:.2f} s total"

@@ -44,7 +44,21 @@ __all__ = [
 #: per-round keys that legitimately differ between bit-identical runs
 #: (wall clock, probed timings) — never part of any plane's verdict
 VOLATILE_KEYS = {"round_time_s", "comm_agg_ms", "comm_agg_share",
-                 "host", "obs_schema", "store_gather_ms"}
+                 "host", "obs_schema", "store_gather_ms",
+                 # wall timings stamped by the federation / serving
+                 # planes (obs/xtrace.py): pure clock, never verdict
+                 "wall_s", "fed_round_ms", "fed_wire_ms",
+                 "fed_queue_ms", "serve_adopt_lag_ms",
+                 # probe accuracy depends on which model version the
+                 # serving worker had adopted at tick time — wall
+                 # scheduling, not run state
+                 "serve_probe_acc",
+                 # transport counters: tracing headers and HELLO
+                 # clock-sync frames legitimately shift byte/message
+                 # counts between otherwise bit-identical twins
+                 "comm_bytes_sent", "comm_bytes_received",
+                 "comm_messages_sent", "comm_messages_received",
+                 "comm_messages_retried"}
 
 #: key prefixes with the same exemption (memory watermarks are host
 #: state, not run state)

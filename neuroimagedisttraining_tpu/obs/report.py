@@ -11,6 +11,11 @@ cataloged run:
   ``slo_health`` stamps, event markers from the events stream;
 * the WIRE-COST table from the ``comm_*`` stamps (obs/comm.py's
   analytical model) of each run that recorded them;
+* FEDERATION LANES: every federation run dir under the results dir
+  (a subdir holding ``aggregator.jsonl`` + ``site<k>.jsonl``
+  per-process streams — these live outside the catalog) renders one
+  row per process: rounds, loss/wall sparklines, straggle counts,
+  and whether a clock-aligned ``federation.trace.json`` was merged;
 * a cross-run SCATTER (rounds/sec vs cohort size) from the bench
   history (``results/bench_history.jsonl``).
 
@@ -31,8 +36,8 @@ from .catalog import read_catalog
 from .export import dedupe_rounds, read_jsonl
 
 __all__ = [
-    "REPORT_SCHEMA_VERSION", "build_report", "load_runs",
-    "scatter_points", "write_report",
+    "REPORT_SCHEMA_VERSION", "build_report", "find_fed_dirs",
+    "load_fed_lanes", "load_runs", "scatter_points", "write_report",
 ]
 
 #: stamped in the report header (a report consumer's compat check)
@@ -140,6 +145,71 @@ def load_runs(entries: List[Dict[str, Any]]
     return out
 
 
+def find_fed_dirs(results_dir: str) -> List[str]:
+    """Federation run dirs under ``results_dir``: immediate subdirs
+    holding an ``aggregator.jsonl`` per-process stream (these runs
+    live outside the catalog — their streams are plain ``.jsonl``,
+    one per process). Sorted, so the report stays deterministic."""
+    if not results_dir or not os.path.isdir(results_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(results_dir)):
+        d = os.path.join(results_dir, name)
+        if os.path.isdir(d) and \
+                os.path.exists(os.path.join(d, "aggregator.jsonl")):
+            out.append(d)
+    return out
+
+
+def load_fed_lanes(fed_dir: str) -> Dict[str, Any]:
+    """One federation run dir's per-process lanes (aggregator +
+    every site), plus whether the clock-aligned merged trace exists.
+    Unreadable streams degrade to empty lanes."""
+    lanes = []
+    for fname in sorted(os.listdir(fed_dir)):
+        if not fname.endswith(".jsonl") or \
+                fname.endswith(".events.jsonl") or \
+                fname == "federation.jsonl":
+            continue
+        stem = fname[:-len(".jsonl")]
+        if stem != "aggregator" and not stem.startswith("site"):
+            continue
+        try:
+            records = read_jsonl(os.path.join(fed_dir, fname),
+                                 allow_partial_tail=True)
+        except (OSError, ValueError):
+            records = []
+        lanes.append({"process": stem, "records": records})
+    return {
+        "dir": fed_dir, "lanes": lanes,
+        "traced": os.path.exists(
+            os.path.join(fed_dir, "federation.trace.json")),
+    }
+
+
+def _fed_lane_rows(fed: Dict[str, Any]) -> List[str]:
+    rows = []
+    for lane in fed["lanes"]:
+        recs = [r for r in lane["records"]
+                if isinstance(r.get("round"), int)
+                and r["round"] >= 0]
+        loss = [float(r["train_loss"]) for r in recs
+                if isinstance(r.get("train_loss"), (int, float))]
+        wall = [float(r["wall_s"]) for r in recs
+                if isinstance(r.get("wall_s"), (int, float))]
+        straggles = sum(1 for r in recs if r.get("fed_straggled"))
+        cells = [
+            f"<td><code>{_html.escape(lane['process'], quote=True)}"
+            "</code></td>",
+            f"<td>{len(recs)}</td>",
+            f"<td>{_sparkline(loss) or '—'}</td>",
+            f"<td>{_sparkline(wall) or '—'}</td>",
+            f"<td>{straggles or '—'}</td>",
+        ]
+        rows.append("<tr>" + "".join(cells) + "</tr>")
+    return rows
+
+
 def scatter_points(history: List[Dict[str, Any]]
                    ) -> List[Tuple[str, int, float]]:
     """(metric, cohort size, rounds/sec) points from the bench
@@ -214,7 +284,9 @@ svg.spark{vertical-align:middle}
 
 def build_report(entries: List[Dict[str, Any]],
                  runs: Optional[Dict[str, Dict[str, Any]]] = None,
-                 history: Optional[List[Dict[str, Any]]] = None) -> str:
+                 history: Optional[List[Dict[str, Any]]] = None,
+                 fed_runs: Optional[List[Dict[str, Any]]] = None
+                 ) -> str:
     """The full fleet report HTML (a pure function of its inputs —
     the byte-determinism contract)."""
     runs = runs if runs is not None else load_runs(entries)
@@ -309,6 +381,26 @@ def build_report(entries: List[Dict[str, Any]],
     else:
         parts.append('<p class="muted">no runs recorded comm_* '
                      "telemetry (--obs_comm)</p>")
+    if fed_runs:
+        parts.append("<h2>Federation lanes "
+                     '<span class="muted">(per-process streams '
+                     "under the fed run dirs)</span></h2>")
+        for fed in fed_runs:
+            base = os.path.basename(fed["dir"].rstrip("/"))
+            parts.append(
+                f"<p><code>{_html.escape(base, quote=True)}</code>"
+                + (' <span class="muted">· clock-aligned merged '
+                   "trace (federation.trace.json)</span>"
+                   if fed.get("traced") else "")
+                + "</p>")
+            rows = _fed_lane_rows(fed)
+            parts.append(
+                "<table><tr><th>process</th><th>rounds</th>"
+                "<th>train_loss</th><th>wall_s</th>"
+                "<th>straggles</th></tr>"
+                + ("".join(rows)
+                   or '<tr><td colspan="5">no lanes</td></tr>')
+                + "</table>")
     parts.append("<h2>Rounds/sec vs cohort size "
                  '<span class="muted">(bench history)</span></h2>')
     parts.append(_scatter_svg(points))
@@ -317,9 +409,11 @@ def build_report(entries: List[Dict[str, Any]],
 
 
 def write_report(out_path: str, catalog: str,
-                 history_path: str = "") -> str:
-    """Read the catalog (+ optional bench history), render, write.
-    Returns ``out_path``."""
+                 history_path: str = "",
+                 results_dir: str = "") -> str:
+    """Read the catalog (+ optional bench history + federation run
+    dirs under ``results_dir``, default: the catalog's own dir),
+    render, write. Returns ``out_path``."""
     entries = read_catalog(catalog)
     history: List[Dict[str, Any]] = []
     if history_path and os.path.exists(history_path):
@@ -327,7 +421,10 @@ def write_report(out_path: str, catalog: str,
             history = read_jsonl(history_path, allow_partial_tail=True)
         except ValueError:
             history = []
-    html_text = build_report(entries, history=history)
+    results_dir = results_dir or (os.path.dirname(catalog) or ".")
+    fed_runs = [load_fed_lanes(d) for d in find_fed_dirs(results_dir)]
+    html_text = build_report(entries, history=history,
+                             fed_runs=fed_runs)
     d = os.path.dirname(out_path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -30,7 +30,12 @@ Grammar: ``<kind>:<metric><op><threshold>[@k=v,...]`` with
   round);
 * ``metric`` — any numeric key of the per-round JSONL record
   (``round_time_s``, ``train_loss``, ``clients_quarantined``,
-  ``mem_device_bytes_in_use``, ``comm_agg_share``, ...);
+  ``mem_device_bytes_in_use``, ``comm_agg_share``, ...). The
+  federation/serving planes stamp their own keys when ``--xtrace``
+  tracing is on, so objectives like ``p95:fed_round_ms<2000``,
+  ``p95:fed_wire_ms<50``, ``rate:fed_queue_ms<20``,
+  ``p99:serve_adopt_lag_ms<500`` or ``ewma:serve_probe_acc>0.5``
+  evaluate live at the aggregator / serving worker;
 * ``op`` — ``<``, ``<=``, ``>``, ``>=`` (the condition the run must
   SATISFY; violation = the condition fails);
 * params — ``w`` (window, rounds), ``a`` (EWMA alpha), ``budget``

@@ -36,8 +36,10 @@ import numpy as np
 
 from ..comm.manager import ServerManager
 from ..comm.message import Message
-from ..fed import wire
+from ..fed import protocol, wire
 from ..fed.protocol import send_with_retry
+from ..obs import xtrace
+from ..obs.xtrace import XTracer
 from . import (MSG_SERVE_ACK, MSG_SERVE_FINISH, MSG_SERVE_PUSH,
                PUSH_WIRE_IMPLS)
 
@@ -102,7 +104,8 @@ class CheckpointPublisher(ServerManager):
     def __init__(self, comm, rank: int = 0, world_size: int = 2,
                  worker_rank: int = 1, ckpt_dir: str = "",
                  wire_impl: str = "int8", retries: int = 2,
-                 backoff_s: float = 0.05):
+                 backoff_s: float = 0.05,
+                 tracer: Optional[XTracer] = None):
         super().__init__(comm, rank=rank, world_size=world_size)
         if wire_impl not in PUSH_WIRE_IMPLS:
             raise ValueError(
@@ -112,6 +115,7 @@ class CheckpointPublisher(ServerManager):
         self.wire_impl = wire_impl
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
+        self.tracer = tracer
         self._base: Optional[Any] = None  # last reconstructed version
         self.pushes = 0
         self.bytes_pushed = 0
@@ -119,8 +123,20 @@ class CheckpointPublisher(ServerManager):
         self._acked_version = -1
         self.register_message_receive_handler(MSG_SERVE_ACK,
                                               self._on_ack)
+        # clock-sync echo for the worker-initiated HELLO (the serving
+        # plane's reference clock is the publisher); registered
+        # unconditionally, only ever exercised when tracing is on
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HELLO, self._on_hello)
 
     # -- protocol ---------------------------------------------------------
+    def _on_hello(self, msg: Message) -> None:
+        t1 = self.tracer.wall_ns() if self.tracer is not None \
+            else time.time_ns()
+        reply = protocol.hello_ack(msg, self.rank, self.rank, t1)
+        send_with_retry(self, reply, retries=self.retries,
+                        backoff_s=self.backoff_s)
+
     def _on_ack(self, msg: Message) -> None:
         with self._ack_cond:
             self._acked_version = max(self._acked_version,
@@ -147,30 +163,44 @@ class CheckpointPublisher(ServerManager):
         """Ship one model version to the worker and checkpoint the
         reconstruction; returns the checkpoint path ('' if ckpt_dir is
         unset)."""
-        params = _np_f32_tree(params)
-        msg = Message(MSG_SERVE_PUSH, self.rank, self.worker_rank)
-        msg.add("version", int(version))
-        if self._base is None:
-            # the baseline: full params, dense — bit-exact by
-            # construction, and the only push that may not be a delta
-            msg.add("kind", "full")
-            wire.encode_update(msg, params, "dense", key="delta")
-            self._base = wire.decode_update(msg, key="delta")
-        else:
-            delta = _tree_sub(params, self._base)
-            msg.add("kind", "delta")
-            wire.encode_update(msg, delta, self.wire_impl, key="delta")
-            # decode OUR OWN payload: the worker's reconstruction twin
-            self._base = _tree_add(self._base,
-                                   wire.decode_update(msg, key="delta"))
-        payload = msg.to_bytes()
-        self.bytes_pushed += len(payload)
-        send_with_retry(self, msg, retries=self.retries,
-                        backoff_s=self.backoff_s)
-        self.pushes += 1
-        path = ""
-        if self.ckpt_dir:
-            path = save_checkpoint(self.ckpt_dir, version, self._base)
+        with xtrace.xspan(self.tracer, "publish",
+                          trace_id=f"v{int(version)}",
+                          args={"version": int(version)}) as pspan:
+            params = _np_f32_tree(params)
+            msg = Message(MSG_SERVE_PUSH, self.rank, self.worker_rank)
+            msg.add("version", int(version))
+            with xtrace.xspan(self.tracer, "encode"):
+                if self._base is None:
+                    # the baseline: full params, dense — bit-exact by
+                    # construction, and the only push that may not be a
+                    # delta
+                    msg.add("kind", "full")
+                    wire.encode_update(msg, params, "dense", key="delta")
+                    self._base = wire.decode_update(msg, key="delta")
+                else:
+                    delta = _tree_sub(params, self._base)
+                    msg.add("kind", "delta")
+                    wire.encode_update(msg, delta, self.wire_impl,
+                                       key="delta")
+                    # decode OUR OWN payload: the worker's
+                    # reconstruction twin
+                    self._base = _tree_add(
+                        self._base, wire.decode_update(msg, key="delta"))
+            if self.tracer is not None:
+                # the worker's adopt span parents to THIS publish; the
+                # send stamp is its adopt-lag input
+                xtrace.inject(msg, pspan.ctx(),
+                              wall_ns=self.tracer.wall_ns())
+            payload = msg.to_bytes()
+            self.bytes_pushed += len(payload)
+            send_with_retry(self, msg, retries=self.retries,
+                            backoff_s=self.backoff_s)
+            self.pushes += 1
+            path = ""
+            if self.ckpt_dir:
+                with xtrace.xspan(self.tracer, "checkpoint"):
+                    path = save_checkpoint(self.ckpt_dir, version,
+                                           self._base)
         logger.info("serve publish v%d: %s wire, %d B%s",
                     version, msg.get("kind"), len(payload),
                     f" -> {path}" if path else "")
@@ -179,8 +209,13 @@ class CheckpointPublisher(ServerManager):
     def finish_worker(self) -> None:
         """Tell the worker to drain and exit (``serve_finish``)."""
         msg = Message(MSG_SERVE_FINISH, self.rank, self.worker_rank)
-        send_with_retry(self, msg, retries=self.retries,
-                        backoff_s=self.backoff_s)
+        with xtrace.xspan(self.tracer, "finish",
+                          trace_id="finish") as fin:
+            if self.tracer is not None:
+                xtrace.inject(msg, fin.ctx(),
+                              wall_ns=self.tracer.wall_ns())
+            send_with_retry(self, msg, retries=self.retries,
+                            backoff_s=self.backoff_s)
 
     @property
     def servable_params(self) -> Optional[Any]:

@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import xtrace
+from ..obs.xtrace import XTracer
 from . import PUSH_WIRE_IMPLS, SERVE_SALT
 from .batcher import MicroBatcher, ServeRequest
 from .publisher import (CheckpointPublisher, checkpoint_path,
@@ -221,8 +223,43 @@ def _pump_traffic(worker: ServeWorker, reqs, rps: float) -> None:
         worker.mark_traffic_done()
 
 
+def _serve_tracer(args, process: str) -> Optional[XTracer]:
+    """Per-process tracer for the serving pair (``--xtrace`` only).
+    The publisher is the plane's reference clock."""
+    if not getattr(args, "xtrace", 0):
+        return None
+    return XTracer(process, ref="publisher")
+
+
+def _serve_xtrace_dir(args, out_dir: str) -> str:
+    return getattr(args, "xtrace_dir", "") or out_dir
+
+
+def _write_serve_stream(tracer: Optional[XTracer], args,
+                        out_dir: str) -> str:
+    if tracer is None:
+        return ""
+    return tracer.write(os.path.join(
+        _serve_xtrace_dir(args, out_dir),
+        tracer.process + xtrace.STREAM_SUFFIX))
+
+
+def _probe_data(args, algo) -> Optional[Tuple[Any, Any]]:
+    """The fixed labeled probe slab for ``--serve_probe_every``: the
+    first training volume of the first few clients (deterministic, one
+    compiled shape)."""
+    if int(getattr(args, "serve_probe_every", 0)) < 1:
+        return None
+    d = algo.data
+    n = min(8, int(np.asarray(d.x_train).shape[0]))
+    ids = np.arange(n)
+    return (np.asarray(d.x_train)[ids, 0],
+            np.asarray(d.y_train)[ids, 0])
+
+
 def _make_worker(args, algo, comm, session, out_dir: str,
-                 init_params) -> ServeWorker:
+                 init_params,
+                 tracer: Optional[XTracer] = None) -> ServeWorker:
     d = algo.data
     num_clients = int(np.asarray(d.x_train).shape[0])
     store = _populate_store(args, out_dir, init_params, num_clients)
@@ -234,7 +271,10 @@ def _make_worker(args, algo, comm, session, out_dir: str,
         init_params=init_params, store=store, data_x=d.x_train,
         data_n=d.n_train, batcher=batcher, session=session,
         retries=int(getattr(args, "fed_retries", 2)),
-        backoff_s=float(getattr(args, "fed_backoff_s", 0.05)))
+        backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
+        tracer=tracer,
+        probe_every=int(getattr(args, "serve_probe_every", 0)),
+        probe_data=_probe_data(args, algo))
 
 
 def _ckpt_dir(args, out_dir: str) -> str:
@@ -333,14 +373,17 @@ def _run_loopback(args, algo_name: str, identity: str,
     session = _make_session(args, algo_name, identity, out_dir)
     ckpt_dir = _ckpt_dir(args, out_dir)
     worker = _make_worker(args, algo, router.manager(1), session,
-                          out_dir, init_params)
+                          out_dir, init_params,
+                          tracer=_serve_tracer(args, "serve_worker"))
     worker.run(background=True)
     pub = CheckpointPublisher(
         router.manager(0), ckpt_dir=ckpt_dir,
         wire_impl=getattr(args, "serve_wire", "int8"),
         retries=int(getattr(args, "fed_retries", 2)),
-        backoff_s=float(getattr(args, "fed_backoff_s", 0.05)))
+        backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
+        tracer=_serve_tracer(args, "publisher"))
     pub.run(background=True)
+    worker.clock_sync()
     worker.warmup()
     serve_thread = threading.Thread(target=worker.serve_loop,
                                     daemon=True)
@@ -366,6 +409,11 @@ def _run_loopback(args, algo_name: str, identity: str,
                        wall)
     finally:
         pub.finish()
+    _write_serve_stream(pub.tracer, args, out_dir)
+    _write_serve_stream(worker.tracer, args, out_dir)
+    if worker.tracer is not None:
+        serve["merged_trace"] = xtrace.merge_run_dir(
+            _serve_xtrace_dir(args, out_dir)) or ""
     serve.update(pushes=pub.pushes, bytes_pushed=pub.bytes_pushed,
                  acked_version=pub.acked_version, out_dir=out_dir,
                  backend="local")
@@ -392,7 +440,8 @@ def _run_tcp(args, algo_name: str, identity: str,
             TcpCommManager(0, endpoints), ckpt_dir=ckpt_dir,
             wire_impl=getattr(args, "serve_wire", "int8"),
             retries=int(getattr(args, "fed_retries", 2)),
-            backoff_s=float(getattr(args, "fed_backoff_s", 0.05)))
+            backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
+            tracer=_serve_tracer(args, "publisher"))
         pub.run(background=True)
         t0 = time.perf_counter()
         try:
@@ -404,6 +453,7 @@ def _run_tcp(args, algo_name: str, identity: str,
             pub.finish_worker()
         finally:
             pub.finish()
+        xtrace_path = _write_serve_stream(pub.tracer, args, out_dir)
         return {"identity": identity, "history": [], "final_eval": {},
                 "stat_path": out_dir, "state": None,
                 "serve": {"role": "publisher", "backend": "tcp",
@@ -413,14 +463,17 @@ def _run_tcp(args, algo_name: str, identity: str,
                           "ckpt_dir": ckpt_dir,
                           "wall_s": time.perf_counter() - t0,
                           "out_dir": out_dir,
+                          "xtrace_path": xtrace_path,
                           **pub.comm.counters.snapshot()}}
     # worker role: serve own traffic, adopt pushes until serve_finish
     d = algo.data
     num_clients = int(np.asarray(d.x_train).shape[0])
     session = _make_session(args, algo_name, identity, out_dir)
     worker = _make_worker(args, algo, TcpCommManager(1, endpoints),
-                          session, out_dir, init_params)
+                          session, out_dir, init_params,
+                          tracer=_serve_tracer(args, "serve_worker"))
     worker.run(background=True)
+    worker.clock_sync()
     worker.warmup()
     serve_thread = threading.Thread(target=worker.serve_loop,
                                     daemon=True)
@@ -438,6 +491,12 @@ def _run_tcp(args, algo_name: str, identity: str,
     traffic.join(timeout=timeout)
     wall = time.perf_counter() - t0
     serve = _drain(args, worker, session, serve_thread, ckpt_dir, wall)
+    _write_serve_stream(worker.tracer, args, out_dir)
+    if worker.tracer is not None:
+        # same filesystem (the smoke's shape): the publisher's stream
+        # is already on disk, so this merge holds both lanes
+        serve["merged_trace"] = xtrace.merge_run_dir(
+            _serve_xtrace_dir(args, out_dir)) or ""
     serve.update(role="worker", backend="tcp", out_dir=out_dir)
     return {"identity": identity, "history": [], "final_eval": {},
             "stat_path": out_dir, "state": None, "serve": serve}

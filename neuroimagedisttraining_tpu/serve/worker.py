@@ -34,16 +34,19 @@ rebuild (``obs/catalog.py entry_from_run``) recognize.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from ..comm.manager import ClientManager
 from ..comm.message import Message
-from ..fed import wire
+from ..fed import protocol, wire
 from ..fed.protocol import send_with_retry
+from ..obs import xtrace
+from ..obs.xtrace import XTracer
 from . import MSG_SERVE_ACK, MSG_SERVE_FINISH, MSG_SERVE_PUSH
 from .batcher import MicroBatcher
 
@@ -65,7 +68,10 @@ class ServeWorker(ClientManager):
     def __init__(self, comm, rank: int, world_size: int, apply_fn,
                  init_params: Any, store, data_x, data_n,
                  batcher: MicroBatcher, session=None,
-                 retries: int = 2, backoff_s: float = 0.05):
+                 retries: int = 2, backoff_s: float = 0.05,
+                 tracer: Optional[XTracer] = None,
+                 probe_every: int = 0,
+                 probe_data: Optional[Tuple[Any, Any]] = None):
         super().__init__(comm, rank=rank, world_size=world_size)
         import jax
 
@@ -77,6 +83,19 @@ class ServeWorker(ClientManager):
         self.session = session
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
+        self.tracer = tracer
+        # accuracy-under-staleness probe: every ``probe_every`` ticks
+        # run the CURRENT served model over a fixed labeled probe set
+        # and stamp ``serve_probe_acc`` beside the tick's
+        # ``serve_model_staleness_s`` (the analyzer joins the pairs)
+        self.probe_every = int(probe_every)
+        self._probe_x = self._probe_y = None
+        if probe_data is not None:
+            self._probe_x = np.asarray(probe_data[0])
+            self._probe_y = np.asarray(probe_data[1])
+        self._jprobe = None
+        self._last_adopt_lag_ms: Optional[float] = None
+        self._hello_acks: "queue.Queue[Dict[str, float]]" = queue.Queue()
         # model plane
         self._swap_lock = threading.Lock()
         self._g_host = jax.tree_util.tree_map(
@@ -107,6 +126,42 @@ class ServeWorker(ClientManager):
                                               self._on_push)
         self.register_message_receive_handler(MSG_SERVE_FINISH,
                                               self._on_finish)
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HELLO_ACK, self._on_hello_ack)
+
+    # -- clock sync (xtrace-gated) ----------------------------------------
+    def _on_hello_ack(self, msg: Message) -> None:
+        t2 = self.tracer.wall_ns() if self.tracer is not None \
+            else time.time_ns()
+        self._hello_acks.put({"t0": float(msg.get("t0_ns", 0)),
+                              "t1": float(msg.get("t1_ns", 0)),
+                              "t2": float(t2)})
+
+    def clock_sync(self, timeout_s: float = 10.0) -> bool:
+        """Worker-initiated HELLO toward the publisher (the serving
+        plane's reference clock): the NTP-midpoint estimate lands on
+        ``tracer.offset_ns`` as THIS clock minus the publisher's —
+        adopt-lag and the merged-trace lane alignment both key off it.
+        No-op (False) when tracing is off."""
+        if self.tracer is None:
+            return False
+        send_with_retry(
+            self, protocol.hello_message(self.rank, 0,
+                                         self.tracer.wall_ns()),
+            retries=self.retries, backoff_s=self.backoff_s)
+        try:
+            ack = self._hello_acks.get(timeout=float(timeout_s))
+        except queue.Empty:
+            logger.warning("serve hello: no ACK from publisher within "
+                           "%.1fs; lanes merge unaligned", timeout_s)
+            return False
+        # ntp_offset returns publisher-minus-worker; offset_ns is
+        # worker-minus-reference, hence the sign flip
+        est, rtt = xtrace.ntp_offset(ack["t0"], ack["t1"], ack["t2"])
+        self.tracer.offset_ns = -est
+        self.tracer.hello["publisher"] = {"offset_ns": -est,
+                                          "rtt_ns": rtt}
+        return True
 
     # -- model plane ------------------------------------------------------
     @property
@@ -121,33 +176,55 @@ class ServeWorker(ClientManager):
 
         version = int(msg.get("version"))
         kind = msg.get("kind")
-        payload = wire.decode_update(msg, key="delta")
-        if kind == "full":
-            new_host = jax.tree_util.tree_map(
-                lambda x: np.asarray(x, np.float32), payload)
-        else:
+        ctx = xtrace.extract(msg) if self.tracer is not None else None
+        with xtrace.xspan(self.tracer, "adopt",
+                          trace_id=ctx.trace_id if ctx else None,
+                          parent=ctx.span_id if ctx else None,
+                          args={"version": version,
+                                "kind": str(kind)}) as aspan:
+            if ctx is not None:
+                send_ns = xtrace.send_wall_ns(msg)
+                if send_ns is not None:
+                    # publish-to-adopt lag on the PUBLISHER clock:
+                    # our wall mapped through the HELLO offset minus
+                    # the push's send stamp
+                    lag_ms = (self.tracer.to_ref_ns(
+                        self.tracer.wall_ns()) - send_ns) / 1e6
+                    self._last_adopt_lag_ms = lag_ms
+                    aspan.add(lag_ms=lag_ms)
+                    if self.session is not None:
+                        self.session.registry.distribution(
+                            "serve_adopt_lag_ms").observe(float(lag_ms))
+            payload = wire.decode_update(msg, key="delta")
+            if kind == "full":
+                new_host = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x, np.float32), payload)
+            else:
+                with self._swap_lock:
+                    base = self._g_host
+                new_host = jax.tree_util.tree_map(
+                    lambda b, d: (np.asarray(b, np.float32)
+                                  + np.asarray(d, np.float32)),
+                    base, payload)
+            new_dev = jax.device_put(new_host)
             with self._swap_lock:
-                base = self._g_host
-            new_host = jax.tree_util.tree_map(
-                lambda b, d: (np.asarray(b, np.float32)
-                              + np.asarray(d, np.float32)),
-                base, payload)
-        new_dev = jax.device_put(new_host)
-        with self._swap_lock:
-            self._g_host = new_host
-            self._g_dev = new_dev
-            self.version = version
-            self._last_swap_t = time.perf_counter()
-        self.pushes_adopted += 1
-        if self.session is not None:
-            self.session.registry.gauge("serve_model_version").set(
-                float(version))
-            self.session.registry.counter(
-                "serve_pushes_adopted_total").inc()
-        ack = Message(MSG_SERVE_ACK, self.rank, msg.sender_id)
-        ack.add("version", version)
-        send_with_retry(self, ack, retries=self.retries,
-                        backoff_s=self.backoff_s)
+                self._g_host = new_host
+                self._g_dev = new_dev
+                self.version = version
+                self._last_swap_t = time.perf_counter()
+            self.pushes_adopted += 1
+            if self.session is not None:
+                self.session.registry.gauge("serve_model_version").set(
+                    float(version))
+                self.session.registry.counter(
+                    "serve_pushes_adopted_total").inc()
+            ack = Message(MSG_SERVE_ACK, self.rank, msg.sender_id)
+            ack.add("version", version)
+            if ctx is not None:
+                xtrace.inject(ack, aspan.ctx(),
+                              wall_ns=self.tracer.wall_ns())
+            send_with_retry(self, ack, retries=self.retries,
+                            backoff_s=self.backoff_s)
         logger.info("serve worker adopted v%d (%s push)", version, kind)
 
     def _on_finish(self, msg: Message) -> None:
@@ -176,6 +253,23 @@ class ServeWorker(ClientManager):
                            self._g_dev)
         jax.block_until_ready(out)
 
+    def _probe_acc(self) -> float:
+        """Accuracy of the CURRENT served global model over the fixed
+        probe set — the staleness-vs-accuracy joint the analyzer pins
+        (a stale model is only a problem if this number says so)."""
+        import jax
+
+        if self._jprobe is None:
+            def _probe(g, x):
+                return self.apply_fn(g, x, False, None)
+
+            self._jprobe = jax.jit(_probe)
+        with self._swap_lock:
+            g = self._g_dev
+        logits = np.asarray(self._jprobe(g, self._probe_x))
+        return float(np.mean(
+            np.argmax(logits, axis=-1) == self._probe_y))
+
     def _tick_record(self, tick: int, batch, lat_ms: np.ndarray,
                      wall_s: float) -> Dict[str, Any]:
         hits = float(self.store.hits)
@@ -190,7 +284,7 @@ class ServeWorker(ClientManager):
         with self._swap_lock:
             version = self.version
             staleness = now - self._last_swap_t
-        return {
+        rec = {
             "round": int(tick),
             "serve_requests": float(len(batch)),
             "serve_batch_fill": len(batch) / self.batcher.max_batch,
@@ -203,6 +297,16 @@ class ServeWorker(ClientManager):
             "serve_model_version": float(version),
             "serve_model_staleness_s": float(staleness),
         }
+        if self._last_adopt_lag_ms is not None:
+            rec["serve_adopt_lag_ms"] = float(self._last_adopt_lag_ms)
+        if self.probe_every > 0 and self._probe_x is not None \
+                and tick % self.probe_every == 0:
+            acc = self._probe_acc()
+            rec["serve_probe_acc"] = acc
+            if self.session is not None:
+                self.session.registry.gauge(
+                    "serve_probe_acc").set(acc)
+        return rec
 
     def _serve_one(self, batch, tick: int) -> None:
         import jax

@@ -1,8 +1,8 @@
 """Federation smoke: the distributed-runtime subsystem's CI gate.
 
 Runs the loopback federation (1 aggregator + 3 sites on a
-``LocalRouter``, real wire messages, real handler threads) twice and
-asserts the two contracts the subsystem stands on:
+``LocalRouter``, real wire messages, real handler threads) and
+asserts the contracts the subsystem stands on:
 
   1. SYNC BIT-PARITY — a synchronous federated run produces global
      params bit-identical to the single-process simulation with the
@@ -14,6 +14,17 @@ asserts the two contracts the subsystem stands on:
      run still completes every flush from the surviving sites, records
      an arrival trace, and replaying that trace reproduces the global
      params bit-for-bit.
+  3. DISTRIBUTED TRACING — a traced federation (``--xtrace 1``, over
+     the native TCP transport where it builds, the loopback shape
+     otherwise) with an injected per-round straggler produces ONE
+     clock-aligned ``federation.trace.json`` with span lanes from the
+     aggregator AND every site, a closed causal tree (every
+     ``site_round`` parents to its round's ``dispatch`` span), and a
+     per-round critical-path decomposition whose named straggler
+     matches the injected ``--fed_site_faults`` straggle trace.
+     Tracing-on vs tracing-off twins stay ``identical`` through the
+     ``obs/diff.py`` planes (params + per-stream trajectories +
+     events) — tracing off is byte-inert on the wire.
 
     python scripts/fed_smoke.py              # CI gate
     python scripts/fed_smoke.py --rounds 3 --clients 9
@@ -25,10 +36,21 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import socket
 import sys
 import time
 
 STRAGGLER_FAULTS = "3:straggle=1.0:{sleep}"
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
 
 
 def _argv(clients, rounds, tmp, sub):
@@ -59,8 +81,22 @@ def _assert_identical(a, b, what):
             f"{pd['diverged'][:3]}")
 
 
+def _load_jsonl(path):
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                break  # partial tail from a killed writer
+    return recs
+
+
 def run_sync_parity(clients: int, rounds: int, sites: int,
-                    tmp: str) -> dict:
+                    tmp: str) -> tuple:
     """Contract 1: loopback sync federation == in-process simulation."""
     import jax
     import numpy as np
@@ -92,7 +128,8 @@ def run_sync_parity(clients: int, rounds: int, sites: int,
         raise SystemExit(f"sync rounds not all completed: {statuses}")
     if not out_fed["fed"]["federation_jsonl"]:
         raise SystemExit("aggregator produced no folded federation.jsonl")
-    return {"sync_bit_identical": True, "sync_rounds": rounds}
+    # out_fed doubles as the tracing leg's untraced twin
+    return {"sync_bit_identical": True, "sync_rounds": rounds}, out_fed
 
 
 def run_buffered_replay(clients: int, rounds: int, sites: int,
@@ -147,6 +184,141 @@ def run_buffered_replay(clients: int, rounds: int, sites: int,
     }
 
 
+def run_tracing_leg(clients: int, rounds: int, sites: int, tmp: str,
+                    off_fed: dict, straggle_s: float) -> dict:
+    """Contract 3: one merged causal trace, straggler attribution
+    matching the injected fault, tracing off byte-inert."""
+    import glob
+    import threading
+
+    from neuroimagedisttraining_tpu.comm.tcp import native_available
+    from neuroimagedisttraining_tpu.obs import analyze as obs_analyze
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
+    from neuroimagedisttraining_tpu.obs import xtrace
+
+    # -- leg A: traced federation with an injected per-round straggler
+    base = _argv(clients, rounds, tmp, "xt") + [
+        "--fed_mode", "sync", "--fed_sites", str(sites),
+        "--fed_site_faults", f"{sites}:straggle=1.0:{straggle_s}",
+        "--fed_timeout_s", "120",
+        "--xtrace", "1",
+    ]
+    tcp = native_available()
+    if tcp:
+        ports = _free_ports(sites + 1)
+        base += ["--fed_backend", "tcp", "--fed_endpoints",
+                 ",".join(f"127.0.0.1:{p}" for p in ports)]
+        sites_done = []
+
+        def _site(k):
+            _run(base + ["--fed_role", "site",
+                         "--fed_site_rank", str(k)])
+            sites_done.append(k)
+
+        threads = [threading.Thread(target=_site, args=(k,), daemon=True)
+                   for k in range(1, sites + 1)]
+        for t in threads:
+            t.start()
+        out = _run(base + ["--fed_role", "aggregator"])
+        for t in threads:
+            t.join(timeout=120)
+        if len(sites_done) != sites:
+            raise SystemExit(
+                f"only {len(sites_done)}/{sites} site processes exited")
+    else:
+        out = _run(base + ["--fed_role", "aggregator",
+                           "--fed_backend", "local"])
+    run_dir = out["fed"]["out_dir"]
+    # TCP runtime merges are partial (each role only sees the streams
+    # already on disk when IT exits) — re-merge once every role is done,
+    # same as the operator's `obs xtrace <dir>`
+    merged = xtrace.merge_run_dir(run_dir)
+    if not merged:
+        raise SystemExit(f"traced run left no xtrace streams in {run_dir}")
+    doc = xtrace.load_doc(merged)
+    lanes = list((doc.get("xtrace") or {}).get("processes", []))
+    want = ["aggregator"] + [f"site{k}" for k in range(1, sites + 1)]
+    if lanes != want:
+        raise SystemExit(f"merged trace lanes {lanes}, want {want}")
+    orphans = xtrace.validate_parentage(doc)
+    if orphans:
+        raise SystemExit(f"causal tree has orphan spans: {orphans[:5]}")
+    idx = xtrace.span_index(doc)
+    for sid in sorted(idx):
+        ev = idx[sid]
+        if ev.get("name") != "site_round":
+            continue
+        parent = str((ev.get("args") or {}).get("parent", ""))
+        pev = idx.get(parent)
+        if pev is None or pev.get("name") != "dispatch":
+            raise SystemExit(
+                f"site_round {sid} parents to "
+                f"{pev and pev.get('name')}, want a dispatch span")
+    records = []
+    for p in sorted(glob.glob(os.path.join(run_dir, "*.jsonl"))):
+        name = os.path.basename(p)
+        if name.endswith(".events.jsonl") or name == "federation.jsonl":
+            continue
+        records.extend(_load_jsonl(p))
+    xt = obs_analyze._analyze_xtrace(doc, records)
+    if not xt.get("present"):
+        raise SystemExit("analyzer saw no merged trace")
+    named = [r for r in xt.get("rounds", []) if r.get("straggler")]
+    if not named:
+        raise SystemExit("no round in the trace named a straggler")
+    wrong = [r for r in named if r["straggler"] != f"site{sites}"]
+    if wrong:
+        raise SystemExit(
+            f"critical path missed the injected straggler: {wrong[:2]}")
+    if xt.get("straggler_mismatches"):
+        raise SystemExit(
+            "attribution contradicts the sites' own straggle records: "
+            f"{xt['straggler_mismatches']}")
+
+    # -- leg B: tracing-on loopback twin vs the untraced sync run -----
+    out_on = _run(_argv(clients, rounds, tmp, "xt_on") + [
+        "--fed_role", "aggregator", "--fed_mode", "sync",
+        "--fed_sites", str(sites), "--fed_backend", "local",
+        "--xtrace", "1",
+    ])
+    pd = obs_diff.params_diff(off_fed["global_params"],
+                              out_on["global_params"])
+    if not pd["identical"]:
+        raise SystemExit(
+            f"tracing is not byte-inert: {len(pd['diverged'])} param "
+            f"leaves diverged, first {pd['diverged'][:3]}")
+    off_dir = off_fed["fed"]["out_dir"]
+    on_dir = out_on["fed"]["out_dir"]
+    for name in sorted(os.listdir(off_dir)):
+        if name.endswith(xtrace.STREAM_SUFFIX) or \
+                name == xtrace.MERGED_TRACE_NAME:
+            raise SystemExit(
+                f"untraced run wrote a trace artifact: {name}")
+        a = _load_jsonl(os.path.join(off_dir, name))
+        b_path = os.path.join(on_dir, name)
+        if not os.path.exists(b_path):
+            raise SystemExit(f"traced twin is missing stream {name}")
+        b = _load_jsonl(b_path)
+        if name.endswith(".events.jsonl"):
+            d = obs_diff.events_diff(a, b)
+        elif name.endswith(".jsonl") and name != "federation.jsonl":
+            d = obs_diff.trajectory_diff(a, b)
+        else:
+            continue
+        if not d["identical"]:
+            raise SystemExit(f"tracing-on twin diverged in {name}: {d}")
+    agg_on = _load_jsonl(os.path.join(on_dir, "aggregator.jsonl"))
+    if not any("fed_round_ms" in r for r in agg_on):
+        raise SystemExit("traced aggregator never stamped fed_round_ms")
+    return {
+        "xtrace_transport": "tcp" if tcp else "local",
+        "xtrace_lanes": len(lanes),
+        "xtrace_rounds_attributed": len(named),
+        "xtrace_straggler": f"site{sites}",
+        "xtrace_inert": True,
+    }
+
+
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--clients", type=int, default=6)
@@ -155,6 +327,10 @@ def main(argv=None) -> dict:
     p.add_argument("--straggle_s", type=float, default=30.0,
                    help="straggler sleep; must exceed the whole "
                         "buffered run so the site never reports")
+    p.add_argument("--trace_straggle_s", type=float, default=1.5,
+                   help="per-round straggle in the traced leg; long "
+                        "enough to dominate compile/timing noise, "
+                        "short enough that sync rounds still complete")
     p.add_argument("--tmp", type=str, default="",
                    help="scratch dir (default: a fresh tempdir)")
     args = p.parse_args(argv)
@@ -171,10 +347,13 @@ def main(argv=None) -> dict:
     t0 = time.perf_counter()
     result = {"fed_smoke_ok": True, "clients": args.clients,
               "sites": args.sites}
-    result.update(run_sync_parity(args.clients, args.rounds, args.sites,
-                                  tmp))
+    sync_res, off_fed = run_sync_parity(args.clients, args.rounds,
+                                        args.sites, tmp)
+    result.update(sync_res)
     result.update(run_buffered_replay(args.clients, args.rounds,
                                       args.sites, tmp, args.straggle_s))
+    result.update(run_tracing_leg(args.clients, args.rounds, args.sites,
+                                  tmp, off_fed, args.trace_straggle_s))
     result["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     return result

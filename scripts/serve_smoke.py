@@ -24,6 +24,14 @@ subsystem stands on:
      (latency/throughput/hit-rate/version/staleness), the drain record
      carries ``serve_drained``, and the run catalog entry records
      ``completed=true`` for the serving stream.
+  5. DISTRIBUTED TRACING — a traced session (``--xtrace 1
+     --serve_probe_every 4``) merges publisher + worker span lanes
+     into one clock-aligned ``federation.trace.json``: every ``adopt``
+     span on the worker lane parents to a ``publish`` span on the
+     publisher lane (cross-process causality over the real wire), the
+     staleness probe stamps ``serve_probe_acc`` on tick lines, and the
+     untraced gate run writes NO trace artifacts (tracing off is
+     byte-inert).
 
     python scripts/serve_smoke.py            # CI gate
     python scripts/serve_smoke.py --requests 128 --rounds 3
@@ -57,22 +65,23 @@ def _free_ports(n):
     return ports
 
 
-def _argv(args, tmp):
+def _argv(args, tmp, sub=""):
+    root = os.path.join(tmp, sub) if sub else tmp
     return [
         "--model", "small3dcnn", "--dataset", "synthetic",
         "--client_num_in_total", str(args.clients), "--frac", "0.25",
         "--batch_size", "8", "--epochs", "1",
         "--comm_round", str(args.rounds), "--lr", "0.05",
         "--final_finetune", "0",
-        "--log_dir", os.path.join(tmp, "LOG"),
-        "--results_dir", os.path.join(tmp, "results"),
+        "--log_dir", os.path.join(root, "LOG"),
+        "--results_dir", os.path.join(root, "results"),
         "--serve_requests", str(args.requests),
         "--serve_rps", str(args.rps),
         "--serve_batch", "8", "--serve_wire", "int8",
         # a hot set smaller than the population: the Zipf head lives in
         # the LRU, the tail faults to disk — hit_rate < 1 is REAL
         "--serve_store", "disk", "--store_hot_clients", "8",
-        "--serve_ckpt_dir", os.path.join(tmp, "ckpt"),
+        "--serve_ckpt_dir", os.path.join(root, "ckpt"),
         "--slo_spec", SLO,
     ]
 
@@ -156,6 +165,13 @@ def run_serving_gate(args, tmp: str) -> dict:
         raise SystemExit(
             "run catalog has no completed=true entry for the serving "
             f"stream: {[(e['identity'], e['completed']) for e in entries]}")
+    # tracing was off: the run dir must hold zero trace artifacts
+    from neuroimagedisttraining_tpu.obs import xtrace
+    stray = [n for n in sorted(os.listdir(serve["out_dir"]))
+             if n.endswith(xtrace.STREAM_SUFFIX)
+             or n == xtrace.MERGED_TRACE_NAME]
+    if stray:
+        raise SystemExit(f"untraced run wrote trace artifacts: {stray}")
     return {
         "transport": "tcp" if tcp else "local",
         "pushes": pushes,
@@ -167,6 +183,81 @@ def run_serving_gate(args, tmp: str) -> dict:
         "rps": round(serve["rps"], 1),
         "slo_health": serve["slo"]["health_rank"],
         "catalog_completed": True,
+    }
+
+
+def run_tracing_leg(args, tmp: str) -> dict:
+    """Contract 5: traced serving session — both lanes in one merged
+    trace, adopt spans parent to publish spans across the wire, the
+    staleness probe stamps accuracy ticks."""
+    from neuroimagedisttraining_tpu.comm.tcp import native_available
+    from neuroimagedisttraining_tpu.obs import xtrace
+
+    base = _argv(args, tmp, "xt") + ["--xtrace", "1",
+                                     "--serve_probe_every", "4"]
+    tcp = native_available()
+    if tcp:
+        p0, p1 = _free_ports(2)
+        base += ["--serve_backend", "tcp", "--serve_endpoints",
+                 f"127.0.0.1:{p0},127.0.0.1:{p1}"]
+        worker_box = {}
+
+        def _worker():
+            worker_box["res"] = _run(base + ["--serve_role", "worker"])
+
+        wt = threading.Thread(target=_worker, daemon=True)
+        wt.start()
+        _run(base + ["--serve_role", "publisher"])
+        wt.join(timeout=180)
+        if wt.is_alive() or "res" not in worker_box:
+            raise SystemExit("traced serving worker never drained")
+        serve = worker_box["res"]["serve"]
+    else:
+        serve = _run(base + ["--serve_role", "worker",
+                             "--serve_backend", "local"])["serve"]
+    run_dir = serve["out_dir"]
+    # both roles share the run dir here; re-merge once both are done so
+    # neither lane is missing (the runtime's own merge may have run
+    # before the other role flushed its stream)
+    merged = xtrace.merge_run_dir(run_dir)
+    if not merged:
+        raise SystemExit(f"traced session left no streams in {run_dir}")
+    doc = xtrace.load_doc(merged)
+    lanes = list((doc.get("xtrace") or {}).get("processes", []))
+    if not {"publisher", "serve_worker"} <= set(lanes):
+        raise SystemExit(f"merged trace lanes {lanes}, want publisher "
+                         "+ serve_worker")
+    orphans = xtrace.validate_parentage(doc)
+    if orphans:
+        raise SystemExit(f"causal tree has orphan spans: {orphans[:5]}")
+    idx = xtrace.span_index(doc)
+    adopts = 0
+    for sid in sorted(idx):
+        ev = idx[sid]
+        if ev.get("name") != "adopt":
+            continue
+        parent = str((ev.get("args") or {}).get("parent", ""))
+        pev = idx.get(parent)
+        if pev is None or pev.get("name") != "publish":
+            raise SystemExit(
+                f"adopt span {sid} parents to "
+                f"{pev and pev.get('name')}, want a publish span")
+        adopts += 1
+    if not adopts:
+        raise SystemExit("traced session recorded no adopt spans")
+    with open(serve["jsonl"]) as f:
+        records = [json.loads(line) for line in f]
+    probes = [r for r in records if "serve_probe_acc" in r]
+    if not probes:
+        raise SystemExit("--serve_probe_every stamped no "
+                         "serve_probe_acc tick")
+    lag = [r for r in records if "serve_adopt_lag_ms" in r]
+    return {
+        "xtrace_transport": "tcp" if tcp else "local",
+        "xtrace_lanes": lanes,
+        "xtrace_adopts": adopts,
+        "probe_ticks": len(probes),
+        "adopt_lag_stamped": bool(lag),
     }
 
 
@@ -193,6 +284,7 @@ def main(argv=None) -> dict:
     result = {"serve_smoke_ok": True, "clients": args.clients,
               "rounds": args.rounds}
     result.update(run_serving_gate(args, tmp))
+    result.update(run_tracing_leg(args, tmp))
     result["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     return result

@@ -1,0 +1,355 @@
+"""Cross-process tracing: the contracts ``obs/xtrace.py`` stands on.
+
+Four planes pinned here:
+
+* **Header transparency** — a :class:`TraceContext` injected into a
+  ``Message`` survives ``to_bytes``/``from_bytes`` and the real
+  backends (loopback queue, native TCP) bit-exactly, on EVERY delta
+  wire impl, and ``extract`` reads untraced frames as ``None`` (old
+  peers never crash a traced aggregator).
+* **Byte-inert off** — the same frame with and without ``inject`` is
+  byte-identical except for exactly the three ``xt_*`` params; no
+  header, identical wire bytes.
+* **Deterministic merge** — ``merge_docs`` is a pure function: same
+  per-process streams in, byte-identical ``federation.trace.json``
+  out; clock offsets shift lanes onto the reference clock.
+* **Attribution end-to-end** — a tiny loopback federation with an
+  injected straggler produces a merged trace whose critical-path
+  analysis names the straggling site, agreeing with the site's own
+  ``fed_straggled`` record.
+"""
+import copy
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+# hypothesis is an optional test extra (pyproject `test`); without it
+# the deterministic shim keeps the properties exercised
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:
+    from _hypothesis_fallback import given, settings, strategies as st
+
+from neuroimagedisttraining_tpu.comm.local import LocalRouter
+from neuroimagedisttraining_tpu.comm.message import Message
+from neuroimagedisttraining_tpu.comm.tcp import (TcpCommManager,
+                                                 native_available)
+from neuroimagedisttraining_tpu.fed.wire import (WIRE_IMPLS,
+                                                 decode_update,
+                                                 encode_update)
+from neuroimagedisttraining_tpu.obs import xtrace
+from neuroimagedisttraining_tpu.obs.xtrace import (TraceContext, XTracer,
+                                                   extract, inject,
+                                                   merge_docs, ntp_offset,
+                                                   send_wall_ns,
+                                                   span_index,
+                                                   structure_of,
+                                                   validate_parentage,
+                                                   xspan)
+
+
+def _delta_msg(impl, seed=0):
+    rng = np.random.default_rng(seed)
+    tree = {"conv": {"w": rng.standard_normal((3, 4)).astype(np.float32)},
+            "head": [rng.standard_normal((5,)).astype(np.float32)]}
+    msg = Message("fed_update", sender_id=1, receiver_id=0)
+    encode_update(msg, tree, impl, density=0.5)
+    msg.add("n_sum", 16.0)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# header roundtrip: serialize / loopback / TCP
+# ---------------------------------------------------------------------------
+
+@settings(max_examples=20, deadline=None)
+@given(trace=st.text(st.characters(codec="ascii", min_codepoint=48,
+                                   max_codepoint=122), min_size=1,
+                     max_size=12),
+       seq=st.integers(1, 10 ** 6),
+       impl=st.sampled_from(WIRE_IMPLS))
+def test_header_roundtrip_serialization(trace, seq, impl):
+    """inject -> to_bytes -> from_bytes -> extract is the identity, on
+    every wire impl, and the payload decode is untouched."""
+    msg = _delta_msg(impl)
+    ctx = TraceContext(trace, f"aggregator:{seq}")
+    inject(msg, ctx, wall_ns=123456789)
+    got = Message.from_bytes(msg.to_bytes())
+    assert extract(got) == ctx
+    assert send_wall_ns(got) == 123456789
+    import jax
+
+    la = jax.tree_util.tree_flatten(decode_update(msg))[0]
+    lb = jax.tree_util.tree_flatten(decode_update(got))[0]
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_absent_header_tolerated():
+    """Untraced frames (tracing off, old peers) extract as None."""
+    msg = _delta_msg("dense")
+    assert extract(msg) is None
+    assert send_wall_ns(msg) is None
+    got = Message.from_bytes(msg.to_bytes())
+    assert extract(got) is None
+
+
+def test_tracing_off_is_byte_inert():
+    """The ONLY difference inject makes is the three xt_* params —
+    same frame without them is byte-identical to never tracing."""
+    a, b = _delta_msg("int8"), _delta_msg("int8")
+    assert a.to_bytes() == b.to_bytes()
+    inject(b, TraceContext("r0", "aggregator:1"), wall_ns=7)
+    assert a.to_bytes() != b.to_bytes()
+    for k in (xtrace.HDR_TRACE, xtrace.HDR_SPAN, xtrace.HDR_SEND_NS):
+        del b.params[k]
+    assert a.to_bytes() == b.to_bytes()
+
+
+@pytest.mark.parametrize("impl", WIRE_IMPLS)
+def test_header_roundtrip_local_backend(impl):
+    router = LocalRouter(2)
+    sender = router.manager(1)
+    msg = _delta_msg(impl)
+    inject(msg, TraceContext("r3", "site1:9"), wall_ns=42)
+    sender.send_message(msg)
+    got = Message.from_bytes(router.queues[0].get(timeout=5.0))
+    assert extract(got) == TraceContext("r3", "site1:9")
+    assert send_wall_ns(got) == 42
+
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++/native build unavailable")
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@needs_native
+def test_header_roundtrip_tcp_backend():
+    """Headers survive the REAL TCP transport on every wire impl, and
+    an untraced frame interleaved on the same connection reads None."""
+    eps = [("127.0.0.1", p) for p in _free_ports(2)]
+    site, agg = TcpCommManager(1, eps), TcpCommManager(0, eps)
+    try:
+        for i, impl in enumerate(WIRE_IMPLS):
+            msg = _delta_msg(impl)
+            ctx = TraceContext(f"r{i}", f"site1:{i + 1}")
+            inject(msg, ctx, wall_ns=1000 + i)
+            site.send_message(msg)
+            got = agg.recv(timeout_s=10.0)
+            assert got is not None and extract(got) == ctx
+            assert send_wall_ns(got) == 1000 + i
+        site.send_message(_delta_msg("dense"))
+        got = agg.recv(timeout_s=10.0)
+        assert got is not None and extract(got) is None
+    finally:
+        site.finalize()
+        agg.finalize()
+
+
+# ---------------------------------------------------------------------------
+# clocks and spans
+# ---------------------------------------------------------------------------
+
+def test_ntp_offset_midpoint():
+    """offset = t1 - (t0+t2)/2 recovers a known clock skew exactly
+    when the two wire legs are symmetric."""
+    skew, leg = 5_000_000, 250_000
+    t0 = 1_000_000
+    t1 = t0 + leg + skew           # peer stamps on arrival
+    t2 = t0 + 2 * leg              # initiator reads the ack
+    off, rtt = ntp_offset(t0, t1, t2)
+    assert off == pytest.approx(skew)
+    assert rtt == pytest.approx(2 * leg)
+
+
+def test_span_ids_and_parentage():
+    """Nested spans build the tree via the thread-local stack; ids are
+    deterministic "<process>:<seq>"."""
+    tr = XTracer("aggregator")
+    with xspan(tr, "fed_round", trace_id="r0") as root:
+        with xspan(tr, "dispatch") as d:
+            assert d.parent == root.span_id
+            assert d.trace_id == "r0"
+        with xspan(tr, "combine"):
+            pass
+    doc = tr.to_doc()
+    idx = span_index(doc)
+    assert sorted(idx) == ["aggregator:1", "aggregator:2", "aggregator:3"]
+    assert validate_parentage(doc) == []
+    s = structure_of(doc)
+    assert s["names"] == {"combine": 1, "dispatch": 1, "fed_round": 1}
+    assert s["edges"] == {">fed_round": 1, "fed_round>combine": 1,
+                          "fed_round>dispatch": 1}
+    assert s["traces"] == ["r0"]
+
+
+def test_null_span_is_total_noop():
+    """xspan(None, ...) is the tracing-off call-site contract: no
+    state, no context, no error."""
+    with xspan(None, "anything") as s:
+        s.add(k=1)
+        assert s.ctx() is None
+
+
+def test_structure_of_is_twin_stable():
+    """Two tracers running the same span program produce identical
+    structure views (the twin gate's comparator) even though their
+    timestamps differ."""
+    def program(tr):
+        with xspan(tr, "fed_round", trace_id="r0"):
+            with xspan(tr, "dispatch"):
+                pass
+            with xspan(tr, "combine"):
+                pass
+
+    a, b = XTracer("aggregator"), XTracer("aggregator")
+    program(a)
+    program(b)
+    assert structure_of(a.to_doc()) == structure_of(b.to_doc())
+
+
+# ---------------------------------------------------------------------------
+# merge: determinism + clock alignment
+# ---------------------------------------------------------------------------
+
+def _two_streams():
+    agg = XTracer("aggregator")
+    agg.note_offset("site1", 2_000_000.0, 300_000.0)
+    with xspan(agg, "fed_round", trace_id="r0") as root:
+        with xspan(agg, "dispatch") as d:
+            parent = d.span_id
+        root.add(round=0)
+    site = XTracer("site1", ref="aggregator")
+    site.offset_ns = 2_000_000.0
+    with xspan(site, "site_round", trace_id="r0", parent=parent):
+        with xspan(site, "train"):
+            pass
+    return agg.to_doc(), site.to_doc()
+
+
+def test_merge_is_deterministic():
+    """Same input docs (any order) -> byte-identical merged artifact."""
+    a, b = _two_streams()
+    m1 = merge_docs([copy.deepcopy(a), copy.deepcopy(b)])
+    m2 = merge_docs([copy.deepcopy(b), copy.deepcopy(a)])
+    assert json.dumps(m1, sort_keys=True) == json.dumps(m2, sort_keys=True)
+    meta = m1["xtrace"]
+    assert meta["merged"] is True
+    assert meta["processes"] == ["aggregator", "site1"]
+    assert meta["offsets_ns"] == {"site1": 2_000_000.0}
+    assert validate_parentage(m1) == []
+
+
+def test_merge_applies_clock_offsets():
+    """A lane whose clock runs AHEAD by the recorded offset lands on
+    the reference timebase after the merge (ts shifts back)."""
+    a, b = _two_streams()
+    raw_site_ts = {e["args"]["span_id"]: e["ts"]
+                   for e in b["traceEvents"]}
+    m = merge_docs([a, b])
+    merged_ts = {e["args"]["span_id"]: e["ts"]
+                 for e in m["traceEvents"] if e.get("ph") == "X"}
+    # aligned = raw - offset (site lane only); merged timebase = the
+    # minimum aligned timestamp across BOTH lanes
+    t0 = min([e["ts"] for e in a["traceEvents"]]
+             + [ts - 2_000.0 for ts in raw_site_ts.values()])
+    for sid, ts in raw_site_ts.items():
+        assert merged_ts[sid] == pytest.approx(ts - 2_000.0 - t0,
+                                               abs=1e-6)
+
+
+def test_merged_write_and_run_dir(tmp_path):
+    """write() + merge_run_dir converge on federation.trace.json and
+    a re-merge of identical streams is byte-identical (the smoke's
+    re-merge after TCP roles exit is safe to repeat)."""
+    a, b = _two_streams()
+    d = str(tmp_path)
+    with open(os.path.join(d, "aggregator" + xtrace.STREAM_SUFFIX),
+              "w") as f:
+        json.dump(a, f, sort_keys=True)
+    with open(os.path.join(d, "site1" + xtrace.STREAM_SUFFIX),
+              "w") as f:
+        json.dump(b, f, sort_keys=True)
+    p1 = xtrace.merge_run_dir(d)
+    assert p1 and os.path.basename(p1) == xtrace.MERGED_TRACE_NAME
+    with open(p1, "rb") as f:
+        bytes1 = f.read()
+    p2 = xtrace.merge_run_dir(d)
+    with open(p2, "rb") as f:
+        bytes2 = f.read()
+    assert bytes1 == bytes2
+    assert xtrace.merge_run_dir(str(tmp_path / "empty_missing")) is None
+
+
+def test_control_plane_json_counts_bytes():
+    """Message.to_json stamps nbytes so HELLO/ack control frames show
+    up in the comm counters instead of riding free."""
+    msg = Message("fed_hello", sender_id=1, receiver_id=0)
+    msg.add("t0_ns", 123)
+    payload = msg.to_json()
+    assert msg.nbytes == len(payload)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: straggler attribution over a real loopback federation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_straggler_attribution_e2e(tmp_path):
+    """A traced 2-site loopback federation with site 2 straggling 3s
+    per round: the merged trace names site2 on every round's critical
+    path and agrees with the site's own fed_straggled record. (The
+    straggle must dominate round-0 jit compile on the OTHER site —
+    sub-second sleeps flake here.)"""
+    from neuroimagedisttraining_tpu.experiments import (parse_args,
+                                                        run_experiment)
+    from neuroimagedisttraining_tpu.obs import analyze as obs_analyze
+
+    argv = [
+        "--model", "small3dcnn", "--dataset", "synthetic",
+        "--client_num_in_total", "4", "--frac", "1.0",
+        "--batch_size", "8", "--epochs", "1",
+        "--comm_round", "1", "--lr", "0.05", "--final_finetune", "0",
+        "--log_dir", str(tmp_path / "LOG"),
+        "--results_dir", str(tmp_path / "results"),
+        "--fed_role", "aggregator", "--fed_mode", "sync",
+        "--fed_sites", "2", "--fed_backend", "local",
+        "--fed_site_faults", "2:straggle=1.0:3.0",
+        "--fed_timeout_s", "60", "--xtrace", "1",
+    ]
+    out = run_experiment(parse_args(argv, algo="fedavg"), "fedavg")
+    run_dir = out["fed"]["out_dir"]
+    merged = out["fed"].get("merged_trace") or xtrace.merge_run_dir(
+        run_dir)
+    doc = xtrace.load_doc(merged)
+    assert (doc["xtrace"]["processes"] ==
+            ["aggregator", "site1", "site2"])
+    assert validate_parentage(doc) == []
+    records = []
+    for name in sorted(os.listdir(run_dir)):
+        if not name.endswith(".jsonl") or \
+                name.endswith(".events.jsonl") or \
+                name == "federation.jsonl":
+            continue
+        with open(os.path.join(run_dir, name)) as f:
+            records.extend(json.loads(line) for line in f if line.strip())
+    xt = obs_analyze._analyze_xtrace(doc, records)
+    assert xt["present"]
+    assert xt["orphans"] == []
+    named = [r for r in xt["rounds"] if r.get("straggler")]
+    assert named, xt["rounds"]
+    assert all(r["straggler"] == "site2" for r in named), named
+    assert xt["straggler_mismatches"] == []
+    assert xt["straggler_counts"].get("site2", 0) >= 1
