@@ -230,6 +230,10 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
     "serve_probe_every": ("inert", "read-only eval probe on the "
                                    "serving worker — telemetry, "
                                    "never training"),
+    "serve_workers": ("inert", "checkpoint fan-out width — every "
+                               "subscriber adopts the SAME encoded "
+                               "pushes; the trained model never "
+                               "changes"),
     # cross-process distributed tracing (obs/xtrace.py): pure
     # telemetry — tracing off is byte-inert on every wire, tracing on
     # adds control-plane headers the decode path ignores
@@ -237,6 +241,19 @@ FLAG_CLASSES: Dict[str, Tuple[str, str]] = {
                         "ignores the headers, payloads untouched "
                         "(tests/test_xtrace.py pins the roundtrip)"),
     "xtrace_dir": ("inert", "trace stream output path"),
+    # live fleet telemetry (obs/live.py, obs/prom.py): heartbeats off
+    # is byte-inert on every wire; on adds hb_* control-plane headers
+    # the decode path ignores (the xtrace gating precedent)
+    "obs_heartbeat_every": ("inert", "liveness frames + hb_* headers; "
+                                     "decode ignores them, payloads "
+                                     "untouched (tests/test_live.py "
+                                     "pins the transparency)"),
+    "obs_prom_port": ("inert", "/metrics HTTP exposition — pure "
+                               "readout of the registry snapshot"),
+    "obs_watch_every": ("inert", "obs watch refresh cadence, "
+                                 "tool-side only"),
+    "obs_watch_color": ("inert", "obs watch ANSI rendering, "
+                                 "tool-side only"),
     "save_masks": ("inert", "stat_info output only"),
     "record_mask_diff": ("inert", "stat_info output only"),
     "public_portion": ("inert", "inert in the reference too"),

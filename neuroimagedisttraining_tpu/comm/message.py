@@ -6,6 +6,18 @@ weights as pickled torch ``state_dict``s (MPI) or JSON floats (gRPC/MQTT);
 here tensor payloads use a zero-copy binary framing — a JSON header with the
 pytree structure + dtype/shape table, followed by the raw leaf bytes — so a
 cross-silo round never pickles and never base64s.
+
+**The in-band header contract** (what the telemetry planes ride on):
+``params`` is an open key-value namespace — a decoder reads the keys
+it knows and ignores the rest, so optional control-plane headers
+travel on existing frames without a protocol version bump. Two
+families use it today, both with the same gating rule (inject only
+when the feature's object is non-None, so feature-off is byte-inert
+on every wire): the ``xt_*`` trace-context headers (``obs/xtrace.py``)
+and the ``hb_*`` heartbeat gauge snapshots (``obs/live.py``). Header
+writers must keep values JSON-safe scalars/dicts and prefix their
+keys (``xt_``, ``hb_``) — the namespace is shared with the protocol's
+own routing and payload metadata.
 """
 from __future__ import annotations
 

@@ -521,6 +521,14 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
     p.add_argument("--serve_timeout_s", type=float, default=60.0,
                    help="drain/ack wait budget: worker waits this long "
                         "for serve_finish; publisher for the last ack")
+    p.add_argument("--serve_workers", type=int, default=1,
+                   help="checkpoint fan-out width (loopback backend): "
+                        "N workers (ranks 1..N) subscribe to the one "
+                        "publisher, every push broadcasts, ACKs keep "
+                        "per-rank watermarks and wait_acked waits for "
+                        "the slowest subscriber. Worker 1 takes the "
+                        "traffic; extras adopt every version "
+                        "identically (the fan-out bit-identity gate)")
     p.add_argument("--checkpoint_dir", type=str, default="",
                    help="enable round-granular orbax checkpointing here")
     p.add_argument("--resume", action="store_true",
@@ -571,6 +579,35 @@ def build_parser(algo: Optional[str] = None) -> argparse.ArgumentParser:
                    help="where the per-process *.xtrace.json streams "
                         "and the merged federation.trace.json land "
                         "(default: the fed/serve out_dir)")
+    p.add_argument("--obs_heartbeat_every", type=float, default=0.0,
+                   help="live fleet telemetry (obs/live.py): every "
+                        "UPDATE/ACK frame piggybacks a gauge snapshot "
+                        "as hb_* control-plane headers AND each site/"
+                        "serve worker emits a standalone fed_heartbeat "
+                        "frame every N seconds; the aggregator/"
+                        "publisher runs a FleetLedger (LIVE->SUSPECT->"
+                        "DOWN on missed heartbeats, SITE_DOWN/"
+                        "SITE_RECOVERED typed events, fleet_* gauges "
+                        "joined onto round records for federation-"
+                        "scope --slo_spec objectives). 0 (the default) "
+                        "is byte-inert on every wire; never enters run "
+                        "identity")
+    p.add_argument("--obs_prom_port", type=int, default=0,
+                   help="Prometheus exposition (obs/prom.py): serve "
+                        "GET /metrics (text format 0.0.4, "
+                        "deterministic key order) from the process "
+                        "metrics registry + comm counters + fleet "
+                        "gauges on this port — the aggregator and the "
+                        "serve worker start the HTTP thread. 0 (the "
+                        "default) = off, -1 = ephemeral port (the "
+                        "bound port lands in the result dict); pure "
+                        "readout, never enters run identity")
+    p.add_argument("--obs_watch_every", type=float, default=1.0,
+                   help="`obs watch` refresh interval in seconds (the "
+                        "live fleet dashboard; tool-side only)")
+    p.add_argument("--obs_watch_color", type=int, default=1,
+                   help="`obs watch` ANSI health colors (0 = plain "
+                        "text, the byte-pinned frame; tool-side only)")
     p.add_argument("--serve_probe_every", type=int, default=0,
                    help="accuracy-under-staleness probe: every N "
                         "serving ticks the worker evaluates its "
@@ -810,6 +847,18 @@ def derive(args: argparse.Namespace) -> argparse.Namespace:
         from ..obs.slo import load_slo_spec
 
         load_slo_spec(args.slo_spec)  # raises ValueError on bad specs
+    # live-telemetry knobs: range checks at parse time (same rule)
+    if float(getattr(args, "obs_heartbeat_every", 0.0) or 0.0) < 0:
+        raise ValueError(
+            f"--obs_heartbeat_every {args.obs_heartbeat_every} must be "
+            ">= 0 (seconds between heartbeat frames; 0 = off)")
+    if int(getattr(args, "obs_prom_port", 0) or 0) < -1:
+        raise ValueError(
+            f"--obs_prom_port {args.obs_prom_port} must be >= -1 "
+            "(0 = off, -1 = ephemeral, else the port to bind)")
+    if float(getattr(args, "obs_watch_every", 1.0) or 0.0) <= 0:
+        raise ValueError(
+            f"--obs_watch_every {args.obs_watch_every} must be > 0")
     if getattr(args, "guard", None) is None:
         args.guard = 1 if fault_spec else 0
     if getattr(args, "watchdog", None) is None:

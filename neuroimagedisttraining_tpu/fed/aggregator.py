@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -36,12 +37,20 @@ import numpy as np
 from ..comm.manager import ServerManager
 from ..comm.message import Message
 from ..core.state import weighted_tree_sum
-from ..obs import xtrace
+from ..obs import live as obs_live, xtrace
 from ..obs.export import RoundLogWriter, record_schema
 from ..obs.xtrace import XTracer
 from . import protocol, wire
 
 logger = logging.getLogger(__name__)
+
+#: clock-offset re-handshake cadence (rounds/flushes): the NTP-midpoint
+#: estimate drifts over long runs, so the aggregator re-initiates the
+#: HELLO pair every this many rounds and the FRESHEST offset wins —
+#: both here (``fed_wire_ms`` attribution via ``to_ref_ns``) and in the
+#: merged-trace lane alignment (``xtrace.merge_docs`` keeps the last
+#: offset a stream carries).
+CLOCK_RESYNC_EVERY = 16
 
 #: Byzantine norm screen: a member whose delta norm exceeds this factor
 #: times the median member norm is flagged (typed BYZANTINE event +
@@ -61,7 +70,8 @@ class FedAggregator(ServerManager):
                  robust_agg: str = "none", robust_trim: float = 0.2,
                  robust_krum_f: int = 0, robust_norm_bound: float = 5.0,
                  log_path: str = "", events_path: str = "",
-                 tracer: Optional[XTracer] = None, slo: Any = None):
+                 tracer: Optional[XTracer] = None, slo: Any = None,
+                 heartbeat_every: float = 0.0):
         super().__init__(comm, rank=0, world_size=world_size)
         import jax
 
@@ -122,11 +132,32 @@ class FedAggregator(ServerManager):
         self._hello_acks: "queue.Queue[Dict[str, float]]" = queue.Queue()
         self.register_message_receive_handler(
             protocol.MSG_FED_HELLO_ACK, self._on_hello_ack)
+        # fleet ledger (--obs_heartbeat_every): per-site liveness state
+        # machine fed by standalone HEARTBEAT frames + the hb_* headers
+        # piggybacked on UPDATE replies. The handler is registered
+        # unconditionally (inert unless sites actually send, which is
+        # flag-gated — the same idiom as the HELLO echo); the lock
+        # serializes pump-thread observations against round-loop ticks.
+        self.ledger: Optional[obs_live.FleetLedger] = \
+            obs_live.FleetLedger(heartbeat_every) \
+            if heartbeat_every > 0 else None
+        self._ledger_lock = threading.Lock()
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HEARTBEAT, self._on_heartbeat)
+        if self.ledger is not None:
+            now = time.monotonic()
+            for k in range(1, self.n_sites + 1):
+                # expected peers start LIVE with the silence clock
+                # running: a site that dies before its first heartbeat
+                # still goes DOWN
+                self.ledger.register(f"site{k}", now)
         # per-round wire/queue accumulators (tracing on): reset at every
         # round / flush boundary
         self._xt_wire_ns = 0.0
         self._xt_queue_ns = 0.0
         self._xt_round_t0 = time.perf_counter()
+        # buffered-mode re-handshake latch: one resync per flush index
+        self._resynced_at = -1
 
     # -- clock sync / trace plumbing (xtrace-gated, byte-inert off) -------
     def _enqueue_update(self, msg: Message) -> None:
@@ -136,7 +167,66 @@ class FedAggregator(ServerManager):
         # serialized, so the wire stays byte-identical either way.
         if self.tracer is not None:
             msg.xt_arrival_ns = self.tracer.wall_ns()
+        self._observe_heartbeat(msg)
         self._updates.put(msg)
+
+    # -- fleet ledger (heartbeat-gated, byte-inert off) -------------------
+    def _observe_heartbeat(self, msg: Message) -> None:
+        """Fold an inbound frame's piggybacked ``hb_*`` headers (or a
+        standalone HEARTBEAT frame) into the ledger; heartbeat-free
+        frames read unchanged."""
+        if self.ledger is None:
+            return
+        hb = obs_live.extract_heartbeat(msg)
+        if hb is None:
+            return
+        with self._ledger_lock:
+            events = self.ledger.observe(
+                hb["peer"], time.monotonic(),
+                round_idx=hb["round"], gauges=hb["gauges"])
+        for ev in events:
+            self._emit_live_event(ev)
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        self._observe_heartbeat(msg)
+
+    def _emit_live_event(self, ev) -> None:
+        rec = ev.to_record()
+        logger.warning("fleet: %s", ev.message)
+        if self.events is not None:
+            with self._ledger_lock:
+                self.events.write(rec)
+
+    def _ledger_tick(self) -> None:
+        """Advance the liveness clocks (SITE_DOWN fires here — from
+        the round loop, so detection happens WHILE a collect wait is
+        still pending, not after the round timeout)."""
+        if self.ledger is None:
+            return
+        with self._ledger_lock:
+            events = self.ledger.tick(time.monotonic())
+        for ev in events:
+            self._emit_live_event(ev)
+
+    def _get_update(self, timeout: float) -> Message:
+        """``_updates.get`` that keeps the ledger ticking: with
+        heartbeats on, the blocking wait is sliced at the heartbeat
+        interval so a dying site turns SUSPECT/DOWN mid-wait instead
+        of only after the round timeout. Raises ``queue.Empty`` after
+        ``timeout`` like the plain get."""
+        if self.ledger is None:
+            return self._updates.get(timeout=timeout)
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        while True:
+            self._ledger_tick()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise queue.Empty
+            try:
+                return self._updates.get(timeout=min(
+                    remaining, self.ledger.interval_s))
+            except queue.Empty:
+                continue
 
     def _on_hello_ack(self, msg: Message) -> None:
         t2 = self.tracer.wall_ns() if self.tracer is not None \
@@ -146,17 +236,21 @@ class FedAggregator(ServerManager):
                               "t1": float(msg.get("t1_ns", 0)),
                               "t2": float(t2)})
 
-    def clock_sync(self) -> None:
+    def clock_sync(self, timeout_s: Optional[float] = None) -> None:
         """One HELLO handshake per site: NTP-midpoint clock-offset
         estimate (``xtrace.ntp_offset``) recorded on the tracer, keying
         both the merged-trace lane alignment and the per-update wire
-        attribution. Only ever called when tracing is on."""
+        attribution. Only ever called when tracing is on. Re-invoked
+        every ``CLOCK_RESYNC_EVERY`` rounds (with a short timeout so a
+        dead site cannot stall the round loop); ``note_offset``
+        overwrites, so the freshest estimate wins everywhere."""
         if self.tracer is None:
             return
         for k in range(1, self.n_sites + 1):
             self._send(protocol.hello_message(
                 0, k, self.tracer.wall_ns()))
-        deadline = time.monotonic() + self.timeout_s
+        deadline = time.monotonic() + (
+            self.timeout_s if timeout_s is None else float(timeout_s))
         got = 0
         while got < self.n_sites:
             remaining = deadline - time.monotonic()
@@ -251,6 +345,17 @@ class FedAggregator(ServerManager):
                                "event_type": event_type, **extra})
 
     def _record(self, rec: Dict[str, Any]) -> None:
+        if self.ledger is not None and int(rec.get("round", -1)) >= 0:
+            # federation-scope gauges join the round record BEFORE the
+            # SLO engine sees it, so --slo_spec can declare fleet
+            # objectives (min sites live, max heartbeat age). The keys
+            # are volatile in obs/diff.py — heartbeat-on twins stay
+            # ``identical``.
+            self._ledger_tick()
+            with self._ledger_lock:
+                self.ledger.note_round(int(rec["round"]))
+                rec = {**rec, **self.ledger.fleet_gauges(
+                    time.monotonic())}
         self.history.append(rec)
         if self.slo is not None and int(rec.get("round", -1)) >= 0:
             # live SLO evaluation on the federation round stream
@@ -259,13 +364,31 @@ class FedAggregator(ServerManager):
             rec = dict(rec)
             for ev in self.slo.observe(rec):
                 if self.events is not None:
-                    self.events.write(ev.to_record())
+                    with self._ledger_lock:
+                        self.events.write(ev.to_record())
             rec["slo_health"] = self.slo.health
             rec["slo_breached"] = float(len(self.slo.breached))
             rec["obs_schema"] = record_schema(rec)
             self.history[-1] = rec
         if self.writer is not None:
             self.writer.write(rec)
+
+    def prom_snapshot(self) -> Dict[str, Any]:
+        """The ``/metrics`` body source (``obs/prom.py``): the
+        process-global registry snapshot joined with this process's
+        comm counters and (heartbeats on) the live fleet gauges —
+        rendered at scrape time, so the scrape tracks the run."""
+        from ..obs import metrics as obs_metrics
+
+        snap = dict(obs_metrics.get_registry().snapshot())
+        for k, v in self.comm.counters.snapshot().items():
+            snap[k] = {"type": "counter", "value": float(v)}
+        if self.ledger is not None:
+            with self._ledger_lock:
+                fleet = self.ledger.fleet_gauges(time.monotonic())
+            for k, v in fleet.items():
+                snap[k] = {"type": "gauge", "value": float(v)}
+        return snap
 
     def execute(self) -> None:
         """Run the configured number of rounds (sync) or flushes
@@ -308,6 +431,15 @@ class FedAggregator(ServerManager):
         import jax.numpy as jnp
 
         tr = self.tracer
+        if tr is not None and round_idx > 0 and \
+                round_idx % CLOCK_RESYNC_EVERY == 0:
+            # drift fix: refresh the per-site offsets between rounds
+            # (sites are idle at the barrier, so acks are immediate; a
+            # dead site only costs the short bounded wait)
+            self.clock_sync(timeout_s=min(self.timeout_s, 2.0))
+        if self.ledger is not None:
+            with self._ledger_lock:
+                self.ledger.note_round(round_idx)
         self._xt_wire_ns = self._xt_queue_ns = 0.0
         t_round = time.perf_counter()
         # the round's trace tree: minted from the round index, so twin
@@ -345,7 +477,7 @@ class FedAggregator(ServerManager):
                     if remaining <= 0:
                         break
                     try:
-                        msg = self._updates.get(timeout=remaining)
+                        msg = self._get_update(remaining)
                     except queue.Empty:
                         break
                     self._note_arrival(msg)
@@ -591,8 +723,13 @@ class FedAggregator(ServerManager):
         buffer: List[Tuple[int, int, Any, float, float]] = []
         flushes = 0
         while flushes < self.rounds:
+            if self.tracer is not None and flushes > 0 and \
+                    flushes % CLOCK_RESYNC_EVERY == 0 and \
+                    not self._resynced_at == flushes:
+                self._resynced_at = flushes
+                self.clock_sync(timeout_s=min(self.timeout_s, 2.0))
             try:
-                msg = self._updates.get(timeout=self.timeout_s)
+                msg = self._get_update(self.timeout_s)
                 self._note_arrival(msg)
             except queue.Empty:
                 if buffer:
@@ -654,7 +791,7 @@ class FedAggregator(ServerManager):
             need = [(int(s), int(b)) for s, b in flush["members"]]
             while not all(k in pool for k in need):
                 try:
-                    msg = self._updates.get(timeout=self.timeout_s)
+                    msg = self._get_update(self.timeout_s)
                     self._note_arrival(msg)
                 except queue.Empty:
                     waiting = [k for k in need if k not in pool]

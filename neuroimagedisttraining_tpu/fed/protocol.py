@@ -24,7 +24,14 @@ Messages (all via ``comm/message.py``'s binary pytree framing):
   offset by the NTP midpoint. Only ever sent when ``--xtrace`` is on
   (the byte-inert contract); both planes reuse the same pair — the
   aggregator initiates toward its sites, the serve worker toward its
-  publisher.
+  publisher. The aggregator re-initiates every
+  ``fed/aggregator.CLOCK_RESYNC_EVERY`` rounds so long runs track
+  clock drift instead of freezing the first offset estimate.
+* ``fed_heartbeat`` (site -> aggregator; serve worker -> publisher):
+  periodic standalone liveness frame carrying only the ``hb_*``
+  headers (``obs/live.py``) — mid-round progress for the fleet
+  ledger. Only ever sent when ``--obs_heartbeat_every`` is on (the
+  byte-inert contract, same as the HELLO pair).
 """
 from __future__ import annotations
 
@@ -43,6 +50,19 @@ MSG_FED_UPDATE = "fed_update"
 MSG_FED_FINISH = "fed_finish"
 MSG_FED_HELLO = "fed_hello"
 MSG_FED_HELLO_ACK = "fed_hello_ack"
+MSG_FED_HEARTBEAT = "fed_heartbeat"
+
+
+def heartbeat_message(sender: int, receiver: int, hb: Any) -> Message:
+    """A standalone HEARTBEAT frame: pure control plane (no tensors),
+    carrying only the ``hb_*`` headers of ``obs/live.py``. Only ever
+    sent when ``--obs_heartbeat_every`` is on (the byte-inert
+    contract, same as the HELLO pair)."""
+    from ..obs import live as obs_live
+
+    msg = Message(MSG_FED_HEARTBEAT, sender, receiver)
+    obs_live.inject_heartbeat(msg, hb)
+    return msg
 
 
 def hello_message(sender: int, receiver: int, t0_ns: int) -> Message:

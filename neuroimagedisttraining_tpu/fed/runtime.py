@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -45,9 +46,10 @@ logger = logging.getLogger(__name__)
 DEFAULT_STRAGGLE_S = 2.0
 
 
-def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
+def parse_site_faults(
+        spec: str) -> Dict[int, Tuple[Optional[FaultSpec], float, float]]:
     """``"rank:fault_spec[:delay_s];..."`` -> {site_rank: (FaultSpec,
-    straggle_sleep_s)}.
+    straggle_sleep_s, kill_after_s)}.
 
     The fault grammar is ``robust.faults.parse_fault_spec``'s
     (``drop=p,straggle=p,...``); the optional trailing ``:delay_s``
@@ -56,9 +58,13 @@ def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
     ``"3:straggle=1.0:6.0"`` — site 3 always straggles, 6s per round.
     ``"rank:byzantine"`` is sugar for ``rank:scale=1.0`` — an
     always-lying site shipping the 100x-forged delta every round.
+    ``"rank:kill[:after_s]"`` is the process-death fault: the site goes
+    COMPLETELY silent (no replies, no heartbeats, pump stopped)
+    ``after_s`` seconds in — the fleet ledger's SITE_DOWN detection
+    target, as distinct from ``drop`` (alive but withholding).
     Raises ``ValueError`` on malformed entries (parse-time validation,
     the derive() contract)."""
-    out: Dict[int, Tuple[FaultSpec, float]] = {}
+    out: Dict[int, Tuple[Optional[FaultSpec], float, float]] = {}
     if not spec:
         return out
     for entry in spec.split(";"):
@@ -88,6 +94,11 @@ def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
                     f"fed_site_faults trailing field {tail!r} is neither "
                     "a fault clause nor a delay") from None
             rest = head
+        if rank in out:
+            raise ValueError(f"duplicate fed_site_faults rank {rank}")
+        if rest == "kill":
+            out[rank] = (None, 0.0, delay)
+            continue
         if rest == "byzantine":
             # the Byzantine-role sugar: scale fires every round at the
             # default 100x factor (parse_fault_spec's scale_factor)
@@ -96,9 +107,7 @@ def parse_site_faults(spec: str) -> Dict[int, Tuple[FaultSpec, float]]:
         if fs is None:
             raise ValueError(
                 f"fed_site_faults entry {entry!r} has an empty fault spec")
-        if rank in out:
-            raise ValueError(f"duplicate fed_site_faults rank {rank}")
-        out[rank] = (fs, delay)
+        out[rank] = (fs, delay, 0.0)
     return out
 
 
@@ -240,19 +249,41 @@ def _fed_slo(args):
     return SloEngine(load_slo_spec(args.slo_spec))
 
 
+def _fed_heartbeat(args, peer: str):
+    """One :class:`obs.live.HeartbeatConfig` per emitting process —
+    ``--obs_heartbeat_every`` only; ``None`` keeps every wire
+    byte-inert (the HELLO/xtrace gating contract, third instance)."""
+    every = float(getattr(args, "obs_heartbeat_every", 0.0) or 0.0)
+    if every <= 0:
+        return None
+    from ..obs import live as obs_live
+
+    return obs_live.HeartbeatConfig(peer, every)
+
+
+def _fed_prom(args, snapshot_fn):
+    """The aggregator's ``/metrics`` endpoint (``--obs_prom_port``;
+    0 = off, -1 = ephemeral port). Returns the server or ``None``."""
+    from ..obs import prom as obs_prom
+
+    return obs_prom.maybe_prom_server(
+        snapshot_fn, int(getattr(args, "obs_prom_port", 0) or 0))
+
+
 def _make_worker(args, comm, rank: int, world: int,
                  trainer: SiteTrainer, out_dir: str,
                  tracer: Optional[XTracer] = None) -> SiteWorker:
     faults = parse_site_faults(getattr(args, "fed_site_faults", ""))
-    fs, delay = faults.get(rank, (None, 0.0))
+    fs, delay, kill_after = faults.get(rank, (None, 0.0, 0.0))
     log_path, events_path = _site_paths(out_dir, rank)
     return SiteWorker(
         comm, rank, world, trainer, seed=args.seed,
         wire_impl=getattr(args, "agg_impl", "dense"),
         wire_density=getattr(args, "agg_topk_density", 0.1),
-        fault_spec=fs, straggle_s=delay,
+        fault_spec=fs, straggle_s=delay, kill_after_s=kill_after,
         retries=args.fed_retries, backoff_s=args.fed_backoff_s,
-        log_path=log_path, events_path=events_path, tracer=tracer)
+        log_path=log_path, events_path=events_path, tracer=tracer,
+        heartbeat=_fed_heartbeat(args, f"site{rank}"))
 
 
 def _make_aggregator(args, comm, world: int, algo, out_dir: str,
@@ -276,7 +307,9 @@ def _make_aggregator(args, comm, world: int, algo, out_dir: str,
         robust_norm_bound=getattr(args, "norm_bound", 5.0),
         log_path=os.path.join(out_dir, "aggregator.jsonl"),
         events_path=os.path.join(out_dir, "aggregator.events.jsonl"),
-        tracer=tracer, slo=_fed_slo(args))
+        tracer=tracer, slo=_fed_slo(args),
+        heartbeat_every=float(
+            getattr(args, "obs_heartbeat_every", 0.0) or 0.0))
 
 
 def _fold_obs(out_dir: str, n_sites: int) -> Dict[str, str]:
@@ -312,7 +345,8 @@ def _fold_obs(out_dir: str, n_sites: int) -> Dict[str, str]:
 
 
 def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
-                       out_dir: str) -> Dict[str, Any]:
+                       out_dir: str, prom_port: int = 0
+                       ) -> Dict[str, Any]:
     import jax
 
     trace_path = ""
@@ -352,6 +386,14 @@ def _finish_aggregator(args, agg: FedAggregator, algo, identity: str,
         fed["merged_trace"] = merged_trace
     if agg.slo is not None:
         fed["slo"] = agg.slo.summary()
+    if agg.ledger is not None:
+        # the final fleet snapshot (+ a disk copy for `obs watch`):
+        # per-peer liveness states, heartbeat frame counts, gauges
+        fed["fleet"] = agg.ledger.snapshot(time.monotonic())
+        with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+            json.dump(fed["fleet"], f, indent=1)
+    if prom_port:
+        fed["prom_port"] = int(prom_port)
     with open(os.path.join(out_dir, "summary.json"), "w") as f:
         json.dump({"identity": identity, "final_eval": final_eval,
                    "rounds": len([r for r in agg.history
@@ -389,6 +431,7 @@ def _run_loopback(args, algo_name: str, identity: str,
                            out_dir,
                            tracer=_fed_tracer(args, "aggregator"))
     agg.run(background=True)
+    prom = _fed_prom(args, agg.prom_snapshot)
     try:
         agg.execute()
     finally:
@@ -399,7 +442,10 @@ def _run_loopback(args, algo_name: str, identity: str,
             w.finish()
             _write_stream(w.tracer, args, out_dir)
         agg.finish()
-    return _finish_aggregator(args, agg, algo, identity, out_dir)
+        if prom is not None:
+            prom.close()
+    return _finish_aggregator(args, agg, algo, identity, out_dir,
+                              prom_port=prom.port if prom else 0)
 
 
 def _run_tcp(args, algo_name: str, identity: str,
@@ -419,11 +465,15 @@ def _run_tcp(args, algo_name: str, identity: str,
             args, TcpCommManager(0, endpoints), world, algo, out_dir,
             tracer=_fed_tracer(args, "aggregator"))
         agg.run(background=True)
+        prom = _fed_prom(args, agg.prom_snapshot)
         try:
             agg.execute()
         finally:
             agg.finish()
-        return _finish_aggregator(args, agg, algo, identity, out_dir)
+            if prom is not None:
+                prom.close()
+        return _finish_aggregator(args, agg, algo, identity, out_dir,
+                                  prom_port=prom.port if prom else 0)
     rank = int(getattr(args, "fed_site_rank", 0))
     if not 1 <= rank <= args.fed_sites:
         _refuse(f"--fed_site_rank {rank} outside [1, fed_sites="

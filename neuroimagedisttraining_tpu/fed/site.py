@@ -26,7 +26,7 @@ import numpy as np
 
 from ..comm.manager import ClientManager
 from ..comm.message import Message
-from ..obs import xtrace
+from ..obs import live as obs_live, xtrace
 from ..obs.export import RoundLogWriter
 from ..obs.xtrace import XTracer
 from ..robust.faults import FaultSpec, fault_trace_round
@@ -49,10 +49,12 @@ class SiteWorker(ClientManager):
                  trainer: SiteTrainer, seed: int,
                  wire_impl: str = "dense", wire_density: float = 0.1,
                  fault_spec: Optional[FaultSpec] = None,
-                 straggle_s: float = 0.0, retries: int = 2,
+                 straggle_s: float = 0.0, kill_after_s: float = 0.0,
+                 retries: int = 2,
                  backoff_s: float = 0.05, log_path: str = "",
                  events_path: str = "",
-                 tracer: Optional[XTracer] = None):
+                 tracer: Optional[XTracer] = None,
+                 heartbeat: Optional[obs_live.HeartbeatConfig] = None):
         super().__init__(comm, rank=rank, world_size=world_size)
         self.trainer = trainer
         self.seed = int(seed)
@@ -69,6 +71,10 @@ class SiteWorker(ClientManager):
             if events_path else None
         self.done = threading.Event()
         self.rounds_trained = 0
+        self.heartbeat = heartbeat
+        # our own threads (receive pump + heartbeat emitter) must not
+        # interleave sends on the shared transport
+        self._send_lock = threading.Lock()
         self.register_message_receive_handler(
             protocol.MSG_FED_TRAIN, self._on_train)
         self.register_message_receive_handler(
@@ -77,13 +83,69 @@ class SiteWorker(ClientManager):
         # aggregator actually initiates a HELLO, which is xtrace-gated)
         self.register_message_receive_handler(
             protocol.MSG_FED_HELLO, self._on_hello)
+        self._hb_thread: Optional[threading.Thread] = None
+        if heartbeat is not None:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"hb:site{rank}", daemon=True)
+            self._hb_thread.start()
+        # the process-death fault ("rank:kill[:after_s]"): unlike a
+        # `drop` draw (alive but withholding one reply) the site goes
+        # COMPLETELY silent — no replies, no heartbeats, pump stopped —
+        # which is exactly the signal the fleet ledger's SUSPECT/DOWN
+        # machine (and nothing else in the repo) can see mid-round
+        self.kill_after_s = float(kill_after_s)
+        self._killed = False
+        if self.kill_after_s > 0:
+            threading.Thread(target=self._kill_loop,
+                             name=f"kill:site{rank}",
+                             daemon=True).start()
+
+    def _kill_loop(self) -> None:
+        if self.done.wait(self.kill_after_s):
+            return  # run finished before the kill fired
+        logger.warning("site %d: injected kill fires after %.2fs — "
+                       "going silent", self.rank, self.kill_after_s)
+        self._event(self.rounds_trained, "fed_site_kill",
+                    after_s=self.kill_after_s)
+        self._killed = True
+        # done stops the heartbeat emitter AND lets the runtime's
+        # bounded join proceed; the pump stop silences the handlers
+        self.done.set()
+        self.comm.stop_receive_message()
 
     def _on_hello(self, msg: Message) -> None:
+        if self._killed:
+            return
         t1 = self.tracer.wall_ns() if self.tracer is not None \
             else time.time_ns()
         reply = protocol.hello_ack(msg, self.rank, self.rank, t1)
-        protocol.send_with_retry(self, reply, retries=self.retries,
-                                 backoff_s=self.backoff_s)
+        with self._send_lock:
+            protocol.send_with_retry(self, reply, retries=self.retries,
+                                     backoff_s=self.backoff_s)
+
+    # -- live telemetry ---------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Periodic standalone HEARTBEAT frames toward the aggregator:
+        mid-round progress while ``_on_train`` is still inside its
+        train step. Best-effort by design — a LOST heartbeat is exactly
+        the signal the fleet ledger detects, so send failures are
+        swallowed, never retried."""
+        hb = self.heartbeat
+        while not self.done.wait(hb.every_s):
+            from ..obs.memory import host_rss
+
+            hb.note("mem_rss_mb",
+                    host_rss()["rss_bytes"] / 1e6)
+            hb.note("comm_messages_sent",
+                    self.comm.counters.messages_sent)
+            hb.note("comm_bytes_sent", self.comm.counters.bytes_sent)
+            try:
+                with self._send_lock:
+                    self.send_message(protocol.heartbeat_message(
+                        self.rank, 0, hb))
+            except OSError:
+                pass  # aggregator draining/gone: the ledger's problem
 
     # -- fault model ------------------------------------------------------
     def _draw_faults(self, version: int):
@@ -117,6 +179,8 @@ class SiteWorker(ClientManager):
 
     # -- protocol ---------------------------------------------------------
     def _on_train(self, msg: Message) -> None:
+        if self._killed:
+            return
         version = int(msg.get("version"))
         mode = msg.get("mode")
         t0 = time.perf_counter()
@@ -209,8 +273,22 @@ class SiteWorker(ClientManager):
                 # parent plus our send wall clock (its wire-time input)
                 xtrace.inject(reply, sr.ctx(),
                               wall_ns=self.tracer.wall_ns())
-            protocol.send_with_retry(self, reply, retries=self.retries,
-                                     backoff_s=self.backoff_s)
+            if self.heartbeat is not None:
+                # piggybacked gauge snapshot: every UPDATE is also a
+                # heartbeat (heartbeats off adds not one byte here)
+                self.heartbeat.note_round(version)
+                self.heartbeat.note("train_loss", loss)
+                self.heartbeat.note("local_epoch",
+                                    self.rounds_trained + 1)
+                obs_live.inject_heartbeat(reply, self.heartbeat)
+            if self._killed:
+                # the kill fired while we were training: a dead
+                # process does not get to finish its send
+                return
+            with self._send_lock:
+                protocol.send_with_retry(self, reply,
+                                         retries=self.retries,
+                                         backoff_s=self.backoff_s)
         self.rounds_trained += 1
         if self.writer is not None:
             self.writer.write({

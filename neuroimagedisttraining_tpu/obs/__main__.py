@@ -54,6 +54,16 @@
     python -m neuroimagedisttraining_tpu.obs xtrace results/fed_run \
         [--json] [--enforce]
 
+    # LIVE fleet dashboard: one lane per peer (health glyph, heartbeat
+    # age, round progress, key gauges) + the fleet summary line,
+    # re-rendered every --every seconds from the run dir's fleet.json
+    # (written by --obs_heartbeat_every runs) or scraped from a
+    # --obs_prom_port /metrics endpoint; --once prints one frame and
+    # exits (the scriptable mode — the frame is a pure function of the
+    # ledger snapshot, byte-pinned in tests/test_live.py)
+    python -m neuroimagedisttraining_tpu.obs watch results/fed_run \
+        [--once] [--every 1.0] [--color 0|1]
+
 Exit codes: analyze — 0 on success, 2 when the dir holds no streams;
 tail — 0 (interrupt to stop; --once prints what's there and exits,
 --all prints the newest line of every cataloged run, 2 when no stream
@@ -65,7 +75,8 @@ holds (or no expectation), 1 when it is violated, 2 when a run fails
 to load; report — 0, 2 when the catalog resolves empty; xtrace — 0,
 1 with --enforce when the causal tree has orphan spans or a named
 straggler contradicts the injected straggle trace, 2 when the dir
-holds no trace streams.
+holds no trace streams; watch — 0 (interrupt to stop; --once prints
+one frame and exits), 2 when no fleet snapshot resolves.
 """
 from __future__ import annotations
 
@@ -494,6 +505,90 @@ def xtrace_cli(run_dir: str, as_json: bool = False,
     return 0
 
 
+def watch_snapshot(target: str):
+    """``watch``'s snapshot resolution: ``(snapshot, slo_health)`` from
+    a run dir (its ``fleet.json``, written by ``--obs_heartbeat_every``
+    runs), an explicit ``fleet.json`` path, or an
+    ``http(s)://`` ``--obs_prom_port`` endpoint (the ``fleet_*`` gauges
+    of a ``/metrics`` scrape render the summary header; per-peer lanes
+    live only in the ledger snapshot). ``(None, "")`` when nothing
+    resolves — never raises."""
+    if target.startswith(("http://", "https://")):
+        from urllib.error import URLError
+        from urllib.request import urlopen
+
+        from . import prom as obs_prom
+
+        url = target if target.endswith("/metrics") \
+            else target.rstrip("/") + "/metrics"
+        try:
+            with urlopen(url, timeout=5.0) as resp:
+                body = resp.read().decode("utf-8", "replace")
+        except (URLError, OSError, ValueError):
+            return None, ""
+        samples = obs_prom.parse_prom_text(body)
+        fleet = {k: v for k, v in samples.items()
+                 if k.startswith("fleet_")}
+        if not fleet:
+            return None, ""
+        return {"round": -1, "interval_s": 0.0, "peers": [],
+                "fleet": fleet}, ""
+    path = os.path.join(target, "fleet.json") \
+        if os.path.isdir(target) else target
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        return None, ""
+    if not isinstance(snap, dict) or "peers" not in snap:
+        return None, ""
+    # the run-health verdict joins the header when the run declared
+    # --slo_spec: the newest round record of the dir's aggregator
+    # stream carries it
+    health = ""
+    agg = os.path.join(os.path.dirname(path) or ".",
+                       "aggregator.jsonl")
+    if os.path.exists(agg):
+        from .export import read_jsonl
+
+        try:
+            records = read_jsonl(agg, allow_partial_tail=True)
+        except (OSError, ValueError):
+            records = []
+        for rec in reversed(records):
+            if isinstance(rec.get("slo_health"), str):
+                health = rec["slo_health"]
+                break
+    return snap, health
+
+
+def watch_cli(target: str, once: bool = False, every: float = 1.0,
+              color: bool = False,
+              out: Callable[[str], None] = print,
+              stop: Optional[Callable[[], bool]] = None) -> int:
+    """``obs watch``: the live fleet dashboard — re-render the frame
+    (a pure function of the ledger snapshot) every ``every`` seconds;
+    ``once`` prints a single frame and exits (the scriptable mode).
+    ``stop`` is the test hook. Exit 2 when ``once`` resolves no
+    snapshot; follow mode keeps polling (the run may not have written
+    its first snapshot yet)."""
+    from . import live as obs_live
+
+    while True:
+        snap, health = watch_snapshot(target)
+        if snap is not None:
+            out(obs_live.render_frame(snap, color=color,
+                                      slo_health=health))
+        elif once:
+            print(f"no fleet snapshot under {target} (was the run "
+                  "launched with --obs_heartbeat_every > 0?)",
+                  file=sys.stderr)
+            return 2
+        if once or (stop is not None and stop()):
+            return 0
+        time.sleep(max(0.05, every))
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m neuroimagedisttraining_tpu.obs",
@@ -596,6 +691,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="bench history for the rounds/sec scatter "
                          "(default <results_dir>/bench_history.jsonl)")
 
+    pw = sub.add_parser(
+        "watch", help="live fleet dashboard (heartbeat ledger lanes)")
+    pw.add_argument("target", help="run dir holding fleet.json, an "
+                                   "explicit fleet.json path, or an "
+                                   "http(s):// --obs_prom_port "
+                                   "endpoint")
+    pw.add_argument("--once", action="store_true",
+                    help="print one frame and exit (the scriptable "
+                         "mode; default re-renders live)")
+    pw.add_argument("--every", type=float, default=1.0,
+                    help="seconds between frame refreshes")
+    pw.add_argument("--color", type=int, default=None,
+                    choices=(0, 1),
+                    help="ANSI health colors (default: on for a TTY, "
+                         "off when piped — frames stay "
+                         "byte-deterministic for scripts)")
+
     px = sub.add_parser(
         "xtrace", help="cross-process causal-trace report (merged "
                        "critical-path decomposition)")
@@ -611,6 +723,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "straggle trace")
 
     args = p.parse_args(argv)
+
+    if args.cmd == "watch":
+        color = bool(args.color) if args.color is not None \
+            else sys.stdout.isatty()
+        try:
+            return watch_cli(args.target, once=args.once,
+                             every=args.every, color=color)
+        except KeyboardInterrupt:
+            return 0
 
     if args.cmd == "xtrace":
         return xtrace_cli(args.run_dir, as_json=args.json,

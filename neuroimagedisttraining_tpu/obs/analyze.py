@@ -70,7 +70,7 @@ __all__ = [
 #: ``obs_schema`` round streams) are still accepted — each
 #: version's keys are required only of documents at that version or
 #: newer.
-ANALYSIS_SCHEMA_VERSION = 5
+ANALYSIS_SCHEMA_VERSION = 6
 
 #: host span name -> phase bucket. Container / nested spans are mapped
 #: to None and skipped so phase totals never double-count (``round``
@@ -866,6 +866,56 @@ def _analyze_slo(records: List[Dict[str, Any]],
     return out
 
 
+def _analyze_fleet(records: List[Dict[str, Any]],
+                   events: Optional[List[Dict[str, Any]]]
+                   ) -> Dict[str, Any]:
+    """The schema-v6 fleet section: the live-telemetry plane's
+    postmortem view — the ``fleet_*`` gauges the ledger joined onto
+    the round stream (sites live / max heartbeat age / round
+    progress trajectories) plus the SITE_DOWN / SITE_RECOVERED
+    timeline from the events stream, each with the peers it named.
+    ``present`` only for ``--obs_heartbeat_every`` runs — heartbeat-off
+    streams analyze with an empty section (the twin contract)."""
+    out: Dict[str, Any] = {
+        "present": False, "sites_live_final": None,
+        "sites_live_min": None, "sites_down_max": None,
+        "max_heartbeat_age_s": None, "round_progress_min": None,
+        "downs": [], "recoveries": [],
+    }
+    stamped = [r for r in records
+               if isinstance(r.get("fleet_sites_live"), (int, float))]
+    ev = [e for e in (events or ())
+          if e.get("event_type") in ("SITE_DOWN", "SITE_RECOVERED")]
+    if not stamped and not ev:
+        return out
+    out["present"] = True
+    if stamped:
+        out["sites_live_final"] = float(
+            stamped[-1]["fleet_sites_live"])
+        out["sites_live_min"] = min(
+            float(r["fleet_sites_live"]) for r in stamped)
+        out["sites_down_max"] = max(
+            float(r.get("fleet_sites_down") or 0.0) for r in stamped)
+        out["max_heartbeat_age_s"] = max(
+            float(r.get("fleet_max_heartbeat_age_s") or 0.0)
+            for r in stamped)
+        out["round_progress_min"] = min(
+            float(r.get("fleet_round_progress") or 0.0)
+            for r in stamped)
+    for e in ev:
+        entry = {
+            "round": int(e.get("round", -1)),
+            "peers": [str(p) for p in
+                      (e.get("detail") or {}).get("peers") or ()],
+        }
+        key = "downs" if e["event_type"] == "SITE_DOWN" \
+            else "recoveries"
+        out[key].append(entry)
+    for key in ("downs", "recoveries"):
+        out[key].sort(key=lambda d: (d["round"], d["peers"]))
+    return out
+
+
 #: merged-trace span names that each root one causal timeline: a sync
 #: federation round (``fed_round``), a buffered flush (``flush``), or
 #: a serving push (``publish``) — matched in this priority order
@@ -1103,6 +1153,7 @@ def analyze_records(records: List[Dict[str, Any]],
     comm = _analyze_comm(rounds, metrics, devtrace=devtrace,
                          config=config)
     slo = _analyze_slo(rounds, events, config)
+    fleet = _analyze_fleet(rounds, events)
     xtr = _analyze_xtrace(xtrace_doc, raw_records)
     analysis = {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
@@ -1120,6 +1171,7 @@ def analyze_records(records: List[Dict[str, Any]],
         "outlier_table": _outlier_table(stragglers, numerics),
         "comm": comm,
         "slo": slo,
+        "fleet": fleet,
         "xtrace": xtr,
     }
     flags = []
@@ -1150,6 +1202,10 @@ def analyze_records(records: List[Dict[str, Any]],
                             if b["event_type"] == "SLO_BREACH"})
     if breach_rounds:
         flags.append(f"slo_breach_rounds_{len(breach_rounds)}")
+    down_peers = sorted({p for d in fleet["downs"]
+                         for p in d["peers"]})
+    if down_peers:
+        flags.append("fleet_down_" + ",".join(down_peers))
     if xtr["present"]:
         if xtr["orphans"]:
             flags.append(f"xtrace_orphans_{len(xtr['orphans'])}")
@@ -1182,6 +1238,9 @@ _SCHEMA_KEYS_V4 = {"slo": dict}
 #: keys ADDED by schema v5 — required only of v5+ documents
 _SCHEMA_KEYS_V5 = {"xtrace": dict}
 
+#: keys ADDED by schema v6 — required only of v6+ documents
+_SCHEMA_KEYS_V6 = {"fleet": dict}
+
 
 def validate_analysis(analysis: Dict[str, Any]) -> None:
     """Raise ValueError describing every schema violation (an explicit
@@ -1200,6 +1259,8 @@ def validate_analysis(analysis: Dict[str, Any]) -> None:
             required.update(_SCHEMA_KEYS_V4)
         if analysis["schema_version"] >= 5:
             required.update(_SCHEMA_KEYS_V5)
+        if analysis["schema_version"] >= 6:
+            required.update(_SCHEMA_KEYS_V6)
     for key, typ in required.items():
         if key not in analysis:
             problems.append(f"missing key {key!r}")
@@ -1537,6 +1598,21 @@ def render_report(analysis: Dict[str, Any]) -> str:
             lines.append("  events: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(
                     (ev.get("by_type") or {}).items())))
+    fl = a.get("fleet") or {}
+    if fl.get("present"):
+        head = "fleet (live heartbeat ledger):"
+        if fl.get("sites_live_final") is not None:
+            head += (f" live {fl['sites_live_final']:g} at end"
+                     f" (min {fl['sites_live_min']:g}),"
+                     f" max heartbeat age "
+                     f"{fl['max_heartbeat_age_s']:.1f}s")
+        lines.append(head)
+        for d in fl.get("downs") or ():
+            lines.append(f"  SITE_DOWN round {d['round']}: "
+                         + ",".join(d["peers"]))
+        for d in fl.get("recoveries") or ():
+            lines.append(f"  SITE_RECOVERED round {d['round']}: "
+                         + ",".join(d["peers"]))
     lines.extend(render_xtrace(a.get("xtrace") or {}))
     c = a["compile"]
     if c["present"]:

@@ -61,8 +61,10 @@ VOLATILE_KEYS = {"round_time_s", "comm_agg_ms", "comm_agg_share",
                  "comm_messages_retried"}
 
 #: key prefixes with the same exemption (memory watermarks are host
-#: state, not run state)
-VOLATILE_PREFIXES = ("mem_",)
+#: state, not run state; hb_* gauge snapshots and fleet_* liveness
+#: gauges are wall-clock scheduling — a heartbeat-on run must still
+#: compare `identical` against its heartbeat-off twin)
+VOLATILE_PREFIXES = ("mem_", "hb_", "fleet_")
 
 #: MAD multiplier of the significance band (the perf-gate default)
 DEFAULT_MAD_K = 4.0

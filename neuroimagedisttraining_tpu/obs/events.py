@@ -63,6 +63,8 @@ EVENT_TYPES = {
     "SLO_BREACH": "error",         # an SLO objective entered violation
     "BUDGET_BURN": "warning",      # multi-window burn-rate alert
     "HEALTH_TRANSITION": "info",   # run-health state machine moved
+    "SITE_DOWN": "critical",       # fleet ledger: peer missed heartbeats
+    "SITE_RECOVERED": "info",      # fleet ledger: DOWN peer came back
 }
 
 #: record fields whose positive counts mark an adversarial round: the

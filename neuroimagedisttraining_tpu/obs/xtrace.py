@@ -228,10 +228,17 @@ class XTracer:
 
     def note_offset(self, peer: str, offset_ns: float,
                     rtt_ns: float) -> None:
-        """Record one HELLO estimate (reference side: peer->offset)."""
+        """Record one HELLO estimate (reference side: peer->offset).
+        Overwrites: a re-handshake (``fed/aggregator.py`` re-initiates
+        every ``CLOCK_RESYNC_EVERY`` rounds) replaces the stale
+        estimate, and the ``hellos`` counter lets ``merge_docs`` pick
+        the freshest table when several streams carry one peer."""
+        prev = self.hello.get(str(peer))
+        hellos = (float(prev.get("hellos", 1.0)) if prev else 0.0) + 1.0
         self.offsets_ns[str(peer)] = float(offset_ns)
         self.hello[str(peer)] = {"offset_ns": float(offset_ns),
-                                 "rtt_ns": float(rtt_ns)}
+                                 "rtt_ns": float(rtt_ns),
+                                 "hellos": hellos}
 
     def to_ref_ns(self, wall_ns: float, peer: str = "") -> float:
         """A wall timestamp mapped onto the reference clock: the
@@ -357,6 +364,7 @@ def merge_docs(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """
     by_proc: Dict[str, Dict[str, Any]] = {}
     offsets: Dict[str, float] = {}
+    fresh: Dict[str, float] = {}
     refs: List[str] = []
     for doc in docs:
         meta = doc.get("xtrace") or {}
@@ -365,11 +373,22 @@ def merge_docs(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         refs.append(str(meta.get("ref", proc)))
         off = meta.get("offset_ns", 0.0)
         if isinstance(off, (int, float)) and off:
+            # a process's OWN estimate always beats a fleet table's
             offsets[proc] = float(off)
-        # a reference-side stream may carry the fleet's offsets
+            fresh[proc] = float("inf")
+        # a reference-side stream may carry the fleet's offsets; the
+        # FRESHEST estimate per peer wins (the ``hellos`` re-handshake
+        # counter — long runs re-sync so drift does not accumulate
+        # into the lane alignment)
+        hello = meta.get("hello") or {}
         for peer, o in (meta.get("offsets_ns") or {}).items():
-            if isinstance(o, (int, float)):
-                offsets.setdefault(str(peer), float(o))
+            if not isinstance(o, (int, float)):
+                continue
+            peer = str(peer)
+            n = float((hello.get(peer) or {}).get("hellos", 1.0))
+            if peer not in offsets or n > fresh.get(peer, 0.0):
+                offsets[peer] = float(o)
+                fresh[peer] = n
     procs = sorted(by_proc)
     aligned: List[Tuple[float, int, str, Dict[str, Any]]] = []
     for pid, proc in enumerate(procs):

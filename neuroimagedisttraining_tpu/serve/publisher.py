@@ -30,7 +30,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -99,18 +99,32 @@ def load_checkpoint(path: str) -> Tuple[int, Any]:
 
 
 class CheckpointPublisher(ServerManager):
-    """Rank-0 manager the training loop calls ``publish`` on."""
+    """Rank-0 manager the training loop calls ``publish`` on.
+
+    ``worker_ranks`` is the fan-out set (default ``[worker_rank]``):
+    every push/finish broadcasts to each subscribed worker, ACKs keep
+    a **per-rank watermark**, and ``wait_acked`` waits for the SLOWEST
+    subscriber — pacing degrades to the laggard, never past it.
+    ``heartbeat_every > 0`` arms a :class:`obs.live.FleetLedger` over
+    the workers (peer ``worker<rank>``), fed by their standalone
+    HEARTBEAT frames and the gauge snapshots piggybacked on ACKs.
+    """
 
     def __init__(self, comm, rank: int = 0, world_size: int = 2,
-                 worker_rank: int = 1, ckpt_dir: str = "",
+                 worker_rank: int = 1,
+                 worker_ranks: Optional[List[int]] = None,
+                 ckpt_dir: str = "",
                  wire_impl: str = "int8", retries: int = 2,
                  backoff_s: float = 0.05,
-                 tracer: Optional[XTracer] = None):
+                 tracer: Optional[XTracer] = None,
+                 heartbeat_every: float = 0.0):
         super().__init__(comm, rank=rank, world_size=world_size)
         if wire_impl not in PUSH_WIRE_IMPLS:
             raise ValueError(
                 f"push wire {wire_impl!r} not in {PUSH_WIRE_IMPLS}")
-        self.worker_rank = int(worker_rank)
+        self.worker_ranks = [int(r) for r in (
+            worker_ranks if worker_ranks else [worker_rank])]
+        self.worker_rank = self.worker_ranks[0]
         self.ckpt_dir = ckpt_dir
         self.wire_impl = wire_impl
         self.retries = int(retries)
@@ -120,7 +134,16 @@ class CheckpointPublisher(ServerManager):
         self.pushes = 0
         self.bytes_pushed = 0
         self._ack_cond = threading.Condition()
-        self._acked_version = -1
+        self._acked = {r: -1 for r in self.worker_ranks}
+        self.ledger = None
+        if float(heartbeat_every) > 0:
+            from ..obs import live as obs_live
+
+            self.ledger = obs_live.FleetLedger(float(heartbeat_every))
+            now = time.monotonic()
+            for r in self.worker_ranks:
+                self.ledger.register(f"worker{r}", now)
+        self._ledger_lock = threading.Lock()
         self.register_message_receive_handler(MSG_SERVE_ACK,
                                               self._on_ack)
         # clock-sync echo for the worker-initiated HELLO (the serving
@@ -128,6 +151,9 @@ class CheckpointPublisher(ServerManager):
         # unconditionally, only ever exercised when tracing is on
         self.register_message_receive_handler(
             protocol.MSG_FED_HELLO, self._on_hello)
+        # liveness frames: same inert-unless-sent idiom as the HELLO
+        self.register_message_receive_handler(
+            protocol.MSG_FED_HEARTBEAT, self._on_heartbeat)
 
     # -- protocol ---------------------------------------------------------
     def _on_hello(self, msg: Message) -> None:
@@ -137,21 +163,50 @@ class CheckpointPublisher(ServerManager):
         send_with_retry(self, reply, retries=self.retries,
                         backoff_s=self.backoff_s)
 
+    def _observe_heartbeat(self, msg: Message) -> None:
+        if self.ledger is None:
+            return
+        from ..obs import live as obs_live
+
+        hb = obs_live.extract_heartbeat(msg)
+        if hb is None:
+            return
+        with self._ledger_lock:
+            events = self.ledger.observe(
+                hb["peer"], time.monotonic(), hb["round"], hb["gauges"])
+            events += self.ledger.tick(time.monotonic())
+        for ev in events:
+            logger.warning("serve fleet: %s %s", ev.type, ev.message)
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        self._observe_heartbeat(msg)
+
     def _on_ack(self, msg: Message) -> None:
+        self._observe_heartbeat(msg)
+        rank = int(msg.sender_id)
         with self._ack_cond:
-            self._acked_version = max(self._acked_version,
-                                      int(msg.get("version")))
+            if rank not in self._acked:
+                self._acked[rank] = -1  # late subscriber: track anyway
+            self._acked[rank] = max(self._acked[rank],
+                                    int(msg.get("version")))
             self._ack_cond.notify_all()
 
     @property
     def acked_version(self) -> int:
+        """The fleet watermark: the highest version EVERY worker has
+        adopted (the slowest subscriber's ack)."""
         with self._ack_cond:
-            return self._acked_version
+            return min(self._acked.values())
+
+    def acked_versions(self) -> Dict[int, int]:
+        """Per-rank ack watermarks (the fan-out accounting view)."""
+        with self._ack_cond:
+            return dict(self._acked)
 
     def wait_acked(self, version: int, timeout_s: float = 30.0) -> bool:
         deadline = time.perf_counter() + float(timeout_s)
         with self._ack_cond:
-            while self._acked_version < int(version):
+            while min(self._acked.values()) < int(version):
                 left = deadline - time.perf_counter()
                 if left <= 0:
                     return False
@@ -159,15 +214,31 @@ class CheckpointPublisher(ServerManager):
         return True
 
     # -- the push ---------------------------------------------------------
+    def _retarget(self, msg: Message, receiver: int) -> Message:
+        """A routing clone: the SAME encoded payload (params copied
+        minus the routing triple, tensor trees shared read-only)
+        addressed to another subscriber — every worker decodes
+        byte-identical wire content, the fan-out's bit-identity
+        anchor."""
+        out = Message(msg.type, self.rank, int(receiver))
+        for k, v in msg.params.items():
+            if k not in (Message.ARG_TYPE, Message.ARG_SENDER,
+                         Message.ARG_RECEIVER):
+                out.params[k] = v
+        out.tensors = dict(msg.tensors)
+        return out
+
     def publish(self, params: Any, version: int) -> str:
-        """Ship one model version to the worker and checkpoint the
-        reconstruction; returns the checkpoint path ('' if ckpt_dir is
-        unset)."""
+        """Ship one model version to every subscribed worker and
+        checkpoint the reconstruction; returns the checkpoint path (''
+        if ckpt_dir is unset). The encode (and the reconstruction-chain
+        advance) runs ONCE per version regardless of fan-out width."""
         with xtrace.xspan(self.tracer, "publish",
                           trace_id=f"v{int(version)}",
                           args={"version": int(version)}) as pspan:
             params = _np_f32_tree(params)
-            msg = Message(MSG_SERVE_PUSH, self.rank, self.worker_rank)
+            msg = Message(MSG_SERVE_PUSH, self.rank,
+                          self.worker_ranks[0])
             msg.add("version", int(version))
             with xtrace.xspan(self.tracer, "encode"):
                 if self._base is None:
@@ -187,35 +258,55 @@ class CheckpointPublisher(ServerManager):
                     self._base = _tree_add(
                         self._base, wire.decode_update(msg, key="delta"))
             if self.tracer is not None:
-                # the worker's adopt span parents to THIS publish; the
-                # send stamp is its adopt-lag input
+                # the workers' adopt spans parent to THIS publish; the
+                # send stamp is their adopt-lag input
                 xtrace.inject(msg, pspan.ctx(),
                               wall_ns=self.tracer.wall_ns())
             payload = msg.to_bytes()
-            self.bytes_pushed += len(payload)
+            self.bytes_pushed += len(payload) * len(self.worker_ranks)
             send_with_retry(self, msg, retries=self.retries,
                             backoff_s=self.backoff_s)
+            for r in self.worker_ranks[1:]:
+                send_with_retry(self, self._retarget(msg, r),
+                                retries=self.retries,
+                                backoff_s=self.backoff_s)
             self.pushes += 1
             path = ""
             if self.ckpt_dir:
                 with xtrace.xspan(self.tracer, "checkpoint"):
                     path = save_checkpoint(self.ckpt_dir, version,
                                            self._base)
-        logger.info("serve publish v%d: %s wire, %d B%s",
-                    version, msg.get("kind"), len(payload),
-                    f" -> {path}" if path else "")
+        if self.ledger is not None:
+            with self._ledger_lock:
+                self.ledger.note_round(int(version))
+                events = self.ledger.tick(time.monotonic())
+            for ev in events:
+                logger.warning("serve fleet: %s %s", ev.type,
+                               ev.message)
+        logger.info("serve publish v%d -> %d worker(s): %s wire, %d B%s",
+                    version, len(self.worker_ranks), msg.get("kind"),
+                    len(payload), f" -> {path}" if path else "")
         return path
 
     def finish_worker(self) -> None:
-        """Tell the worker to drain and exit (``serve_finish``)."""
-        msg = Message(MSG_SERVE_FINISH, self.rank, self.worker_rank)
+        """Tell every worker to drain and exit (``serve_finish``)."""
         with xtrace.xspan(self.tracer, "finish",
                           trace_id="finish") as fin:
-            if self.tracer is not None:
-                xtrace.inject(msg, fin.ctx(),
-                              wall_ns=self.tracer.wall_ns())
-            send_with_retry(self, msg, retries=self.retries,
-                            backoff_s=self.backoff_s)
+            for r in self.worker_ranks:
+                msg = Message(MSG_SERVE_FINISH, self.rank, r)
+                if self.tracer is not None:
+                    xtrace.inject(msg, fin.ctx(),
+                                  wall_ns=self.tracer.wall_ns())
+                send_with_retry(self, msg, retries=self.retries,
+                                backoff_s=self.backoff_s)
+
+    def fleet_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The ledger's point-in-time fleet view (None when heartbeats
+        are off) — the serve runtime's ``fleet.json`` source."""
+        if self.ledger is None:
+            return None
+        with self._ledger_lock:
+            return self.ledger.snapshot(time.monotonic())
 
     @property
     def servable_params(self) -> Optional[Any]:

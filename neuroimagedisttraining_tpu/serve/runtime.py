@@ -105,6 +105,13 @@ def validate_serve_args(args, algo_name: str) -> None:
         _refuse("--serve_push_every must be >= 1")
     if float(getattr(args, "serve_timeout_s", 0.0)) <= 0:
         _refuse("--serve_timeout_s must be > 0")
+    n_workers = int(getattr(args, "serve_workers", 1) or 1)
+    if n_workers < 1:
+        _refuse("--serve_workers must be >= 1")
+    if n_workers > 1 and backend == "tcp":
+        _refuse("--serve_workers > 1 is the loopback fan-out harness; "
+                "a tcp deployment runs one --serve_role worker process "
+                "per rank against a single publisher")
 
 
 def _out_dir(args, identity: str) -> str:
@@ -114,10 +121,13 @@ def _out_dir(args, identity: str) -> str:
     return d
 
 
-def _make_session(args, algo_name: str, identity: str, out_dir: str):
+def _make_session(args, algo_name: str, identity: str, out_dir: str,
+                  suffix: str = "", catalog: bool = True):
     """A real ObsSession for the worker (runner template, minus the
     --obs gate): JSONL stream, SLO engine straight off --slo_spec,
-    catalog entry at close."""
+    catalog entry at close. ``suffix`` keys extra fan-out workers'
+    streams (``catalog=False`` for those — one catalog entry per run,
+    not per subscriber)."""
     from ..experiments.config import run_identity
     from ..obs.export import ObsSession
 
@@ -126,9 +136,10 @@ def _make_session(args, algo_name: str, identity: str, out_dir: str):
         from ..obs.slo import SloEngine, load_slo_spec
 
         slo_engine = SloEngine(load_slo_spec(args.slo_spec))
+    identity = identity + suffix
     jsonl = os.path.join(out_dir, identity + ".obs.jsonl")
     cat_path, cat_info = "", None
-    if getattr(args, "obs_catalog", 1) and \
+    if catalog and getattr(args, "obs_catalog", 1) and \
             getattr(args, "results_dir", ""):
         from ..obs import catalog as obs_catalog
         from ..obs.regress import git_sha as _git_sha
@@ -153,12 +164,15 @@ def _make_session(args, algo_name: str, identity: str, out_dir: str):
     return session
 
 
-def _populate_store(args, out_dir: str, init_params, num_clients: int):
+def _populate_store(args, out_dir: str, init_params, num_clients: int,
+                    rank: int = 1):
     """The personal-model population: one deterministic per-client
     delta row, REALLY staged+committed (a disk-mode store ends up with
     real row files — the tier the Zipf head's LRU is measured against).
     Row c is a pure function of (seed, SERVE_SALT, c): re-deriving the
-    population on the publisher side (or in a test) is byte-exact."""
+    population on the publisher side (or in a test) is byte-exact.
+    Fan-out workers (rank > 1) stage into their own root — two LRU
+    tiers must not share row files."""
     import jax
 
     from ..core.client_store import ClientStore
@@ -166,7 +180,8 @@ def _populate_store(args, out_dir: str, init_params, num_clients: int):
     store = ClientStore(
         num_clients, mode=getattr(args, "serve_store", "disk"),
         hot_clients=int(getattr(args, "store_hot_clients", 64)),
-        root=os.path.join(out_dir, "store"))
+        root=os.path.join(out_dir,
+                          "store" if rank == 1 else f"store{rank}"))
     zeros = jax.tree_util.tree_map(
         lambda x: np.zeros_like(np.asarray(x, np.float32)), init_params)
     store.register(PERSONAL_FIELD, zeros)
@@ -257,24 +272,48 @@ def _probe_data(args, algo) -> Optional[Tuple[Any, Any]]:
             np.asarray(d.y_train)[ids, 0])
 
 
+def _serve_heartbeat(args, peer: str):
+    """One ``HeartbeatConfig`` per emitting process
+    (``--obs_heartbeat_every`` only; ``None`` keeps every wire
+    byte-inert — the fed runtime's gating contract, shared)."""
+    every = float(getattr(args, "obs_heartbeat_every", 0.0) or 0.0)
+    if every <= 0:
+        return None
+    from ..obs import live as obs_live
+
+    return obs_live.HeartbeatConfig(peer, every)
+
+
+def _serve_prom(args, snapshot_fn):
+    """The worker's ``/metrics`` endpoint (``--obs_prom_port``; 0 =
+    off, -1 = ephemeral). Returns the server or ``None``."""
+    from ..obs import prom as obs_prom
+
+    return obs_prom.maybe_prom_server(
+        snapshot_fn, int(getattr(args, "obs_prom_port", 0) or 0))
+
+
 def _make_worker(args, algo, comm, session, out_dir: str,
-                 init_params,
+                 init_params, rank: int = 1, world_size: int = 2,
                  tracer: Optional[XTracer] = None) -> ServeWorker:
     d = algo.data
     num_clients = int(np.asarray(d.x_train).shape[0])
-    store = _populate_store(args, out_dir, init_params, num_clients)
+    store = _populate_store(args, out_dir, init_params, num_clients,
+                            rank=rank)
     batcher = MicroBatcher(
         max_batch=int(getattr(args, "serve_batch", 16)),
         linger_ms=float(getattr(args, "serve_linger_ms", 2.0)))
     return ServeWorker(
-        comm, rank=1, world_size=2, apply_fn=algo.apply_fn,
+        comm, rank=rank, world_size=world_size,
+        apply_fn=algo.apply_fn,
         init_params=init_params, store=store, data_x=d.x_train,
         data_n=d.n_train, batcher=batcher, session=session,
         retries=int(getattr(args, "fed_retries", 2)),
         backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
         tracer=tracer,
         probe_every=int(getattr(args, "serve_probe_every", 0)),
-        probe_data=_probe_data(args, algo))
+        probe_data=_probe_data(args, algo),
+        heartbeat=_serve_heartbeat(args, f"worker{rank}"))
 
 
 def _ckpt_dir(args, out_dir: str) -> str:
@@ -369,25 +408,48 @@ def _run_loopback(args, algo_name: str, identity: str,
     init_params = state.global_params
     d = algo.data
     num_clients = int(np.asarray(d.x_train).shape[0])
-    router = LocalRouter(2)
-    session = _make_session(args, algo_name, identity, out_dir)
+    n_workers = int(getattr(args, "serve_workers", 1) or 1)
+    router = LocalRouter(1 + n_workers)
     ckpt_dir = _ckpt_dir(args, out_dir)
-    worker = _make_worker(args, algo, router.manager(1), session,
-                          out_dir, init_params,
-                          tracer=_serve_tracer(args, "serve_worker"))
-    worker.run(background=True)
+    workers: List[ServeWorker] = []
+    sessions = []
+    for r in range(1, n_workers + 1):
+        sess = _make_session(args, algo_name, identity, out_dir) \
+            if r == 1 else _make_session(
+                args, algo_name, identity, out_dir,
+                suffix=f".w{r}", catalog=False)
+        w = _make_worker(
+            args, algo, router.manager(r), sess, out_dir, init_params,
+            rank=r, world_size=1 + n_workers,
+            tracer=_serve_tracer(
+                args, "serve_worker" if r == 1 else f"serve_worker{r}"))
+        w.run(background=True)
+        workers.append(w)
+        sessions.append(sess)
+    worker, session = workers[0], sessions[0]
     pub = CheckpointPublisher(
-        router.manager(0), ckpt_dir=ckpt_dir,
+        router.manager(0), world_size=1 + n_workers,
+        worker_ranks=list(range(1, n_workers + 1)), ckpt_dir=ckpt_dir,
         wire_impl=getattr(args, "serve_wire", "int8"),
         retries=int(getattr(args, "fed_retries", 2)),
         backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
-        tracer=_serve_tracer(args, "publisher"))
+        tracer=_serve_tracer(args, "publisher"),
+        heartbeat_every=float(
+            getattr(args, "obs_heartbeat_every", 0.0) or 0.0))
     pub.run(background=True)
-    worker.clock_sync()
+    for w in workers:
+        w.clock_sync()
     worker.warmup()
-    serve_thread = threading.Thread(target=worker.serve_loop,
-                                    daemon=True)
-    serve_thread.start()
+    threads = []
+    for w in workers:
+        th = threading.Thread(target=w.serve_loop, daemon=True)
+        th.start()
+        threads.append(th)
+        if w is not worker:
+            # fan-out subscribers take no traffic in this harness —
+            # they exist to adopt every push identically; an immediate
+            # traffic_done lets their drain fire on serve_finish
+            w.mark_traffic_done()
     reqs = _requests(args, num_clients, d.n_train)
     traffic = threading.Thread(
         target=_pump_traffic,
@@ -395,28 +457,55 @@ def _run_loopback(args, algo_name: str, identity: str,
         daemon=True)
     t0 = time.perf_counter()
     traffic.start()
+    prom = _serve_prom(args, worker.prom_snapshot)
     try:
         # the training loop IS the calling thread: checkpoints stream
-        # to the worker while it absorbs the open-loop traffic
+        # to the worker(s) while rank 1 absorbs the open-loop traffic
         state, last_version = _train_and_push(args, algo, state, pub)
         traffic.join()
         if not pub.wait_acked(last_version, timeout_s=float(
                 getattr(args, "serve_timeout_s", 60.0))):
-            _refuse(f"worker never acked v{last_version}")
+            _refuse(f"worker(s) never acked v{last_version} "
+                    f"(watermarks {pub.acked_versions()})")
         pub.finish_worker()
         wall = time.perf_counter() - t0
-        serve = _drain(args, worker, session, serve_thread, ckpt_dir,
-                       wall)
+        serve = _drain(args, worker, session, serve_thread=threads[0],
+                       ckpt_dir=ckpt_dir, wall_s=wall)
+        extras = [_drain(args, w, s, serve_thread=th,
+                         ckpt_dir=ckpt_dir, wall_s=wall)
+                  for w, s, th in zip(workers[1:], sessions[1:],
+                                      threads[1:])]
     finally:
         pub.finish()
+        if prom is not None:
+            prom.close()
     _write_serve_stream(pub.tracer, args, out_dir)
-    _write_serve_stream(worker.tracer, args, out_dir)
+    for w in workers:
+        _write_serve_stream(w.tracer, args, out_dir)
     if worker.tracer is not None:
         serve["merged_trace"] = xtrace.merge_run_dir(
             _serve_xtrace_dir(args, out_dir)) or ""
     serve.update(pushes=pub.pushes, bytes_pushed=pub.bytes_pushed,
                  acked_version=pub.acked_version, out_dir=out_dir,
                  backend="local")
+    if n_workers > 1:
+        serve["workers"] = [
+            {"rank": r, "requests": s["requests"],
+             "pushes_adopted": s["pushes_adopted"],
+             "model_version": s["model_version"],
+             "bit_identical": s["bit_identical"]}
+            for r, s in enumerate([serve] + extras, start=1)]
+        serve["acked_versions"] = {
+            str(k): v for k, v in sorted(pub.acked_versions().items())}
+    fleet = pub.fleet_snapshot()
+    if fleet is not None:
+        serve["fleet"] = fleet
+        with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+            import json as _json
+
+            _json.dump(fleet, f, indent=1)
+    if prom is not None:
+        serve["prom_port"] = prom.port
     return {"identity": identity, "history": [], "final_eval": {},
             "stat_path": out_dir, "state": None, "serve": serve}
 
@@ -441,7 +530,9 @@ def _run_tcp(args, algo_name: str, identity: str,
             wire_impl=getattr(args, "serve_wire", "int8"),
             retries=int(getattr(args, "fed_retries", 2)),
             backoff_s=float(getattr(args, "fed_backoff_s", 0.05)),
-            tracer=_serve_tracer(args, "publisher"))
+            tracer=_serve_tracer(args, "publisher"),
+            heartbeat_every=float(
+                getattr(args, "obs_heartbeat_every", 0.0) or 0.0))
         pub.run(background=True)
         t0 = time.perf_counter()
         try:
@@ -454,17 +545,21 @@ def _run_tcp(args, algo_name: str, identity: str,
         finally:
             pub.finish()
         xtrace_path = _write_serve_stream(pub.tracer, args, out_dir)
+        serve_pub = {"role": "publisher", "backend": "tcp",
+                     "pushes": pub.pushes,
+                     "bytes_pushed": pub.bytes_pushed,
+                     "acked_version": pub.acked_version,
+                     "ckpt_dir": ckpt_dir,
+                     "wall_s": time.perf_counter() - t0,
+                     "out_dir": out_dir,
+                     "xtrace_path": xtrace_path,
+                     **pub.comm.counters.snapshot()}
+        fleet = pub.fleet_snapshot()
+        if fleet is not None:
+            serve_pub["fleet"] = fleet
         return {"identity": identity, "history": [], "final_eval": {},
                 "stat_path": out_dir, "state": None,
-                "serve": {"role": "publisher", "backend": "tcp",
-                          "pushes": pub.pushes,
-                          "bytes_pushed": pub.bytes_pushed,
-                          "acked_version": pub.acked_version,
-                          "ckpt_dir": ckpt_dir,
-                          "wall_s": time.perf_counter() - t0,
-                          "out_dir": out_dir,
-                          "xtrace_path": xtrace_path,
-                          **pub.comm.counters.snapshot()}}
+                "serve": serve_pub}
     # worker role: serve own traffic, adopt pushes until serve_finish
     d = algo.data
     num_clients = int(np.asarray(d.x_train).shape[0])
@@ -485,12 +580,21 @@ def _run_tcp(args, algo_name: str, identity: str,
         daemon=True)
     t0 = time.perf_counter()
     traffic.start()
+    prom = _serve_prom(args, worker.prom_snapshot)
     timeout = float(getattr(args, "serve_timeout_s", 60.0))
-    if not worker.done.wait(timeout=timeout):
-        _refuse(f"no serve_finish from the publisher within {timeout}s")
-    traffic.join(timeout=timeout)
-    wall = time.perf_counter() - t0
-    serve = _drain(args, worker, session, serve_thread, ckpt_dir, wall)
+    try:
+        if not worker.done.wait(timeout=timeout):
+            _refuse(
+                f"no serve_finish from the publisher within {timeout}s")
+        traffic.join(timeout=timeout)
+        wall = time.perf_counter() - t0
+        serve = _drain(args, worker, session, serve_thread, ckpt_dir,
+                       wall)
+    finally:
+        if prom is not None:
+            prom.close()
+    if prom is not None:
+        serve["prom_port"] = prom.port
     _write_serve_stream(worker.tracer, args, out_dir)
     if worker.tracer is not None:
         # same filesystem (the smoke's shape): the publisher's stream

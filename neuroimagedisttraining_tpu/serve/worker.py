@@ -45,7 +45,7 @@ from ..comm.manager import ClientManager
 from ..comm.message import Message
 from ..fed import protocol, wire
 from ..fed.protocol import send_with_retry
-from ..obs import xtrace
+from ..obs import live as obs_live, xtrace
 from ..obs.xtrace import XTracer
 from . import MSG_SERVE_ACK, MSG_SERVE_FINISH, MSG_SERVE_PUSH
 from .batcher import MicroBatcher
@@ -71,7 +71,9 @@ class ServeWorker(ClientManager):
                  retries: int = 2, backoff_s: float = 0.05,
                  tracer: Optional[XTracer] = None,
                  probe_every: int = 0,
-                 probe_data: Optional[Tuple[Any, Any]] = None):
+                 probe_data: Optional[Tuple[Any, Any]] = None,
+                 heartbeat: Optional[
+                     obs_live.HeartbeatConfig] = None):
         super().__init__(comm, rank=rank, world_size=world_size)
         import jax
 
@@ -128,6 +130,55 @@ class ServeWorker(ClientManager):
                                               self._on_finish)
         self.register_message_receive_handler(
             protocol.MSG_FED_HELLO_ACK, self._on_hello_ack)
+        # live telemetry: ACKs carry a piggybacked gauge snapshot and a
+        # daemon thread emits standalone HEARTBEAT frames toward the
+        # publisher's fleet ledger (--obs_heartbeat_every only — every
+        # wire stays byte-inert otherwise, the HELLO/xtrace contract)
+        self.heartbeat = heartbeat
+        # our own threads (receive pump + heartbeat emitter + the
+        # caller's clock_sync) must not interleave sends on the shared
+        # transport
+        self._send_lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        if heartbeat is not None:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"hb:worker{rank}", daemon=True)
+            self._hb_thread.start()
+
+    # -- live telemetry ---------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Best-effort by design — a LOST heartbeat is exactly the
+        signal the fleet ledger detects, so send failures are
+        swallowed, never retried."""
+        hb = self.heartbeat
+        while not self.done.wait(hb.every_s):
+            from ..obs.memory import host_rss
+
+            hb.note("mem_rss_mb", host_rss()["rss_bytes"] / 1e6)
+            hb.note("serve_queue_depth", self.batcher.depth())
+            hb.note("serve_requests_total", self.requests_served)
+            hb.note("comm_messages_sent",
+                    self.comm.counters.messages_sent)
+            hb.note("comm_bytes_sent", self.comm.counters.bytes_sent)
+            try:
+                with self._send_lock:
+                    self.send_message(protocol.heartbeat_message(
+                        self.rank, 0, hb))
+            except OSError:
+                pass  # publisher draining/gone: the ledger's problem
+
+    def prom_snapshot(self) -> Dict[str, Any]:
+        """The worker's ``/metrics`` source: the session registry
+        (latency/throughput/hit-rate distributions and gauges) joined
+        with the transport counters — rendered by ``obs/prom.py`` at
+        scrape time."""
+        snap: Dict[str, Any] = {}
+        if self.session is not None:
+            snap.update(self.session.registry.snapshot())
+        for k, v in self.comm.counters.snapshot().items():
+            snap[k] = {"type": "counter", "value": float(v)}
+        return snap
 
     # -- clock sync (xtrace-gated) ----------------------------------------
     def _on_hello_ack(self, msg: Message) -> None:
@@ -145,10 +196,11 @@ class ServeWorker(ClientManager):
         No-op (False) when tracing is off."""
         if self.tracer is None:
             return False
-        send_with_retry(
-            self, protocol.hello_message(self.rank, 0,
-                                         self.tracer.wall_ns()),
-            retries=self.retries, backoff_s=self.backoff_s)
+        with self._send_lock:
+            send_with_retry(
+                self, protocol.hello_message(self.rank, 0,
+                                             self.tracer.wall_ns()),
+                retries=self.retries, backoff_s=self.backoff_s)
         try:
             ack = self._hello_acks.get(timeout=float(timeout_s))
         except queue.Empty:
@@ -223,8 +275,18 @@ class ServeWorker(ClientManager):
             if ctx is not None:
                 xtrace.inject(ack, aspan.ctx(),
                               wall_ns=self.tracer.wall_ns())
-            send_with_retry(self, ack, retries=self.retries,
-                            backoff_s=self.backoff_s)
+            if self.heartbeat is not None:
+                # piggybacked gauge snapshot: every ACK is also a
+                # heartbeat (heartbeats off adds not one byte here)
+                self.heartbeat.note_round(version)
+                self.heartbeat.note("serve_model_version",
+                                    float(version))
+                self.heartbeat.note("serve_requests_total",
+                                    self.requests_served)
+                obs_live.inject_heartbeat(ack, self.heartbeat)
+            with self._send_lock:
+                send_with_retry(self, ack, retries=self.retries,
+                                backoff_s=self.backoff_s)
         logger.info("serve worker adopted v%d (%s push)", version, kind)
 
     def _on_finish(self, msg: Message) -> None:

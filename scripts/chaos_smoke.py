@@ -109,10 +109,14 @@ ATTACK_AGGS = ("median", "krum")
 
 #: accuracy-under-attack SLO: the same objective through three
 #: estimator kinds (obs/slo.py DSL) — EWMA drift floor, windowed-mean
-#: floor, lower-quartile floor. Each attack cell's eval-round history
-#: replays through a fresh engine offline; the per-estimator
-#: breach/no-breach verdict is pinned into the matrix output (the
-#: robustness claim as an SLO, not a one-off assert).
+#: floor, lower-quartile floor. Each attack cell runs it LIVE
+#: (``--slo_spec``: every eval-round record is stamped with the
+#: engine's verdict as the attacked run executes), then the recorded
+#: history replays through a fresh engine offline — the replay must
+#: reproduce the live health verdict (the engine is a pure function of
+#: the record stream), and the per-estimator breach/no-breach verdict
+#: is pinned into the matrix output (the robustness claim as an SLO,
+#: not a one-off assert).
 ATTACK_SLO = ("ewma:global_acc>0.4@a=0.3;"
               "rate:global_acc>0.4@w=6;"
               "p25:global_acc>0.35@w=6")
@@ -120,13 +124,15 @@ ATTACK_SLO = ("ewma:global_acc>0.4@a=0.3;"
 
 def attack_slo_verdicts(name: str, history) -> dict:
     """Replay one attacked run's round records through the SLO engine;
-    every estimator must produce a verdict (evaluate at least once)."""
+    every estimator must produce a verdict (evaluate at least once),
+    and the replay's health must reproduce the verdict the LIVE engine
+    stamped on the recorded lines."""
     from neuroimagedisttraining_tpu.obs.slo import (SloEngine,
                                                     parse_slo_spec)
 
+    records = [h for h in history if isinstance(h.get("round"), int)]
     engine = SloEngine(parse_slo_spec(ATTACK_SLO))
-    engine.replay([h for h in history
-                   if isinstance(h.get("round"), int)])
+    engine.replay(records)
     verdicts = {}
     for obj_name, obj in engine.summary()["objectives"].items():
         if not obj["evaluated"]:
@@ -140,6 +146,18 @@ def attack_slo_verdicts(name: str, history) -> dict:
             "compliance": round(obj["compliance"], 4),
             "value": obj["value"],
         }
+    # the live-evaluation contract: the in-run engine stamped its
+    # verdict on every eval-round line, and the offline replay agrees
+    live = [h for h in records if isinstance(h.get("slo_health"), str)]
+    if not live:
+        raise SystemExit(
+            f"[{name}] no recorded line carries slo_health — the "
+            "attack SLO did not run live")
+    if live[-1]["slo_health"] != engine.summary()["health"]:
+        raise SystemExit(
+            f"[{name}] live verdict {live[-1]['slo_health']!r} != "
+            f"replay verdict {engine.summary()['health']!r}")
+    verdicts["health_live"] = live[-1]["slo_health"]
     return verdicts
 
 
@@ -158,15 +176,23 @@ def run_attack_matrix(clients: int, rounds: int, tmp: str) -> dict:
             raise SystemExit(f"[{name}] non-finite train loss")
         if not tree_finite(out["state"].global_params):
             raise SystemExit(f"[{name}] non-finite final global params")
+        # the LIVE engine stamps slo_health on the obs JSONL lines
+        # (the enriched records), not the in-memory history — read the
+        # stream the run wrote
+        from neuroimagedisttraining_tpu.obs.export import read_jsonl
+        stream = os.path.join(tmp, name, "results", "synthetic",
+                              out["identity"] + ".obs.jsonl")
+        stamped = read_jsonl(stream, allow_partial_tail=True)
         return {"final_train_loss": float(hist[-1]["train_loss"]),
-                "slo": attack_slo_verdicts(name, out["history"])}
+                "slo": attack_slo_verdicts(name, stamped)}
 
     # -- in-process: adversary x robust statistic -------------------------
     for adv, spec in ATTACK_SPECS.items():
         for agg in ATTACK_AGGS:
             name = f"{adv}-{agg}"
             out = run_experiment(_build(
-                ["--robust_agg", agg, "--watchdog", "0"],
+                ["--robust_agg", agg, "--watchdog", "0",
+                 "--obs", "1", "--slo_spec", ATTACK_SLO],
                 clients, rounds, os.path.join(tmp, name),
                 fault_spec=spec), "fedavg")
             cells[name] = check(name, out)

@@ -25,6 +25,15 @@ asserts the contracts the subsystem stands on:
      Tracing-on vs tracing-off twins stay ``identical`` through the
      ``obs/diff.py`` planes (params + per-stream trajectories +
      events) — tracing off is byte-inert on the wire.
+  4. LIVE FLEET TELEMETRY — heartbeats (``--obs_heartbeat_every``)
+     are byte-inert (hb-on twin ``identical`` to the plain sync run
+     through every diff plane) and the ledger sees every site LIVE;
+     a site killed mid-run (``rank:kill:after_s`` fault) turns
+     SITE_DOWN with a typed event while the surviving quorum
+     finishes every buffered flush, the federation-scope SLO
+     (``ewma:fleet_sites_live>=N``) breaches, the ``--obs_prom_port``
+     ``/metrics`` endpoint serves parseable fleet gauges MID-RUN, and
+     ``obs watch --once`` renders the run dir's fleet frame.
 
     python scripts/fed_smoke.py              # CI gate
     python scripts/fed_smoke.py --rounds 3 --clients 9
@@ -319,6 +328,158 @@ def run_tracing_leg(clients: int, rounds: int, sites: int, tmp: str,
     }
 
 
+def run_live_leg(clients: int, rounds: int, sites: int, tmp: str,
+                 off_fed: dict, hb_every: float) -> dict:
+    """Contract 4 (live fleet telemetry): heartbeats are byte-inert;
+    a site killed mid-run turns SITE_DOWN on the ledger BEFORE the
+    round timeout while the surviving quorum finishes every flush; the
+    federation-scope SLO (min sites live) breaches; the /metrics
+    endpoint serves parseable fleet gauges mid-run; and
+    ``obs watch --once`` renders a non-empty frame from the run dir."""
+    import threading
+    from urllib.request import urlopen
+
+    from neuroimagedisttraining_tpu.obs import diff as obs_diff
+    from neuroimagedisttraining_tpu.obs import prom as obs_prom
+    from neuroimagedisttraining_tpu.obs.__main__ import watch_cli
+
+    # -- leg A: heartbeat-on loopback twin vs the plain sync run ------
+    out_on = _run(_argv(clients, rounds, tmp, "hb_on") + [
+        "--fed_role", "aggregator", "--fed_mode", "sync",
+        "--fed_sites", str(sites), "--fed_backend", "local",
+        "--obs_heartbeat_every", str(hb_every),
+    ])
+    pd = obs_diff.params_diff(off_fed["global_params"],
+                              out_on["global_params"])
+    if not pd["identical"]:
+        raise SystemExit(
+            f"heartbeats are not byte-inert: {len(pd['diverged'])} "
+            f"param leaves diverged, first {pd['diverged'][:3]}")
+    off_dir = off_fed["fed"]["out_dir"]
+    on_dir = out_on["fed"]["out_dir"]
+    for name in sorted(os.listdir(off_dir)):
+        if not name.endswith(".jsonl") or name == "federation.jsonl":
+            continue
+        b_path = os.path.join(on_dir, name)
+        if not os.path.exists(b_path):
+            raise SystemExit(f"heartbeat twin is missing stream {name}")
+        a = _load_jsonl(os.path.join(off_dir, name))
+        b = _load_jsonl(b_path)
+        d = obs_diff.events_diff(a, b) \
+            if name.endswith(".events.jsonl") \
+            else obs_diff.trajectory_diff(a, b)
+        if not d["identical"]:
+            raise SystemExit(
+                f"heartbeat-on twin diverged in {name}: {d}")
+    fleet = (out_on["fed"] or {}).get("fleet") or {}
+    live_peers = [p for p in fleet.get("peers", ())
+                  if p["state"] == "live" and p["frames"] > 0]
+    if len(live_peers) != sites:
+        raise SystemExit(
+            f"heartbeat run ledger saw {len(live_peers)}/{sites} "
+            f"live peers: {fleet}")
+    if os.path.exists(os.path.join(off_dir, "fleet.json")):
+        raise SystemExit("heartbeat-off run wrote a fleet.json")
+
+    # -- leg B: kill a site mid-run; detect, breach, survive ----------
+    # timing: DOWN fires after 6 silent heartbeat intervals (1.2s at
+    # 0.2s), while straggling ONE survivor pins the flush cadence (a
+    # site has at most one update in flight, so every flush waits on
+    # site 1's 0.5s sleep) — the run deterministically outlives the
+    # detection threshold with warm jit caches
+    hb_kill = min(0.2, hb_every)
+    kill_after = 2.0 * hb_kill
+    kill_rounds = max(rounds + 3, 5)
+    port = _free_ports(1)[0]
+    argv = _argv(clients, kill_rounds, tmp, "kill") + [
+        "--fed_role", "aggregator", "--fed_mode", "buffered",
+        "--fed_sites", str(sites), "--fed_buffer_k", str(sites - 1),
+        "--fed_backend", "local",
+        "--fed_site_faults",
+        f"1:straggle=1.0:0.5;{sites}:kill:{kill_after}",
+        "--fed_timeout_s", "120",
+        "--obs_heartbeat_every", str(hb_kill),
+        "--obs_prom_port", str(port),
+        "--slo_spec", f"ewma:fleet_sites_live>={sites}@a=1,min=1",
+    ]
+    box = {}
+
+    def _agg():
+        box["out"] = _run(argv)
+
+    th = threading.Thread(target=_agg, daemon=True)
+    th.start()
+    # mid-run prom scrape: the endpoint is up for the whole run, so
+    # poll until it serves the fleet gauges (run still in flight)
+    samples = {}
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and th.is_alive():
+        try:
+            with urlopen(f"http://127.0.0.1:{port}/metrics",
+                         timeout=2.0) as resp:
+                samples = obs_prom.parse_prom_text(
+                    resp.read().decode("utf-8"))
+        except OSError:
+            samples = {}
+        if "fleet_sites_live" in samples:
+            break
+        time.sleep(0.1)
+    if "fleet_sites_live" not in samples:
+        raise SystemExit(
+            "prom endpoint never served fleet gauges mid-run "
+            f"(last scrape keys: {sorted(samples)[:8]})")
+    th.join(timeout=240)
+    if "out" not in box:
+        raise SystemExit("killed-site run did not finish")
+    out_kill = box["out"]
+    flushes = [h for h in out_kill["history"]
+               if h.get("round", -1) >= 0]
+    if len(flushes) != kill_rounds:
+        raise SystemExit(
+            f"quorum did not survive the kill: {len(flushes)} flushes, "
+            f"expected {kill_rounds}")
+    # the ledger named the killed site DOWN (the typed event fired
+    # during the run — not a post-hoc timeout postmortem)
+    events = _load_jsonl(os.path.join(
+        out_kill["fed"]["out_dir"], "aggregator.events.jsonl"))
+    downs = [e for e in events if e.get("event_type") == "SITE_DOWN"]
+    down_peers = sorted({p for e in downs
+                         for p in (e.get("detail") or {})["peers"]})
+    if f"site{sites}" not in down_peers:
+        raise SystemExit(
+            f"no SITE_DOWN event named site{sites}: {downs}")
+    fleet = (out_kill["fed"] or {}).get("fleet") or {}
+    state = {p["peer"]: p["state"] for p in fleet.get("peers", ())}
+    if state.get(f"site{sites}") != "down":
+        raise SystemExit(
+            f"final ledger snapshot missed the kill: {state}")
+    # federation-scope SLO: min-sites-live breached once the site died
+    slo = (out_kill["fed"] or {}).get("slo") or {}
+    breaches = [e for e in events
+                if e.get("event_type") == "SLO_BREACH"]
+    if slo.get("health") == "ok" or not breaches:
+        raise SystemExit(
+            "fleet SLO never breached despite the killed site: "
+            f"health={slo.get('health')}, breaches={len(breaches)}")
+    # obs watch --once renders a non-empty frame from the run dir
+    frames = []
+    rc = watch_cli(out_kill["fed"]["out_dir"], once=True,
+                   out=frames.append)
+    if rc != 0 or not frames or f"site{sites}" not in frames[0]:
+        raise SystemExit(
+            f"obs watch --once failed: rc={rc}, frame={frames[:1]}")
+    return {
+        "hb_inert": True,
+        "hb_live_peers": len(live_peers),
+        "kill_flushes": len(flushes),
+        "site_down_detected": down_peers,
+        "fleet_slo_health": slo.get("health"),
+        "fleet_slo_breaches": len(breaches),
+        "prom_scrape_keys": len(samples),
+        "watch_frame_lines": frames[0].count("\n"),
+    }
+
+
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--clients", type=int, default=6)
@@ -331,6 +492,9 @@ def main(argv=None) -> dict:
                    help="per-round straggle in the traced leg; long "
                         "enough to dominate compile/timing noise, "
                         "short enough that sync rounds still complete")
+    p.add_argument("--hb_every", type=float, default=0.5,
+                   help="heartbeat interval for the live-telemetry "
+                        "leg; DOWN fires at 6x this silence")
     p.add_argument("--tmp", type=str, default="",
                    help="scratch dir (default: a fresh tempdir)")
     args = p.parse_args(argv)
@@ -354,6 +518,8 @@ def main(argv=None) -> dict:
                                       args.sites, tmp, args.straggle_s))
     result.update(run_tracing_leg(args.clients, args.rounds, args.sites,
                                   tmp, off_fed, args.trace_straggle_s))
+    result.update(run_live_leg(args.clients, args.rounds, args.sites,
+                               tmp, off_fed, args.hb_every))
     result["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     return result

@@ -32,6 +32,12 @@ subsystem stands on:
      staleness probe stamps ``serve_probe_acc`` on tick lines, and the
      untraced gate run writes NO trace artifacts (tracing off is
      byte-inert).
+  6. FAN-OUT — one publisher, two subscribed workers
+     (``--serve_workers 2``, loopback): each version is encoded ONCE
+     and the frame cloned per subscriber, so both workers adopt
+     bit-identical models at the same version; the publisher's
+     FleetLedger (worker heartbeats) shows both live and the per-rank
+     ack watermarks agree.
 
     python scripts/serve_smoke.py            # CI gate
     python scripts/serve_smoke.py --requests 128 --rounds 3
@@ -261,6 +267,47 @@ def run_tracing_leg(args, tmp: str) -> dict:
     }
 
 
+def run_fanout_leg(args, tmp: str) -> dict:
+    """Contract 6: one publisher, TWO subscribed workers (loopback
+    fan-out harness). The publisher encodes each version ONCE and
+    clones the frame per subscriber, so both workers adopt
+    bit-identical models at the same version; its FleetLedger (fed by
+    worker heartbeats) shows both live; ``wait_acked`` paces on the
+    slowest subscriber so the per-rank ack watermarks agree."""
+    serve = _run(_argv(args, tmp, "fanout") + [
+        "--serve_role", "worker", "--serve_backend", "local",
+        "--serve_workers", "2", "--obs_heartbeat_every", "0.3",
+    ])["serve"]
+    workers = serve.get("workers") or []
+    if len(workers) != 2:
+        raise SystemExit(f"fan-out ran {len(workers)} workers, want 2")
+    for w in workers:
+        if not w["bit_identical"]:
+            raise SystemExit(
+                f"fan-out worker {w['rank']} diverged from the "
+                f"checkpoint: {w}")
+    versions = sorted({w["model_version"] for w in workers})
+    if len(versions) != 1 or versions[0] < 1:
+        raise SystemExit(
+            f"fan-out workers ended at different versions: {workers}")
+    acked = serve.get("acked_versions") or {}
+    if len(set(acked.values())) != 1 or len(acked) != 2:
+        raise SystemExit(
+            f"per-rank ack watermarks disagree: {acked}")
+    fleet = serve.get("fleet") or {}
+    state = {p["peer"]: p["state"] for p in fleet.get("peers", ())}
+    if state != {"worker1": "live", "worker2": "live"}:
+        raise SystemExit(
+            f"publisher ledger missed a fan-out worker: {state}")
+    return {
+        "fanout_workers": len(workers),
+        "fanout_version": versions[0],
+        "fanout_bit_identical": True,
+        "fanout_acked": sorted(acked.values())[0],
+        "fanout_fleet_live": len(state),
+    }
+
+
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--clients", type=int, default=24)
@@ -285,6 +332,7 @@ def main(argv=None) -> dict:
               "rounds": args.rounds}
     result.update(run_serving_gate(args, tmp))
     result.update(run_tracing_leg(args, tmp))
+    result.update(run_fanout_leg(args, tmp))
     result["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(result))
     return result

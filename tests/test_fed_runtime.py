@@ -84,9 +84,9 @@ def test_send_with_retry_counts_and_reraises():
 def test_parse_site_faults_grammar():
     out = parse_site_faults("3:straggle=1.0:6.0;1:drop=0.5")
     assert set(out) == {1, 3}
-    fs3, delay3 = out[3]
-    assert delay3 == 6.0
-    _fs1, delay1 = out[1]
+    fs3, delay3, kill3 = out[3]
+    assert delay3 == 6.0 and kill3 == 0.0
+    _fs1, delay1, _kill1 = out[1]
     assert delay1 == 2.0  # DEFAULT_STRAGGLE_S when no trailing delay
     assert parse_site_faults("") == {}
 

@@ -1,0 +1,370 @@
+"""Live fleet telemetry plane (obs/live.py): the wire + state-machine
+contracts.
+
+* **Header transparency** — the ``hb_*`` heartbeat headers survive
+  serialize / LocalRouter / native TCP on EVERY delta wire impl, the
+  payload decode is untouched, heartbeat-free frames extract as None,
+  and heartbeats off is byte-inert (the xt_* contract, third family).
+* **Ledger determinism** — LIVE -> SUSPECT -> DOWN transitions are a
+  pure function of the (peer, time) observation sequence: a synthetic
+  clock drives a killed-site scenario twice and the snapshots match
+  bit-for-bit; SITE_DOWN / SITE_RECOVERED events fire exactly once
+  per transition.
+* **Frame byte pins** — ``render_frame`` is a pure function of the
+  snapshot: the exact bytes (plain and ANSI-colored) are pinned.
+* **Kill-fault grammar** — ``rank:kill[:after_s]`` parses into the
+  runtime's (fault, straggle, kill_after) triple alongside the
+  existing fault kinds.
+"""
+from __future__ import annotations
+
+import socket
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.comm.local import LocalRouter
+from neuroimagedisttraining_tpu.comm.message import Message
+from neuroimagedisttraining_tpu.comm.tcp import (TcpCommManager,
+                                                 native_available)
+from neuroimagedisttraining_tpu.fed import protocol
+from neuroimagedisttraining_tpu.fed.runtime import (DEFAULT_STRAGGLE_S,
+                                                    parse_site_faults)
+from neuroimagedisttraining_tpu.fed.wire import (WIRE_IMPLS,
+                                                 decode_update,
+                                                 encode_update)
+from neuroimagedisttraining_tpu.obs.live import (DOWN, HB_GAUGES,
+                                                 HB_PEER, HB_ROUND,
+                                                 LIVE, SUSPECT,
+                                                 FleetLedger,
+                                                 HeartbeatConfig,
+                                                 extract_heartbeat,
+                                                 fleet_gauge_keys,
+                                                 inject_heartbeat,
+                                                 render_frame)
+
+
+def _hb(peer="site1", every=0.5, rnd=3):
+    hb = HeartbeatConfig(peer, every)
+    hb.note_round(rnd)
+    hb.note("train_loss", 1.25)
+    hb.note("mem_rss_mb", 812.5)
+    hb.note("ignored_str", "nope")
+    hb.note("ignored_bool", True)
+    return hb
+
+
+def _delta_msg(impl, seed=0):
+    rng = np.random.default_rng(seed)
+    tree = {"conv": {"w": rng.standard_normal((3, 4)).astype(np.float32)},
+            "head": [rng.standard_normal((5,)).astype(np.float32)]}
+    msg = Message("fed_update", sender_id=1, receiver_id=0)
+    encode_update(msg, tree, impl, density=0.5)
+    msg.add("n_sum", 16.0)
+    return msg
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++/native build unavailable")
+
+
+# ---------------------------------------------------------------------------
+# heartbeat config + header roundtrip
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_config_board():
+    """note keeps numeric gauges only (bools excluded), payload is
+    sorted-key frozen, inject counts sends."""
+    hb = _hb()
+    assert hb.payload() == {"mem_rss_mb": 812.5, "train_loss": 1.25}
+    assert list(hb.payload()) == ["mem_rss_mb", "train_loss"]
+    assert hb.round == 3
+    msg = _delta_msg("dense")
+    inject_heartbeat(msg, hb)
+    assert hb.sent == 1
+    with pytest.raises(ValueError):
+        HeartbeatConfig("x", 0.0)
+
+
+@pytest.mark.parametrize("impl", WIRE_IMPLS)
+def test_header_roundtrip_serialization(impl):
+    """inject -> to_bytes -> from_bytes -> extract is the identity on
+    every delta wire impl, and the payload decode is untouched."""
+    import jax
+
+    msg = _delta_msg(impl)
+    inject_heartbeat(msg, _hb())
+    got = Message.from_bytes(msg.to_bytes())
+    assert extract_heartbeat(got) == {
+        "peer": "site1", "round": 3,
+        "gauges": {"mem_rss_mb": 812.5, "train_loss": 1.25}}
+    la = jax.tree_util.tree_flatten(decode_update(msg))[0]
+    lb = jax.tree_util.tree_flatten(decode_update(got))[0]
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_absent_header_tolerated():
+    """Heartbeat-free frames (heartbeats off, old peers) extract as
+    None — never raise."""
+    msg = _delta_msg("dense")
+    assert extract_heartbeat(msg) is None
+    assert extract_heartbeat(Message.from_bytes(msg.to_bytes())) is None
+
+
+def test_heartbeats_off_is_byte_inert():
+    """The ONLY difference inject makes is the three hb_* params —
+    the same frame without them is byte-identical to never
+    heartbeating (the wire contract every call site gates on)."""
+    a, b = _delta_msg("int8"), _delta_msg("int8")
+    assert a.to_bytes() == b.to_bytes()
+    inject_heartbeat(b, _hb())
+    assert a.to_bytes() != b.to_bytes()
+    for k in (HB_PEER, HB_ROUND, HB_GAUGES):
+        del b.params[k]
+    assert a.to_bytes() == b.to_bytes()
+
+
+@pytest.mark.parametrize("impl", WIRE_IMPLS)
+def test_header_roundtrip_local_backend(impl):
+    router = LocalRouter(2)
+    sender = router.manager(1)
+    msg = _delta_msg(impl)
+    inject_heartbeat(msg, _hb(peer="site1", rnd=9))
+    sender.send_message(msg)
+    got = Message.from_bytes(router.queues[0].get(timeout=5.0))
+    hb = extract_heartbeat(got)
+    assert hb is not None and hb["peer"] == "site1" \
+        and hb["round"] == 9
+
+
+@needs_native
+def test_header_roundtrip_tcp_backend():
+    """Headers survive the REAL TCP transport on every delta wire
+    impl; a heartbeat-free frame interleaved on the same connection
+    reads None."""
+    eps = [("127.0.0.1", p) for p in _free_ports(2)]
+    site, agg = TcpCommManager(1, eps), TcpCommManager(0, eps)
+    try:
+        for i, impl in enumerate(WIRE_IMPLS):
+            msg = _delta_msg(impl)
+            inject_heartbeat(msg, _hb(peer=f"site{i}", rnd=i))
+            site.send_message(msg)
+            got = agg.recv(timeout_s=10.0)
+            assert got is not None
+            hb = extract_heartbeat(got)
+            assert hb == {"peer": f"site{i}", "round": i,
+                          "gauges": {"mem_rss_mb": 812.5,
+                                     "train_loss": 1.25}}
+        site.send_message(_delta_msg("dense"))
+        got = agg.recv(timeout_s=10.0)
+        assert got is not None and extract_heartbeat(got) is None
+    finally:
+        site.finalize()
+        agg.finalize()
+
+
+def test_standalone_heartbeat_frame():
+    """protocol.heartbeat_message carries the full header triple."""
+    msg = protocol.heartbeat_message(2, 0, _hb(peer="site2", rnd=5))
+    got = Message.from_bytes(msg.to_bytes())
+    assert got.type == protocol.MSG_FED_HEARTBEAT
+    hb = extract_heartbeat(got)
+    assert hb is not None and hb["peer"] == "site2" \
+        and hb["round"] == 5
+
+
+# ---------------------------------------------------------------------------
+# the fleet ledger state machine (synthetic clock — no wall time)
+# ---------------------------------------------------------------------------
+
+def _killed_site_sequence(led):
+    """Drive a 3-peer ledger through a killed-site scenario; returns
+    the (time, event_type, peers) transitions observed."""
+    evs = []
+    for p in ("site1", "site2", "site3"):
+        led.register(p, 0.0)
+    t = 0.0
+    while t < 5.0:
+        t = round(t + 0.5, 3)
+        for p in ("site1", "site2"):
+            evs += [(t, e.type, e.detail["peers"])
+                    for e in led.observe(p, t, round_idx=int(t))]
+        # site3 goes silent at t=1.0 (the kill)
+        if t <= 1.0:
+            evs += [(t, e.type, e.detail["peers"])
+                    for e in led.observe("site3", t, round_idx=int(t))]
+        led.note_round(int(t))
+        evs += [(t, e.type, e.detail["peers"])
+                for e in led.tick(t)]
+    return evs
+
+
+def test_ledger_live_suspect_down():
+    """interval 0.5 -> SUSPECT at 1.5s silence, DOWN at 3.0s: the
+    killed site walks the machine exactly once and the SITE_DOWN
+    event names it (and only it)."""
+    led = FleetLedger(0.5)
+    evs = _killed_site_sequence(led)
+    downs = [e for e in evs if e[1] == "SITE_DOWN"]
+    assert downs == [(4.0, "SITE_DOWN", ["site3"])]
+    assert led.states() == {"site1": LIVE, "site2": LIVE,
+                            "site3": DOWN}
+    # intermediate state walked through SUSPECT
+    led2 = FleetLedger(0.5)
+    for p in ("site1", "site3"):
+        led2.register(p, 0.0)
+    led2.observe("site1", 2.0)
+    assert led2.tick(2.0) == []
+    assert led2.states()["site3"] == SUSPECT
+    # recovery: any sign of life flips DOWN back to LIVE with an event
+    recs = led.observe("site3", 5.5, round_idx=5)
+    assert [e.type for e in recs] == ["SITE_RECOVERED"]
+    assert led.states()["site3"] == LIVE
+    # ... and re-observing does not re-emit
+    assert led.observe("site3", 5.6) == []
+
+
+def test_ledger_deterministic_replay():
+    """Same observation sequence -> bit-identical snapshots (the
+    --fed_replay contract: the ledger is a pure function of the
+    arrival trace)."""
+    a, b = FleetLedger(0.5), FleetLedger(0.5)
+    evs_a, evs_b = _killed_site_sequence(a), _killed_site_sequence(b)
+    assert evs_a == evs_b
+    assert a.snapshot(5.0) == b.snapshot(5.0)
+
+
+def test_ledger_fleet_gauges():
+    led = FleetLedger(0.5)
+    assert set(led.fleet_gauges(0.0)) == set(fleet_gauge_keys())
+    _killed_site_sequence(led)
+    g = led.fleet_gauges(5.0)
+    assert g["fleet_sites_live"] == 2.0
+    assert g["fleet_sites_down"] == 1.0
+    assert g["fleet_max_heartbeat_age_s"] == pytest.approx(4.0)
+    # sites 1+2 reached the current round, site3 stuck at round 1
+    assert g["fleet_round_progress"] == pytest.approx(2.0 / 3.0)
+
+
+def test_ledger_refuses_bad_config():
+    with pytest.raises(ValueError):
+        FleetLedger(0.0)
+    with pytest.raises(ValueError):
+        FleetLedger(1.0, suspect_after=6.0, down_after=3.0)
+
+
+def test_ledger_gauges_absorbed():
+    led = FleetLedger(1.0)
+    led.observe("w1", 0.0, gauges={"train_loss": 0.7, "bad": "x",
+                                   "flag": True})
+    row = led.snapshot(0.0)["peers"][0]
+    assert row["gauges"] == {"train_loss": 0.7}
+
+
+# ---------------------------------------------------------------------------
+# dashboard frame byte pins
+# ---------------------------------------------------------------------------
+
+_FROZEN_SNAPSHOT = {
+    "round": 7, "interval_s": 0.5,
+    "peers": [
+        {"peer": "site1", "state": "live", "age_s": 0.123, "round": 7,
+         "frames": 42, "downs": 0,
+         "gauges": {"train_loss": 0.5, "mem_rss_mb": 812.5}},
+        {"peer": "site2", "state": "suspect", "age_s": 1.6, "round": 6,
+         "frames": 40, "downs": 0, "gauges": {}},
+        {"peer": "site3", "state": "down", "age_s": 3.75, "round": 3,
+         "frames": 12, "downs": 1, "gauges": {}},
+    ],
+    "fleet": {"fleet_sites_live": 2.0, "fleet_sites_down": 1.0,
+              "fleet_max_heartbeat_age_s": 3.75,
+              "fleet_round_progress": 1 / 3},
+}
+
+_FRAME_PLAIN = (
+    "fleet round 7  live 2/3  max_age 3.8s  progress 33%\n"
+    "  ● site1        live     age    0.1s  round 7    frames 42"
+    "    train_loss=0.5 mem_rss_mb=812.5\n"
+    "  ◐ site2        suspect  age    1.6s  round 6    frames 40   \n"
+    "  ○ site3        down     age    3.8s  round 3    frames 12   \n")
+
+_FRAME_COLOR = (
+    "fleet round 7  live 2/3  max_age 3.8s  progress 33%"
+    "  slo \x1b[33mDEGRADED\x1b[0m\n"
+    "  \x1b[32m●\x1b[0m site1        live     age    0.1s  round 7"
+    "    frames 42    train_loss=0.5 mem_rss_mb=812.5\n"
+    "  \x1b[33m◐\x1b[0m site2        suspect  age    1.6s  round 6"
+    "    frames 40   \n"
+    "  \x1b[31m○\x1b[0m site3        down     age    3.8s  round 3"
+    "    frames 12   \n")
+
+
+def test_render_frame_byte_pin():
+    assert render_frame(_FROZEN_SNAPSHOT) == _FRAME_PLAIN
+
+
+def test_render_frame_color_byte_pin():
+    assert render_frame(_FROZEN_SNAPSHOT, color=True,
+                        slo_health="degraded") == _FRAME_COLOR
+
+
+def test_render_frame_is_pure():
+    a = render_frame(_FROZEN_SNAPSHOT)
+    b = render_frame(dict(_FROZEN_SNAPSHOT))
+    assert a == b
+    assert render_frame({"round": -1, "peers": [], "fleet": {}}) \
+        == "fleet round -1  live 0/0  max_age 0.0s  progress 0%\n"
+
+
+def test_watch_cli_renders_run_dir(tmp_path):
+    """obs watch --once: run dir fleet.json -> exactly the pinned
+    frame bytes (the smoke's scriptable mode)."""
+    import json
+
+    from neuroimagedisttraining_tpu.obs.__main__ import watch_cli
+
+    (tmp_path / "fleet.json").write_text(
+        json.dumps(_FROZEN_SNAPSHOT))
+    frames = []
+    assert watch_cli(str(tmp_path), once=True,
+                     out=frames.append) == 0
+    assert frames == [_FRAME_PLAIN]
+    assert watch_cli(str(tmp_path / "absent"), once=True,
+                     out=frames.append) == 2
+
+
+# ---------------------------------------------------------------------------
+# the kill-fault grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_site_faults_kill_grammar():
+    out = parse_site_faults("3:kill:1.5")
+    assert out == {3: (None, 0.0, 1.5)}
+    fs, straggle, kill = parse_site_faults("2:kill")[2]
+    assert fs is None and straggle == 0.0 \
+        and kill == DEFAULT_STRAGGLE_S
+    # kill composes with the existing kinds on OTHER ranks
+    out = parse_site_faults("1:straggle=1.0:0.5;3:kill:0.4")
+    assert out[3] == (None, 0.0, 0.4)
+    fs, straggle, kill = out[1]
+    assert fs is not None and straggle == 0.5 and kill == 0.0
+
+
+def test_parse_site_faults_kill_rejects():
+    with pytest.raises(ValueError):
+        parse_site_faults("3:kill;3:kill")  # duplicate rank
+    with pytest.raises(ValueError):
+        parse_site_faults("0:kill")  # ranks are >= 1
+    with pytest.raises(ValueError):
+        parse_site_faults("3:kill:soon")  # delay must be a float

@@ -1,0 +1,178 @@
+"""Prometheus exposition (obs/prom.py): renderer golden pins + the
+scrape endpoint.
+
+* **Golden body** — ``render_prom`` is a pure function of the registry
+  snapshot: the exact text-format-0.0.4 body for a frozen snapshot
+  covering every mapped kind (counter, gauge, labeled children,
+  distribution->summary, NaN/±Inf spelling, int-collapsed floats) is
+  pinned byte-for-byte, and rendering is insertion-order independent.
+* **Endpoint** — ``PromServer`` serves exactly that body on
+  ``GET /metrics`` with the version-0.0.4 content type, 404s any other
+  path, tracks the live snapshot between scrapes, and closes
+  idempotently.
+* **Gate** — ``maybe_prom_server``: port 0 stays off, -1 binds
+  ephemeral, a taken port degrades to None instead of killing the run.
+* **Parser** — ``parse_prom_text`` roundtrips the golden body and
+  refuses malformed sample lines.
+"""
+from __future__ import annotations
+
+import math
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from neuroimagedisttraining_tpu.obs.prom import (CONTENT_TYPE,
+                                                 PromServer,
+                                                 maybe_prom_server,
+                                                 parse_prom_text,
+                                                 render_prom)
+
+
+def _snapshot():
+    return {
+        "fed_rounds_total": {"type": "counter", "value": 23.0,
+                             "labeled": {"site=site2": 7.0}},
+        "fleet_sites_live": {"type": "gauge", "value": 3},
+        "fleet_round_progress": {"type": "gauge", "value": 0.75},
+        "agg_flush_ms": {"type": "distribution",
+                         "value": {"p50": 12.0, "p99": 40.5,
+                                   "sum": 120.25, "count": 9},
+                         "labeled": {"wire=int8": {"p50": 3.5,
+                                                   "sum": 7.0,
+                                                   "count": 2}}},
+        "queue_depth": {"type": "gauge", "value": float("nan"),
+                        "labeled": {"site=site1": float("inf"),
+                                    "site=site2": float("-inf")}},
+    }
+
+
+_GOLDEN = (
+    '# TYPE agg_flush_ms summary\n'
+    'agg_flush_ms{quantile="0.5"} 12\n'
+    'agg_flush_ms{quantile="0.99"} 40.5\n'
+    'agg_flush_ms_sum 120.25\n'
+    'agg_flush_ms_count 9\n'
+    'agg_flush_ms{wire="int8",quantile="0.5"} 3.5\n'
+    'agg_flush_ms_sum{wire="int8"} 7\n'
+    'agg_flush_ms_count{wire="int8"} 2\n'
+    '# TYPE fed_rounds_total counter\n'
+    'fed_rounds_total 23\n'
+    'fed_rounds_total{site="site2"} 7\n'
+    '# TYPE fleet_round_progress gauge\n'
+    'fleet_round_progress 0.75\n'
+    '# TYPE fleet_sites_live gauge\n'
+    'fleet_sites_live 3\n'
+    '# TYPE queue_depth gauge\n'
+    'queue_depth NaN\n'
+    'queue_depth{site="site1"} +Inf\n'
+    'queue_depth{site="site2"} -Inf\n'
+)
+
+
+# ---------------------------------------------------------------------------
+# the renderer (pure function, byte-pinned)
+# ---------------------------------------------------------------------------
+
+def test_render_golden_body():
+    assert render_prom(_snapshot()) == _GOLDEN
+
+
+def test_render_insertion_order_independent():
+    """Output order is sorted metric/label order, not dict order —
+    two registries that absorbed the same gauges in different orders
+    render byte-identical bodies."""
+    snap = _snapshot()
+    shuffled = {k: snap[k] for k in reversed(list(snap))}
+    assert render_prom(shuffled) == _GOLDEN
+    assert render_prom(snap) == render_prom(snap)
+
+
+def test_render_empty_and_partial():
+    """Empty snapshot renders empty; a distribution missing its p99
+    drops that quantile row but keeps the _sum/_count pair."""
+    assert render_prom({}) == ""
+    body = render_prom({"lat_ms": {"type": "distribution",
+                                   "value": {"p50": 2.0, "sum": 4.0,
+                                             "count": 2}}})
+    assert 'lat_ms{quantile="0.5"} 2' in body
+    assert 'quantile="0.99"' not in body
+    assert "lat_ms_sum 4" in body and "lat_ms_count 2" in body
+
+
+def test_render_escapes_label_values():
+    body = render_prom({"g": {"type": "gauge", "labeled":
+                              {'k=a"b\nc': 1.0}}})
+    assert body == '# TYPE g gauge\ng{k="a\\"b\\nc"} 1\n'
+
+
+# ---------------------------------------------------------------------------
+# the scrape endpoint
+# ---------------------------------------------------------------------------
+
+def test_prom_server_scrape_roundtrip():
+    """GET /metrics returns the rendered live snapshot with the
+    0.0.4 content type; other paths 404; the body tracks snapshot
+    mutation between scrapes; close is idempotent."""
+    snap = _snapshot()
+    srv = PromServer(lambda: snap, port=0).start()
+    try:
+        assert srv.port > 0
+        with urllib.request.urlopen(srv.url, timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == CONTENT_TYPE
+            assert r.read().decode() == _GOLDEN
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/other", timeout=10)
+        assert ei.value.code == 404
+        snap["fleet_sites_live"]["value"] = 2
+        with urllib.request.urlopen(srv.url, timeout=10) as r:
+            assert "fleet_sites_live 2" in r.read().decode()
+    finally:
+        srv.close()
+        srv.close()
+
+
+def test_maybe_prom_server_gate():
+    """0 -> off; -1 -> ephemeral port; a port already bound by
+    another listener degrades to None (never kills the run)."""
+    assert maybe_prom_server(dict, 0) is None
+    srv = maybe_prom_server(dict, -1)
+    try:
+        assert srv is not None and srv.port > 0
+    finally:
+        srv.close()
+    blocker = socket.socket()
+    try:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken = blocker.getsockname()[1]
+        assert maybe_prom_server(dict, taken) is None
+    finally:
+        blocker.close()
+
+
+# ---------------------------------------------------------------------------
+# the text parser (the smoke's scrape assertion)
+# ---------------------------------------------------------------------------
+
+def test_parse_prom_text_roundtrip():
+    samples = parse_prom_text(_GOLDEN)
+    assert samples["fleet_sites_live"] == 3.0
+    assert samples['fed_rounds_total{site="site2"}'] == 7.0
+    assert samples['agg_flush_ms{quantile="0.99"}'] == 40.5
+    assert samples['queue_depth{site="site1"}'] == float("inf")
+    assert samples['queue_depth{site="site2"}'] == float("-inf")
+    assert math.isnan(samples["queue_depth"])
+    assert len(samples) == sum(
+        1 for ln in _GOLDEN.splitlines() if not ln.startswith("#"))
+
+
+def test_parse_prom_text_rejects_malformed():
+    with pytest.raises(ValueError, match="malformed prom sample"):
+        parse_prom_text("just_a_name_no_value")
+    with pytest.raises(ValueError, match="line 2"):
+        parse_prom_text("ok 1\nbad notafloat")

@@ -307,9 +307,9 @@ def test_parse_site_faults_byzantine_sugar():
     from neuroimagedisttraining_tpu.fed.runtime import parse_site_faults
 
     out = parse_site_faults("2:byzantine;3:byzantine:4.0")
-    fs2, _delay2 = out[2]
+    fs2, _delay2, _kill2 = out[2]
     assert fs2.scale == 1.0 and fs2.scale_factor == 100.0
-    _fs3, delay3 = out[3]
+    _fs3, delay3, _kill3 = out[3]
     assert delay3 == 4.0
     # sugar composes with the ordinary grammar elsewhere
     out2 = parse_site_faults("1:signflip=1.0")
